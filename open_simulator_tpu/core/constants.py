@@ -1,0 +1,87 @@
+"""Simulator constants: annotations, labels, stop reasons, env knobs.
+
+Mirrors the constant surface of the reference (/root/reference/pkg/type/const.go:7-43 and
+pkg/utils/const.go:3-17) so configs and annotated YAML written for the reference load
+unchanged.
+"""
+
+# --- scheduler identity -------------------------------------------------------------------
+DefaultSchedulerName = "default-scheduler"
+SimonPluginName = "Simon"
+OpenLocalPluginName = "Open-Local"
+OpenGpuSharePluginName = "Open-Gpu-Share"
+
+# --- annotations & labels (pkg/type/const.go) ---------------------------------------------
+AnnoWorkloadKind = "simon/workload-kind"
+AnnoWorkloadName = "simon/workload-name"
+AnnoNodeLocalStorage = "simon/node-local-storage"
+AnnoPodLocalStorage = "simon/pod-local-storage"
+AnnoNodeGpuShare = "simon/node-gpu-share"
+AnnoPodProvisioner = "simon/pod-provisioned-by"
+AnnoWorkloadNamespace = "simon/workload-namespace"
+
+LabelNewNode = "simon/new-node"
+LabelAppName = "simon/app-name"
+LabelDaemonSetFromCluster = "simon/daemonset-from-cluster"
+
+# --- workload kinds -----------------------------------------------------------------------
+Pod = "Pod"
+Deployment = "Deployment"
+ReplicaSet = "ReplicaSet"
+ReplicationController = "ReplicationController"
+StatefulSet = "StatefulSet"
+DaemonSet = "DaemonSet"
+Job = "Job"
+CronJob = "CronJob"
+Service = "Service"
+PodDisruptionBudget = "PodDisruptionBudget"
+StorageClass = "StorageClass"
+PersistentVolumeClaim = "PersistentVolumeClaim"
+ConfigMap = "ConfigMap"
+Node = "Node"
+
+WorkloadKinds = (Deployment, ReplicaSet, ReplicationController, StatefulSet, DaemonSet, Job, CronJob)
+
+# --- gpu-share annotations (pkg/type/open-gpu-share/utils/const.go:3-9) -------------------
+AnnoGpuMem = "alibabacloud.com/gpu-mem"            # pod: per-GPU memory request
+AnnoGpuCount = "alibabacloud.com/gpu-count"        # pod: number of GPUs wanted
+AnnoGpuIndex = "alibabacloud.com/gpu-index"        # pod: assigned device id(s), e.g. "0-2"
+AnnoGpuModel = "alibabacloud.com/gpu-card-model"   # node: card model
+ResourceGpuMem = "alibabacloud.com/gpu-mem"        # node allocatable: total sharable GPU mem
+ResourceGpuCount = "nvidia.com/gpu"                # node allocatable: whole-GPU count
+
+# --- fake node factory (pkg/type/const.go:11, pkg/utils/utils.go:885-915) -----------------
+NewNodeNamePrefix = "simon"
+
+# --- stop reasons (pkg/simulator/simulator.go:449-468) ------------------------------------
+StopReasonSuccess = "Success"
+StopReasonUnschedulable = "Unschedulable"
+PodReasonUnschedulable = "Unschedulable"
+
+CreatePodError = "failed to create pod"
+DeletePodError = "failed to delete pod"
+
+# --- env knobs (pkg/apply/apply.go:694-719) -----------------------------------------------
+EnvMaxCPU = "MaxCPU"
+EnvMaxMemory = "MaxMemory"
+EnvMaxVG = "MaxVG"
+EnvLogLevel = "LogLevel"
+
+# --- well-known k8s label/taint keys ------------------------------------------------------
+LabelHostname = "kubernetes.io/hostname"
+LabelTopologyZone = "topology.kubernetes.io/zone"
+LabelTopologyZoneBeta = "failure-domain.beta.kubernetes.io/zone"
+LabelTopologyRegion = "topology.kubernetes.io/region"
+TaintNodeUnschedulable = "node.kubernetes.io/unschedulable"
+
+# --- open-local storage class names (pkg/utils/const.go) ----------------------------------
+OpenLocalSCNameLVM = "open-local-lvm"
+OpenLocalSCNameDeviceHDD = "open-local-device-hdd"
+OpenLocalSCNameDeviceSSD = "open-local-device-ssd"
+OpenLocalSCNameMountPointHDD = "open-local-mountpoint-hdd"
+OpenLocalSCNameMountPointSSD = "open-local-mountpoint-ssd"
+YodaSCNameLVM = "yoda-lvm-default"
+YodaSCNameDeviceHDD = "yoda-device-hdd"
+YodaSCNameDeviceSSD = "yoda-device-ssd"
+YodaSCNameMountPointHDD = "yoda-mountpoint-hdd"
+YodaSCNameMountPointSSD = "yoda-mountpoint-ssd"

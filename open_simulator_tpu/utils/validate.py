@@ -1,0 +1,100 @@
+"""Validation-lite for generated pods/nodes.
+
+The reference runs full k8s apimachinery validation on every generated object
+(/root/reference/pkg/utils/utils.go:495-508 ValidatePod → validation.ValidatePodCreate,
+utils.go:625-645 ValidateNode). We reimplement the checks that can actually fire on
+simulator inputs: DNS-1123 names, required fields, non-negative resource quantities,
+resource requests ≤ limits, known restart/DNS policies.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from .quantity import InvalidQuantity, parse_decimal
+
+_DNS1123_SUBDOMAIN = re.compile(r"^[a-z0-9]([-a-z0-9.]*[a-z0-9])?$")
+_DNS1123_LABEL = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def _err(errs: List[str], msg: str) -> None:
+    errs.append(msg)
+
+
+def validate_name(name: str, errs: List[str], what: str) -> None:
+    if not name:
+        _err(errs, f"{what}: name is required")
+    elif len(name) > 253 or not _DNS1123_SUBDOMAIN.match(name):
+        _err(errs, f"{what}: invalid DNS-1123 name {name!r}")
+
+
+def _validate_resources(res: dict, errs: List[str], where: str) -> None:
+    requests = (res or {}).get("requests") or {}
+    limits = (res or {}).get("limits") or {}
+    for bucket_name, bucket in (("requests", requests), ("limits", limits)):
+        for k, v in bucket.items():
+            try:
+                q = parse_decimal(v)
+            except InvalidQuantity as e:
+                _err(errs, f"{where}.{bucket_name}[{k}]: {e}")
+                continue
+            if q < 0:
+                _err(errs, f"{where}.{bucket_name}[{k}]: must be non-negative")
+    for k, v in requests.items():
+        if k in limits:
+            try:
+                if parse_decimal(v) > parse_decimal(limits[k]):
+                    _err(errs, f"{where}: request of {k} exceeds limit")
+            except InvalidQuantity:
+                pass
+
+
+def validate_pod(pod: dict) -> None:
+    """Raise ValidationError listing every problem found (mirrors ValidatePod)."""
+    errs: List[str] = []
+    validate_name((pod.get("metadata") or {}).get("name", ""), errs, "pod")
+    spec = pod.get("spec") or {}
+    containers = spec.get("containers") or []
+    if not containers:
+        _err(errs, "pod: spec.containers is required")
+    seen = set()
+    # name uniqueness is required across containers AND initContainers (ValidatePodCreate)
+    for c in containers + (spec.get("initContainers") or []):
+        cname = c.get("name", "")
+        if not cname or not _DNS1123_LABEL.match(cname):
+            _err(errs, f"container: invalid name {cname!r}")
+        if not c.get("image"):
+            _err(errs, f"container {cname}: image is required")
+        _validate_resources(c.get("resources") or {}, errs, f"container {cname}")
+        if cname in seen:
+            _err(errs, f"container: duplicate name {cname!r}")
+        seen.add(cname)
+    rp = spec.get("restartPolicy")
+    if rp and rp not in ("Always", "OnFailure", "Never"):
+        _err(errs, f"pod: invalid restartPolicy {rp!r}")
+    dp = spec.get("dnsPolicy")
+    if dp and dp not in ("ClusterFirst", "ClusterFirstWithHostNet", "Default", "None"):
+        _err(errs, f"pod: invalid dnsPolicy {dp!r}")
+    if errs:
+        raise ValidationError("invalid pod: " + "; ".join(errs))
+
+
+def validate_node(node: dict) -> None:
+    """Mirrors ValidateNode: name + non-negative capacity/allocatable quantities."""
+    errs: List[str] = []
+    validate_name((node.get("metadata") or {}).get("name", ""), errs, "node")
+    status = node.get("status") or {}
+    for bucket_name in ("capacity", "allocatable"):
+        for k, v in (status.get(bucket_name) or {}).items():
+            try:
+                if parse_decimal(v) < 0:
+                    _err(errs, f"node.{bucket_name}[{k}]: must be non-negative")
+            except InvalidQuantity as e:
+                _err(errs, f"node.{bucket_name}[{k}]: {e}")
+    if errs:
+        raise ValidationError("invalid node: " + "; ".join(errs))

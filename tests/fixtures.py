@@ -1,0 +1,247 @@
+"""Functional-option fixture builders, mirroring the reference's pkg/test builders
+(/root/reference/pkg/test/{pod,node,deployment,...}.go) so tests read the same way."""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Optional
+
+
+def make_node(
+    name: str,
+    cpu: str = "8",
+    memory: str = "16Gi",
+    pods: str = "110",
+    labels: Optional[dict] = None,
+    taints: Optional[List[dict]] = None,
+    annotations: Optional[dict] = None,
+    extra_resources: Optional[dict] = None,
+    unschedulable: bool = False,
+) -> dict:
+    alloc = {"cpu": cpu, "memory": memory, "pods": pods, "ephemeral-storage": "100Gi"}
+    if extra_resources:
+        alloc.update(extra_resources)
+    node = {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {
+            "name": name,
+            "labels": {"kubernetes.io/hostname": name, **(labels or {})},
+            "annotations": annotations or {},
+        },
+        "spec": {},
+        "status": {"allocatable": copy.deepcopy(alloc), "capacity": copy.deepcopy(alloc)},
+    }
+    if taints:
+        node["spec"]["taints"] = taints
+    if unschedulable:
+        node["spec"]["unschedulable"] = True
+    return node
+
+
+def make_pod(
+    name: str,
+    namespace: str = "default",
+    cpu: str = "1",
+    memory: str = "1Gi",
+    labels: Optional[dict] = None,
+    node_name: Optional[str] = None,
+    node_selector: Optional[dict] = None,
+    tolerations: Optional[List[dict]] = None,
+    affinity: Optional[dict] = None,
+    host_ports: Optional[List[int]] = None,
+    annotations: Optional[dict] = None,
+    no_requests: bool = False,
+) -> dict:
+    container = {"name": "main", "image": "busybox"}
+    if not no_requests:
+        container["resources"] = {"requests": {"cpu": cpu, "memory": memory}}
+    if host_ports:
+        container["ports"] = [{"containerPort": p, "hostPort": p} for p in host_ports]
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": labels or {},
+            "annotations": annotations or {},
+        },
+        "spec": {"containers": [container]},
+    }
+    if node_name:
+        pod["spec"]["nodeName"] = node_name
+    if node_selector:
+        pod["spec"]["nodeSelector"] = node_selector
+    if tolerations:
+        pod["spec"]["tolerations"] = tolerations
+    if affinity:
+        pod["spec"]["affinity"] = affinity
+    return pod
+
+
+def _template(labels: dict, cpu: str, memory: str, **spec_extra) -> dict:
+    return {
+        "metadata": {"labels": labels},
+        "spec": {
+            "containers": [
+                {
+                    "name": "main",
+                    "image": "busybox",
+                    "resources": {"requests": {"cpu": cpu, "memory": memory}},
+                }
+            ],
+            **spec_extra,
+        },
+    }
+
+
+def make_deployment(
+    name: str,
+    replicas: int = 1,
+    namespace: str = "default",
+    cpu: str = "1",
+    memory: str = "1Gi",
+    labels: Optional[dict] = None,
+    **spec_extra,
+) -> dict:
+    labels = labels or {"app": name}
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": namespace, "labels": labels},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": labels},
+            "template": _template(labels, cpu, memory, **spec_extra),
+        },
+    }
+
+
+def make_statefulset(
+    name: str,
+    replicas: int = 1,
+    namespace: str = "default",
+    cpu: str = "1",
+    memory: str = "1Gi",
+    labels: Optional[dict] = None,
+    volume_claim_templates: Optional[List[dict]] = None,
+    **spec_extra,
+) -> dict:
+    labels = labels or {"app": name}
+    sts = {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {"name": name, "namespace": namespace, "labels": labels},
+        "spec": {
+            "replicas": replicas,
+            "serviceName": name,
+            "selector": {"matchLabels": labels},
+            "template": _template(labels, cpu, memory, **spec_extra),
+        },
+    }
+    if volume_claim_templates:
+        sts["spec"]["volumeClaimTemplates"] = volume_claim_templates
+    return sts
+
+
+def make_daemonset(
+    name: str,
+    namespace: str = "default",
+    cpu: str = "500m",
+    memory: str = "512Mi",
+    labels: Optional[dict] = None,
+    **spec_extra,
+) -> dict:
+    labels = labels or {"app": name}
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {"name": name, "namespace": namespace, "labels": labels},
+        "spec": {
+            "selector": {"matchLabels": labels},
+            "template": _template(labels, cpu, memory, **spec_extra),
+        },
+    }
+
+
+def make_job(
+    name: str, completions: int = 1, namespace: str = "default", cpu: str = "100m", memory: str = "100Mi"
+) -> dict:
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "completions": completions,
+            "template": {
+                "metadata": {"labels": {"job-name": name}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "main",
+                            "image": "busybox",
+                            "resources": {"requests": {"cpu": cpu, "memory": memory}},
+                        }
+                    ],
+                    "restartPolicy": "Never",
+                },
+            },
+        },
+    }
+
+
+def make_replicaset(
+    name: str, replicas: int = 1, namespace: str = "default", cpu: str = "100m", memory: str = "128Mi",
+    labels: Optional[dict] = None, **spec_extra,
+) -> dict:
+    labels = labels or {"app": name}
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "ReplicaSet",
+        "metadata": {"name": name, "namespace": namespace, "labels": labels},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": labels},
+            "template": _template(labels, cpu, memory, **spec_extra),
+        },
+    }
+
+
+def make_cronjob(
+    name: str, namespace: str = "default", cpu: str = "100m", memory: str = "100Mi", completions: int = 1
+) -> dict:
+    return {
+        "apiVersion": "batch/v1beta1",
+        "kind": "CronJob",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "schedule": "*/5 * * * *",
+            "jobTemplate": {
+                "spec": {
+                    "completions": completions,
+                    "template": {
+                        "metadata": {"labels": {"cron": name}},
+                        "spec": {
+                            "containers": [
+                                {
+                                    "name": "main",
+                                    "image": "busybox",
+                                    "resources": {"requests": {"cpu": cpu, "memory": memory}},
+                                }
+                            ],
+                            "restartPolicy": "Never",
+                        },
+                    },
+                }
+            },
+        },
+    }
+
+
+def master_taint() -> dict:
+    return {"key": "node-role.kubernetes.io/master", "effect": "NoSchedule"}
+
+
+def master_toleration() -> dict:
+    return {"key": "node-role.kubernetes.io/master", "operator": "Exists", "effect": "NoSchedule"}

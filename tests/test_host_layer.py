@@ -1,0 +1,251 @@
+"""Unit tests for the host (string-world) layer: quantities, YAML IO, matchers,
+workload expansion. Reference behaviors cited per test."""
+
+import json
+
+import pytest
+
+from fixtures import (
+    make_cronjob,
+    make_daemonset,
+    make_deployment,
+    make_job,
+    make_node,
+    make_pod,
+    make_replicaset,
+    make_statefulset,
+    master_taint,
+)
+from open_simulator_tpu.core import constants as C
+from open_simulator_tpu.models import workloads as W
+from open_simulator_tpu.utils import objutil as O
+from open_simulator_tpu.utils.quantity import format_quantity, parse_milli, parse_quantity
+from open_simulator_tpu.utils.validate import ValidationError, validate_pod
+from open_simulator_tpu.utils.yamlio import bucket_objects, decode_yaml_content
+
+
+class TestQuantity:
+    def test_plain_and_suffixes(self):
+        assert parse_quantity("4") == 4
+        assert parse_quantity("1500m") == 1.5
+        assert parse_quantity("128Mi") == 128 * 1024**2
+        assert parse_quantity("16Gi") == 16 * 1024**3
+        assert parse_quantity("61255492Ki") == 61255492 * 1024
+        assert parse_quantity("2k") == 2000
+        assert parse_quantity("1e3") == 1000
+        assert parse_quantity("0.5") == 0.5
+        assert parse_quantity(2) == 2
+
+    def test_milli(self):
+        assert parse_milli("1500m") == 1500
+        assert parse_milli("2") == 2000
+        assert parse_milli("0.1") == 100
+        assert parse_milli("100m") == 100
+
+    def test_format(self):
+        assert format_quantity(0) == "0"
+        assert format_quantity(1.5) == "1500m"
+        assert format_quantity(4) == "4"
+
+
+class TestMatchers:
+    def test_label_selector(self):
+        sel = {"matchLabels": {"app": "x"}, "matchExpressions": [{"key": "tier", "operator": "In", "values": ["fe"]}]}
+        assert O.match_label_selector(sel, {"app": "x", "tier": "fe"})
+        assert not O.match_label_selector(sel, {"app": "x", "tier": "be"})
+        assert not O.match_label_selector(None, {"app": "x"})
+        assert O.match_label_selector({}, {"anything": "goes"})  # empty selector matches all
+
+    def test_expression_operators(self):
+        labels = {"a": "1", "b": "5"}
+        assert O.match_expression(labels, {"key": "a", "operator": "Exists"})
+        assert not O.match_expression(labels, {"key": "z", "operator": "Exists"})
+        assert O.match_expression(labels, {"key": "z", "operator": "DoesNotExist"})
+        assert O.match_expression(labels, {"key": "b", "operator": "Gt", "values": ["4"]})
+        assert not O.match_expression(labels, {"key": "b", "operator": "Lt", "values": ["4"]})
+        assert O.match_expression(labels, {"key": "a", "operator": "NotIn", "values": ["2"]})
+
+    def test_node_affinity_and_selector(self):
+        node = make_node("n1", labels={"disk": "ssd"})
+        pod = make_pod("p", node_selector={"disk": "ssd"})
+        assert O.pod_matches_node_affinity(pod, node)
+        pod2 = make_pod("p2", node_selector={"disk": "hdd"})
+        assert not O.pod_matches_node_affinity(pod2, node)
+        aff = {
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [
+                        {"matchExpressions": [{"key": "disk", "operator": "In", "values": ["ssd"]}]}
+                    ]
+                }
+            }
+        }
+        assert O.pod_matches_node_affinity(make_pod("p3", affinity=aff), node)
+
+    def test_match_fields(self):
+        node = make_node("worker-1")
+        term = {"matchFields": [{"key": "metadata.name", "operator": "In", "values": ["worker-1"]}]}
+        assert O.match_node_selector_term(node, term)
+        term2 = {"matchFields": [{"key": "metadata.name", "operator": "In", "values": ["worker-2"]}]}
+        assert not O.match_node_selector_term(node, term2)
+
+    def test_taints(self):
+        node = make_node("m", taints=[master_taint()])
+        pod = make_pod("p")
+        assert O.find_untolerated_taint(node, pod, ("NoSchedule", "NoExecute")) is not None
+        tol = {"key": "node-role.kubernetes.io/master", "operator": "Exists", "effect": "NoSchedule"}
+        pod_t = make_pod("p2", tolerations=[tol])
+        assert O.find_untolerated_taint(node, pod_t, ("NoSchedule", "NoExecute")) is None
+        # empty-key Exists toleration tolerates everything
+        pod_all = make_pod("p3", tolerations=[{"operator": "Exists"}])
+        assert O.find_untolerated_taint(node, pod_all, ("NoSchedule", "NoExecute")) is None
+
+    def test_pod_requests_max_of_init(self):
+        pod = make_pod("p", cpu="1", memory="1Gi")
+        pod["spec"]["initContainers"] = [
+            {"name": "init", "image": "busybox", "resources": {"requests": {"cpu": "3", "memory": "256Mi"}}}
+        ]
+        req = O.pod_resource_requests(pod)
+        assert req["cpu"] == 3000  # init dominates cpu (milli)
+        assert req["memory"] == 1024**3  # containers dominate memory
+
+    def test_host_ports_hostnetwork(self):
+        pod = make_pod("p")
+        pod["spec"]["hostNetwork"] = True
+        pod["spec"]["containers"][0]["ports"] = [{"containerPort": 8080}]
+        assert O.pod_host_ports(pod) == [("TCP", "0.0.0.0", 8080)]
+
+
+class TestYamlIO:
+    def test_multidoc_and_bucket(self):
+        content = """
+apiVersion: v1
+kind: Node
+metadata: {name: n1}
+---
+apiVersion: apps/v1
+kind: Deployment
+metadata: {name: d1}
+"""
+        rt = bucket_objects(decode_yaml_content([content]))
+        assert len(rt.nodes) == 1 and len(rt.deployments) == 1
+
+    def test_unknown_kind(self):
+        from open_simulator_tpu.utils.yamlio import UnknownKindError
+
+        with pytest.raises(UnknownKindError):
+            bucket_objects([{"kind": "Gizmo"}])
+
+
+class TestWorkloadExpansion:
+    def test_deployment(self):
+        pods = W.pods_from_deployment(make_deployment("web", replicas=3))
+        assert len(pods) == 3
+        for p in pods:
+            assert p["metadata"]["name"].startswith("web-")
+            assert O.annotations_of(p)[C.AnnoWorkloadKind] == "ReplicaSet"  # via synthetic RS
+            assert p["spec"]["schedulerName"] == C.DefaultSchedulerName
+        assert len({p["metadata"]["name"] for p in pods}) == 3
+
+    def test_statefulset_ordinals_and_storage(self):
+        vct = [
+            {
+                "metadata": {"name": "data"},
+                "spec": {
+                    "storageClassName": "open-local-lvm",
+                    "resources": {"requests": {"storage": "10Gi"}},
+                },
+            }
+        ]
+        pods = W.pods_from_statefulset(make_statefulset("db", replicas=2, volume_claim_templates=vct))
+        assert [p["metadata"]["name"] for p in pods] == ["db-0", "db-1"]
+        vols = json.loads(O.annotations_of(pods[0])[C.AnnoPodLocalStorage])
+        assert vols["volumes"][0]["kind"] == "LVM"
+        assert vols["volumes"][0]["size"] == str(10 * 1024**3)
+
+    def test_job_and_cronjob(self):
+        assert len(W.pods_from_job(make_job("pi", completions=4))) == 4
+        assert len(W.pods_from_cronjob(make_cronjob("cron", completions=2))) == 2
+
+    def test_replicaset_default_one(self):
+        rs = make_replicaset("rs")
+        del rs["spec"]["replicas"]
+        assert len(W.pods_from_replicaset(rs)) == 1
+
+    def test_daemonset_skips_tainted_and_pins(self):
+        nodes = [
+            make_node("w1"),
+            make_node("w2"),
+            make_node("m1", taints=[master_taint()]),
+        ]
+        pods = W.pods_from_daemonset(make_daemonset("agent"), nodes)
+        assert len(pods) == 2  # master skipped: taint untolerated
+        terms = pods[0]["spec"]["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        ]["nodeSelectorTerms"]
+        assert terms[0]["matchFields"][0]["values"] == ["w1"]
+
+    def test_daemonset_merges_affinity_terms(self):
+        ds = make_daemonset(
+            "agent",
+            affinity={
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [
+                            {
+                                "matchExpressions": [
+                                    {"key": "node-role.kubernetes.io/master", "operator": "DoesNotExist"}
+                                ]
+                            }
+                        ]
+                    }
+                }
+            },
+        )
+        nodes = [make_node("w1"), make_node("m1", labels={"node-role.kubernetes.io/master": ""})]
+        pods = W.pods_from_daemonset(ds, nodes)
+        # master excluded by the preserved matchExpressions, not by taints
+        assert len(pods) == 1
+        term = pods[0]["spec"]["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        ]["nodeSelectorTerms"][0]
+        assert "matchExpressions" in term and "matchFields" in term
+
+    def test_make_valid_pod_sanitizes(self):
+        pod = make_pod("p")
+        pod["spec"]["containers"][0]["env"] = [{"name": "A", "value": "b"}]
+        pod["spec"]["containers"][0]["livenessProbe"] = {"exec": {"command": ["true"]}}
+        pod["spec"]["volumes"] = [{"name": "v", "persistentVolumeClaim": {"claimName": "c"}}]
+        out = W.make_valid_pod(pod)
+        c = out["spec"]["containers"][0]
+        assert "env" not in c and "livenessProbe" not in c
+        assert out["spec"]["volumes"][0]["hostPath"]["path"] == "/tmp"
+        assert out["spec"]["dnsPolicy"] == "ClusterFirst"
+
+    def test_validation_rejects_bad_pod(self):
+        with pytest.raises(ValidationError):
+            validate_pod({"metadata": {"name": "UPPER_bad"}, "spec": {"containers": [{"name": "c", "image": "i"}]}})
+        with pytest.raises(ValidationError):
+            validate_pod({"metadata": {"name": "ok"}, "spec": {"containers": []}})
+
+    def test_fake_nodes(self):
+        nodes = W.new_fake_nodes(make_node("template"), 3)
+        assert len(nodes) == 3
+        for n in nodes:
+            assert n["metadata"]["name"].startswith("simon-")
+            assert O.labels_of(n)[C.LabelNewNode] == "true"
+            assert O.labels_of(n)[C.LabelHostname] == n["metadata"]["name"]
+        assert len({n["metadata"]["name"] for n in nodes}) == 3
+
+    def test_expand_app(self):
+        from open_simulator_tpu.core.types import ResourceTypes
+
+        rt = ResourceTypes(
+            deployments=[make_deployment("d", replicas=2)],
+            daemon_sets=[make_daemonset("ds")],
+            jobs=[make_job("j", completions=1)],
+        )
+        nodes = [make_node("n1"), make_node("n2")]
+        pods = W.generate_valid_pods_from_app("myapp", rt, nodes)
+        assert len(pods) == 2 + 2 + 1
+        assert all(O.labels_of(p)[C.LabelAppName] == "myapp" for p in pods)
