@@ -1,0 +1,21 @@
+"""tpu-simon: a TPU-native Kubernetes cluster simulator.
+
+Same capabilities as alibaba/open-simulator — fake cluster from YAML/kubeconfig,
+controller simulation, full kube-scheduler placement semantics, capacity planning,
+GPU-share / local-storage extended resources — with a batched JAX/XLA scheduling core.
+"""
+
+from .core.types import AppResource, NodeStatus, ResourceTypes, SimulateResult, UnscheduledPod
+from .simulator.core import simulate
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AppResource",
+    "NodeStatus",
+    "ResourceTypes",
+    "SimulateResult",
+    "UnscheduledPod",
+    "simulate",
+    "__version__",
+]

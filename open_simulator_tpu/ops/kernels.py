@@ -1,0 +1,346 @@
+"""Device kernels: the batched scheduling engine.
+
+One `lax.scan` step = one scheduleOne cycle of the vendored scheduler
+(scheduler.go:441): filter every node in parallel, score the feasible ones with the
+v1.20 default plugin set + the Simon bin-packing plugin, pick the winner, commit
+capacity/counter updates into the carry. The serial pod order of the reference
+(pkg/simulator/simulator.go:309-348 schedules one pod per channel handshake) is preserved
+exactly — but each step is a fused [N]-wide tensor program on the accelerator instead of
+a goroutine round-trip, and whole apps run as one compiled scan.
+
+Plugin parity notes (all semantics cross-checked against the vendored sources):
+- Filters: NodeResourcesFit, NodePorts (node_ports.go), NodeUnschedulable/TaintToleration/
+  NodeAffinity/NodeName (pre-folded into the static group mask by the encoder),
+  InterPodAffinity incl. the bootstrap special case and the existing-pods anti-affinity
+  direction (filtering.go:226-280), PodTopologySpread DoNotSchedule with critical-path
+  min over eligible domains (filtering.go:200-241).
+- Scores (weights from algorithmprovider/registry.go:118-137 + SelectorSpread appended by
+  applyFeatureGates:161-171): LeastAllocated(1), BalancedAllocation(1), ImageLocality(1),
+  InterPodAffinity(1), NodeAffinity(1), NodePreferAvoidPods(10000), PodTopologySpread(2),
+  TaintToleration(1), SelectorSpread(1), and Simon(1) with its min-max NormalizeScore
+  (plugin/simon.go:76-101). Integer truncation points and the zero-initialized min/max
+  quirks of the upstream normalizers are reproduced with explicit floors.
+- selectHost tie-break: upstream picks uniformly at random among max-score nodes
+  (generic_scheduler.go:188); we deterministically pick the lowest node index. This is
+  the one intentional divergence (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .resources import CPU_I, MEM_I
+
+# Score weights (registry.go:118-137; Simon/OpenLocal/GpuShare default to weight 1 via
+# the framework's zero→1 rule for enabled score plugins).
+W_LEAST = 1.0
+W_BALANCED = 1.0
+W_IMAGE = 1.0
+W_INTERPOD = 1.0
+W_NODEAFF = 1.0
+W_AVOID = 10000.0
+W_PTS = 2.0
+W_TAINT = 1.0
+W_SS = 1.0
+W_SIMON = 1.0
+
+_F32 = jnp.float32
+
+
+class Tables(NamedTuple):
+    """Scan-invariant device tables (see encode.BatchTables for field docs)."""
+
+    alloc: jax.Array
+    node_zone: jax.Array
+    static_mask: jax.Array
+    mask_taint: jax.Array
+    mask_unsched: jax.Array
+    mask_aff: jax.Array
+    simon_raw: jax.Array
+    nodeaff_raw: jax.Array
+    taint_raw: jax.Array
+    avoid_raw: jax.Array
+    image_raw: jax.Array
+    grp_requests: jax.Array
+    grp_nonzero: jax.Array
+    grp_unknown: jax.Array
+    grp_ports: jax.Array
+    counter_dom: jax.Array
+    counter_sel_match_g: jax.Array
+    req_aff_t: jax.Array
+    grp_aff_self: jax.Array
+    req_anti_t: jax.Array
+    pref_t: jax.Array
+    pref_w: jax.Array
+    dns_t: jax.Array
+    dns_maxskew: jax.Array
+    dns_self: jax.Array
+    dns_edom: jax.Array
+    sa_t: jax.Array
+    sa_maxskew: jax.Array
+    sa_self: jax.Array
+    ss_t: jax.Array
+    ss_skip: jax.Array
+    carr_dom: jax.Array
+    carr_use_anti: jax.Array
+    carr_hard_w: jax.Array
+    carr_pref_w: jax.Array
+    carr_sel_match_g: jax.Array
+    grp_carries: jax.Array
+
+
+class Carry(NamedTuple):
+    """Mutable cluster state threaded through the scan."""
+
+    requested: jax.Array    # [N, R] f32
+    nonzero: jax.Array      # [N, 2] f32
+    port_used: jax.Array    # [N, PORT+1] bool
+    counter: jax.Array      # [T, D+1] f32
+    carrier: jax.Array      # [Tc, D+1] f32
+
+
+def _flr(x):
+    return jnp.floor(x)
+
+
+def feasibility(tb: Tables, cry: Carry, g, forced, valid) -> Tuple[jax.Array, dict]:
+    """[N] feasibility mask for one pod, plus named per-stage masks for diagnostics."""
+    N = tb.alloc.shape[0]
+    D = cry.counter.shape[1] - 1
+
+    req = tb.grp_requests[g]
+    smask = tb.static_mask[g]
+
+    # NodeResourcesFit (noderesources/fit.go): only requested resources are checked.
+    eps = tb.alloc * 1e-6  # absorb f32 accumulation noise; never enough to overcommit
+    new_req = cry.requested + req[None, :]
+    fit_each = (new_req <= tb.alloc + eps) | (req[None, :] == 0)
+    fit = jnp.all(fit_each, axis=1) & ~tb.grp_unknown[g]
+
+    # NodePorts
+    pids = tb.grp_ports[g]
+    conflict = jnp.any(cry.port_used[:, pids] & (pids > 0)[None, :], axis=1)
+
+    # counter gathers shared by inter-pod affinity and topology spread
+    cnt_at = jnp.take_along_axis(cry.counter, tb.counter_dom, axis=1)      # [T, N]
+    key_present = tb.counter_dom < D
+    totals = jnp.sum(cry.counter[:, :D], axis=1)                           # [T]
+
+    # InterPodAffinity: required affinity (filtering.go satisfyPodAffinity)
+    aff_ids = tb.req_aff_t[g]
+    avalid = aff_ids >= 0
+    aids = jnp.maximum(aff_ids, 0)
+    sat = (key_present[aids] & (cnt_at[aids] > 0)) | ~avalid[:, None]
+    aff_all = jnp.all(sat, axis=0)
+    has_aff = jnp.any(avalid)
+    total_aff = jnp.sum(jnp.where(avalid, totals[aids], 0.0))
+    bootstrap = has_aff & (total_aff == 0.0) & tb.grp_aff_self[g]
+    aff_ok = jnp.where(bootstrap, jnp.ones_like(aff_all), aff_all)
+
+    # incoming required anti-affinity (satisfyPodAntiAffinity)
+    anti_ids = tb.req_anti_t[g]
+    bvalid = anti_ids >= 0
+    bids = jnp.maximum(anti_ids, 0)
+    blocked_in = jnp.any((cnt_at[bids] > 0) & bvalid[:, None], axis=0)
+
+    # existing pods' required anti-affinity (satisfyExistingPodsAntiAffinity)
+    carr_at = jnp.take_along_axis(cry.carrier, tb.carr_dom, axis=1)        # [Tc, N]
+    relevant = tb.carr_use_anti & tb.carr_sel_match_g[:, g]
+    blocked_ex = jnp.any((carr_at > 0) & relevant[:, None], axis=0)
+
+    # PodTopologySpread DoNotSchedule (filtering.go Filter)
+    dns_ids = tb.dns_t[g]
+    dvalid = dns_ids >= 0
+    dids = jnp.maximum(dns_ids, 0)
+    edom = tb.dns_edom[g]                                                  # [Sd, D+1]
+    cdom = cry.counter[dids]
+    min_cnt = jnp.min(jnp.where(edom, cdom, jnp.inf), axis=1)
+    min_cnt = jnp.where(jnp.isfinite(min_cnt), min_cnt, 0.0)
+    skew = cnt_at[dids] + tb.dns_self[g][:, None] - min_cnt[:, None]
+    dns_ok_each = key_present[dids] & (skew <= tb.dns_maxskew[g][:, None])
+    dns_ok = jnp.all(dns_ok_each | ~dvalid[:, None], axis=0)
+
+    feasible = smask & fit & ~conflict & aff_ok & ~blocked_in & ~blocked_ex & dns_ok
+    feasible &= valid
+    iota = jnp.arange(N)
+    feasible = jnp.where(forced >= 0, feasible & (iota == forced), feasible)
+
+    stages = {
+        "static": smask,
+        "taint": tb.mask_taint[g],
+        "unsched": tb.mask_unsched[g],
+        "affinity": tb.mask_aff[g],
+        "fit": fit,
+        "fit_each": fit_each,
+        "ports": ~conflict,
+        "pod_affinity": aff_ok,
+        "pod_anti": ~(blocked_in | blocked_ex),
+        "spread": dns_ok,
+    }
+    return feasible, stages
+
+
+def scores(tb: Tables, cry: Carry, g, feasible, n_zones: int) -> jax.Array:
+    """Weighted sum of all normalized plugin scores over the feasible set ([N] f32)."""
+    F = feasible
+    alloc_cm = tb.alloc[:, (CPU_I, MEM_I)]
+    used = cry.nonzero + tb.grp_nonzero[g][None, :]
+
+    # NodeResourcesLeastAllocated (least_allocated.go:93-115), integer divisions floored
+    def least_one(u, a):
+        return jnp.where((a > 0) & (u <= a), _flr((a - u) * 100.0 / a), 0.0)
+
+    least = _flr((least_one(used[:, 0], alloc_cm[:, 0]) + least_one(used[:, 1], alloc_cm[:, 1])) / 2.0)
+
+    # NodeResourcesBalancedAllocation (balanced_allocation.go:96-120)
+    cf = jnp.where(alloc_cm[:, 0] > 0, used[:, 0] / alloc_cm[:, 0], 1.0)
+    mf = jnp.where(alloc_cm[:, 1] > 0, used[:, 1] / alloc_cm[:, 1], 1.0)
+    balanced = jnp.where((cf >= 1.0) | (mf >= 1.0), 0.0, _flr((1.0 - jnp.abs(cf - mf)) * 100.0))
+
+    # Simon max-share + min-max normalize (plugin/simon.go:45-101)
+    simon_s = _flr(100.0 * tb.simon_raw[g])
+    hi = jnp.max(jnp.where(F, simon_s, -jnp.inf))
+    lo = jnp.min(jnp.where(F, simon_s, jnp.inf))
+    rng = hi - lo
+    simon = jnp.where((rng > 0) & jnp.isfinite(rng), _flr((simon_s - lo) * 100.0 / rng), 0.0)
+
+    # NodeAffinity preferred (helper.DefaultNormalizeScore, reverse=false)
+    na_raw = tb.nodeaff_raw[g]
+    na_max = jnp.maximum(jnp.max(jnp.where(F, na_raw, -jnp.inf)), 0.0)
+    nodeaff = jnp.where(na_max > 0, _flr(na_raw * 100.0 / na_max), 0.0)
+
+    # TaintToleration (DefaultNormalizeScore reverse=true: all-100 when max==0)
+    t_raw = tb.taint_raw[g]
+    t_max = jnp.maximum(jnp.max(jnp.where(F, t_raw, -jnp.inf)), 0.0)
+    taint = jnp.where(t_max > 0, 100.0 - _flr(t_raw * 100.0 / t_max), 100.0)
+
+    # InterPodAffinity score (scoring.go): incoming preferred terms + existing pods'
+    # required (HardPodAffinityWeight=1) and preferred terms; zero-initialized min/max.
+    cnt_at = jnp.take_along_axis(cry.counter, tb.counter_dom, axis=1)
+    carr_at = jnp.take_along_axis(cry.carrier, tb.carr_dom, axis=1)
+    pref_ids = tb.pref_t[g]
+    pvalid = pref_ids >= 0
+    pidx = jnp.maximum(pref_ids, 0)
+    w = tb.pref_w[g]
+    ip_raw = jnp.sum(jnp.where(pvalid[:, None], w[:, None] * cnt_at[pidx], 0.0), axis=0)
+    carr_w = (tb.carr_hard_w + tb.carr_pref_w) * tb.carr_sel_match_g[:, g]
+    ip_raw = ip_raw + jnp.sum(carr_w[:, None] * carr_at, axis=0)
+    ip_max = jnp.maximum(jnp.max(jnp.where(F, ip_raw, -jnp.inf)), 0.0)
+    ip_min = jnp.minimum(jnp.min(jnp.where(F, ip_raw, jnp.inf)), 0.0)
+    ip_rng = ip_max - ip_min
+    interpod = jnp.where(ip_rng > 0, _flr(100.0 * (ip_raw - ip_min) / ip_rng), 0.0)
+
+    # SelectorSpread (selector_spread.go:104-160): per-node count + 2/3 zone blending
+    ss_id = tb.ss_t[g]
+    has_ss = ss_id >= 0
+    pernode = cnt_at[jnp.maximum(ss_id, 0)]
+    maxN = jnp.maximum(jnp.max(jnp.where(F, pernode, -jnp.inf)), 0.0)
+    node_score = jnp.where(maxN > 0, 100.0 * (maxN - pernode) / maxN, 100.0)
+    # zone sums over feasible nodes only (NormalizeScore iterates scored nodes)
+    nz_count = jnp.where(F, pernode, 0.0)
+    zones = tb.node_zone
+    zone_sums = jnp.zeros((max(2, n_zones),), _F32).at[zones].add(nz_count)
+    maxZ = jnp.max(zone_sums.at[0].set(0.0))
+    have_zones = jnp.any(F & (zones > 0))
+    zscore = jnp.where(maxZ > 0, 100.0 * (maxZ - zone_sums[zones]) / maxZ, 100.0)
+    blended = jnp.where(have_zones & (zones > 0),
+                        node_score * (1.0 / 3.0) + zscore * (2.0 / 3.0), node_score)
+    selector_spread = jnp.where(
+        tb.ss_skip[g], 0.0, jnp.where(has_ss, _flr(blended), 100.0)
+    )
+
+    # PodTopologySpread ScheduleAnyway scoring (scoring.go:108-200)
+    D = cry.counter.shape[1] - 1
+    sa_ids = tb.sa_t[g]
+    svalid = sa_ids >= 0
+    sidx = jnp.maximum(sa_ids, 0)
+    key_present = tb.counter_dom < D
+    ignored = jnp.any(svalid[:, None] & ~key_present[sidx], axis=0)
+    relevantF = F & ~ignored
+    Ss = sa_ids.shape[0]
+    dom_rows = tb.counter_dom[sidx]                                        # [Ss, N]
+    marks = jnp.zeros((Ss, D + 1), _F32).at[
+        jnp.arange(Ss)[:, None], dom_rows
+    ].max(jnp.broadcast_to(relevantF.astype(_F32), dom_rows.shape))
+    topo_size = jnp.sum(marks[:, :D], axis=1)
+    tpw = jnp.log(topo_size + 2.0)
+    contrib = cnt_at[sidx] * tpw[:, None] + (tb.sa_maxskew[g][:, None] - 1.0)
+    sa_raw = _flr(jnp.sum(jnp.where(svalid[:, None], contrib, 0.0), axis=0))
+    sa_max = jnp.maximum(jnp.max(jnp.where(relevantF, sa_raw, -jnp.inf)), 0.0)
+    sa_min_raw = jnp.min(jnp.where(relevantF, sa_raw, jnp.inf))
+    sa_min = jnp.where(jnp.isfinite(sa_min_raw), sa_min_raw, 0.0)
+    pts = jnp.where(
+        ~relevantF,
+        0.0,
+        jnp.where(sa_max > 0, _flr((sa_max + sa_min - sa_raw) * 100.0 / sa_max), 100.0),
+    )
+
+    total = (
+        W_LEAST * least
+        + W_BALANCED * balanced
+        + W_SIMON * simon
+        + W_NODEAFF * nodeaff
+        + W_TAINT * taint
+        + W_INTERPOD * interpod
+        + W_SS * selector_spread
+        + W_PTS * pts
+        + W_AVOID * tb.avoid_raw[g]
+        + W_IMAGE * tb.image_raw[g]
+    )
+    return total
+
+
+def commit(tb: Tables, cry: Carry, g, choice, do) -> Carry:
+    """Apply one placement to the carry (the Reserve+Bind of the cycle)."""
+    T = cry.counter.shape[0]
+    Tc = cry.carrier.shape[0]
+    D = cry.counter.shape[1] - 1
+    c = jnp.maximum(choice, 0)
+    dof = do.astype(_F32)
+
+    requested = cry.requested.at[c].add(tb.grp_requests[g] * dof)
+    nonzero = cry.nonzero.at[c].add(tb.grp_nonzero[g] * dof)
+    pids = tb.grp_ports[g]
+    port_used = cry.port_used.at[c, pids].max((pids > 0) & do)
+
+    dom_col = tb.counter_dom[:, c]
+    inc = tb.counter_sel_match_g[:, g].astype(_F32) * (dom_col < D) * dof
+    counter = cry.counter.at[jnp.arange(T), dom_col].add(inc)
+
+    cdom_col = tb.carr_dom[:, c]
+    cinc = tb.grp_carries[g] * (cdom_col < D) * dof
+    carrier = cry.carrier.at[jnp.arange(Tc), cdom_col].add(cinc)
+
+    return Carry(requested, nonzero, port_used, counter, carrier)
+
+
+def _step(tb: Tables, cry: Carry, xs, n_zones: int):
+    g, forced, valid = xs
+    feasible, _ = feasibility(tb, cry, g, forced, valid)
+    any_f = jnp.any(feasible)
+    sc = scores(tb, cry, g, feasible, n_zones)
+    masked = jnp.where(feasible, sc, -jnp.inf)
+    choice = jnp.argmax(masked).astype(jnp.int32)  # first max → lowest node index
+    choice = jnp.where(any_f, choice, jnp.int32(-1))
+    new_cry = commit(tb, cry, g, choice, any_f)
+    return new_cry, choice
+
+
+# Module-level jit so repeated diagnostic calls hit the compile cache.
+feasibility_jit = jax.jit(feasibility)
+
+
+@partial(jax.jit, static_argnames=("n_zones",))
+def schedule_batch(tb: Tables, cry: Carry, pod_group, forced_node, valid, n_zones: int):
+    """Scan the whole batch; returns (final carry, placements[P] int32, -1=unschedulable)."""
+
+    def body(c, xs):
+        return _step(tb, c, xs, n_zones)
+
+    final, choices = jax.lax.scan(body, cry, (pod_group, forced_node, valid))
+    return final, choices

@@ -1,0 +1,921 @@
+"""Host-side tensorization: k8s objects → dense tables for the batched TPU scheduler.
+
+This is the string-world ↔ tensor-world boundary (SURVEY.md §7). Everything the vendored
+scheduler derives from strings — label selectors, affinity terms, taints, topology
+domains, host ports — is interned and pre-evaluated here into numpy tables; the device
+kernels (`open_simulator_tpu.ops.kernels`) see only integers and floats.
+
+Key ideas:
+- **Groups**: pods sharing (namespace, labels, scheduling-relevant spec) — i.e. replicas
+  of one workload — share one row of every per-pod table. Static node predicates
+  (unschedulable, taints, nodeSelector, required node affinity) and static score inputs
+  (Simon max-share, preferred-node-affinity weights, PreferNoSchedule taint counts) are
+  evaluated once per group as `[N]` vectors.
+- **Counters**: every pairwise pod relation (inter-pod affinity/anti-affinity terms,
+  topology-spread constraints, selector-spread) reduces to "number of placed pods
+  matching selector S in topology domain d". Distinct (topologyKey, namespaces,
+  selector) triples become counter rows; the device carry holds `counter_count [T, D+1]`
+  (last column = sentinel for nodes missing the topology key, always zero).
+- **Carriers**: the reverse direction — "placed pods *carrying* term t in domain d" —
+  for existing-pod anti-affinity (interpodaffinity filtering.go
+  satisfyExistingPodsAntiAffinity) and existing-pod preferred/required terms in scoring
+  (scoring.go processExistingPod).
+
+DaemonSet pods pinned via matchFields metadata.name affinity are detected and encoded as
+`forced_node` so that N pinned pods don't explode the group count.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import constants as C
+from ..ops.resources import (
+    CPU_I,
+    MEM_I,
+    PODS_I,
+    ResourceAxis,
+    pod_has_unknown_resource,
+    pod_nonzero_cpu_mem,
+)
+from ..utils.interning import StringTable
+from ..utils.objutil import (
+    annotations_of,
+    labels_of,
+    match_label_selector,
+    name_of,
+    namespace_of,
+    pod_host_ports,
+    pod_resource_requests,
+    toleration_tolerates_taint,
+)
+from ..utils.quantity import parse_quantity
+
+# ----------------------------------------------------------------- node arrays --------
+
+_UNSCHED_TAINT = {"key": C.TaintNodeUnschedulable, "effect": "NoSchedule"}
+
+
+class NodeArrays:
+    """Vectorized view of the node list: per-label-key interned value columns, taints,
+    allocatable matrix, zone/domain interning."""
+
+    def __init__(self, nodes: List[dict], axis: ResourceAxis) -> None:
+        self.nodes = nodes
+        self.axis = axis
+        self.N = len(nodes)
+        self.names = [name_of(n) for n in nodes]
+        self.index = {nm: i for i, nm in enumerate(self.names)}
+        self.values = StringTable()  # shared value interner for labels & names
+
+        # label key → int32[N] of value ids (0 = key absent)
+        self.label_vals: Dict[str, np.ndarray] = {}
+        for i, node in enumerate(nodes):
+            for k, v in labels_of(node).items():
+                col = self.label_vals.get(k)
+                if col is None:
+                    col = self.label_vals[k] = np.zeros(self.N, np.int32)
+                col[i] = self.values.intern(str(v))
+        self.name_ids = np.array([self.values.intern(nm) for nm in self.names], np.int32)
+
+        self.taints: List[Tuple[tuple, ...]] = [
+            tuple(
+                (t.get("key", ""), t.get("value", "") or "", t.get("effect", ""))
+                for t in (n.get("spec") or {}).get("taints") or []
+            )
+            for n in nodes
+        ]
+        self.unschedulable = np.array(
+            [bool((n.get("spec") or {}).get("unschedulable")) for n in nodes], bool
+        )
+        self.alloc = np.stack([axis.node_vector(n) for n in nodes]) if nodes else np.zeros((0, axis.R))
+
+        # zone composite key (utilnode.GetZoneKey): region + zone, either label family
+        self.zones = StringTable()
+        zid = np.zeros(self.N, np.int32)
+        for i, node in enumerate(nodes):
+            lbl = labels_of(node)
+            region = lbl.get(C.LabelTopologyRegion) or lbl.get("failure-domain.beta.kubernetes.io/region") or ""
+            zone = lbl.get(C.LabelTopologyZone) or lbl.get(C.LabelTopologyZoneBeta) or ""
+            if region or zone:
+                zid[i] = self.zones.intern((region, zone))
+        self.zone_id = zid  # 0 = no zone
+
+        # topology domains: (topo key, node's value) interned globally
+        self.domains = StringTable()
+        self._dom_cache: Dict[str, np.ndarray] = {}
+
+    def label_numeric(self, key: str) -> np.ndarray:
+        out = np.full(self.N, np.nan)
+        col = self.label_vals.get(key)
+        if col is None:
+            return out
+        for i in range(self.N):
+            if col[i]:
+                try:
+                    out[i] = int(self.values.value(col[i]))
+                except (TypeError, ValueError):
+                    pass
+        return out
+
+    def domain_of(self, topo_key: str) -> np.ndarray:
+        """int32[N] domain index per node under topo_key; -1 where the key is absent.
+        (kubernetes.io/hostname always present per MakeValidNode → per-node domains.)"""
+        cached = self._dom_cache.get(topo_key)
+        if cached is not None:
+            return cached
+        col = self.label_vals.get(topo_key)
+        out = np.full(self.N, -1, np.int32)
+        if col is not None:
+            for i in range(self.N):
+                if col[i]:
+                    out[i] = self.domains.intern((topo_key, int(col[i])))
+        self._dom_cache[topo_key] = out
+        return out
+
+    @property
+    def D(self) -> int:
+        return len(self.domains)
+
+
+# ----------------------------------------------------- vectorized node matchers -------
+
+
+def _expr_vec(na: NodeArrays, expr: dict) -> np.ndarray:
+    """NodeSelectorRequirement over labels → bool[N] (objutil.match_expression, vectorized)."""
+    key, op = expr.get("key", ""), expr.get("operator", "In")
+    values = expr.get("values") or []
+    col = na.label_vals.get(key)
+    present = (col > 0) if col is not None else np.zeros(na.N, bool)
+    if op == "Exists":
+        return present
+    if op == "DoesNotExist":
+        return ~present
+    if op in ("Gt", "Lt"):
+        if len(values) != 1:
+            return np.zeros(na.N, bool)
+        try:
+            v = int(values[0])
+        except ValueError:
+            return np.zeros(na.N, bool)
+        num = na.label_numeric(key)
+        with np.errstate(invalid="ignore"):
+            return (num > v) if op == "Gt" else (num < v)
+    ids = np.array([na.values.lookup(v) for v in values], np.int32)
+    if col is None:
+        isin = np.zeros(na.N, bool)
+    else:
+        isin = np.isin(col, ids[ids > 0]) & present
+    return isin if op == "In" else ~isin  # NotIn: absent key also matches
+
+
+def _field_expr_vec(na: NodeArrays, expr: dict) -> np.ndarray:
+    if expr.get("key") != "metadata.name":
+        return np.zeros(na.N, bool)
+    ids = np.array([na.values.lookup(v) for v in expr.get("values") or []], np.int32)
+    isin = np.isin(na.name_ids, ids[ids > 0])
+    op = expr.get("operator", "In")
+    return isin if op == "In" else (~isin if op == "NotIn" else np.zeros(na.N, bool))
+
+
+def node_selector_term_vec(na: NodeArrays, term: dict) -> np.ndarray:
+    """One NodeSelectorTerm → bool[N]; empty term matches nothing (upstream semantics)."""
+    exprs = term.get("matchExpressions") or []
+    fields = term.get("matchFields") or []
+    if not exprs and not fields:
+        return np.zeros(na.N, bool)
+    m = np.ones(na.N, bool)
+    for e in exprs:
+        m &= _expr_vec(na, e)
+    for e in fields:
+        m &= _field_expr_vec(na, e)
+    return m
+
+
+def node_affinity_vec(na: NodeArrays, pod_spec: dict) -> np.ndarray:
+    """nodeSelector map AND requiredDuringScheduling node affinity → bool[N]."""
+    m = np.ones(na.N, bool)
+    for k, v in (pod_spec.get("nodeSelector") or {}).items():
+        col = na.label_vals.get(k)
+        want = na.values.lookup(str(v))
+        m &= (col == want) & (col > 0) if col is not None and want else np.zeros(na.N, bool)
+    required = ((pod_spec.get("affinity") or {}).get("nodeAffinity") or {}).get(
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    )
+    if required:
+        terms = required.get("nodeSelectorTerms") or []
+        om = np.zeros(na.N, bool)
+        for t in terms:
+            om |= node_selector_term_vec(na, t)
+        m &= om
+    return m
+
+
+def _taint_masks(na: NodeArrays, tolerations: List[dict]) -> Tuple[np.ndarray, np.ndarray]:
+    """(hard_ok[N], prefer_count[N]): NoSchedule/NoExecute all tolerated, and count of
+    untolerated PreferNoSchedule taints (TaintToleration filter + score inputs)."""
+    hard_ok = np.ones(na.N, bool)
+    prefer_cnt = np.zeros(na.N, np.float32)
+    # tolerations relevant to PreferNoSchedule scoring: effect empty or PreferNoSchedule
+    pref_tols = [t for t in tolerations if not t.get("effect") or t.get("effect") == "PreferNoSchedule"]
+    cache: Dict[tuple, Tuple[bool, int]] = {}
+    for i, taints in enumerate(na.taints):
+        if not taints:
+            continue
+        got = cache.get(taints)
+        if got is None:
+            ok = True
+            cnt = 0
+            for key, value, effect in taints:
+                taint = {"key": key, "value": value, "effect": effect}
+                if effect in ("NoSchedule", "NoExecute"):
+                    if not any(toleration_tolerates_taint(t, taint) for t in tolerations):
+                        ok = False
+                elif effect == "PreferNoSchedule":
+                    if not any(toleration_tolerates_taint(t, taint) for t in pref_tols):
+                        cnt += 1
+            got = cache[taints] = (ok, cnt)
+        hard_ok[i], prefer_cnt[i] = got
+    return hard_ok, prefer_cnt
+
+
+def _unschedulable_ok(na: NodeArrays, tolerations: List[dict]) -> np.ndarray:
+    """NodeUnschedulable plugin: spec.unschedulable blocked unless the pod tolerates the
+    node.kubernetes.io/unschedulable:NoSchedule taint."""
+    tolerates = any(toleration_tolerates_taint(t, _UNSCHED_TAINT) for t in tolerations)
+    return ~na.unschedulable | tolerates
+
+
+# ------------------------------------------------------------- terms & counters -------
+
+HOSTNAME = C.LabelHostname
+
+
+def _canon(obj) -> str:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+@dataclass(frozen=True)
+class CounterSpec:
+    """Count of placed pods matching (namespaces, selector) per domain of topo_key."""
+
+    topo_key: str
+    namespaces: frozenset
+    selector_canon: str
+
+    def selector(self) -> Optional[dict]:
+        return json.loads(self.selector_canon)
+
+    def matches_pod(self, pod: dict) -> bool:
+        if namespace_of(pod) not in self.namespaces:
+            return False
+        return match_label_selector(self.selector(), labels_of(pod))
+
+
+@dataclass(frozen=True)
+class CarrierSpec:
+    """A term carried by placed pods: (use, topo, namespaces, selector, weight)."""
+
+    use: str  # 'anti' (required anti-affinity), 'hard' (required affinity), 'pref'
+    topo_key: str
+    namespaces: frozenset
+    selector_canon: str
+    weight: float  # signed for 'pref'; 1 for anti/hard
+
+    def matches_pod(self, pod: dict) -> bool:
+        if namespace_of(pod) not in self.namespaces:
+            return False
+        return match_label_selector(json.loads(self.selector_canon), labels_of(pod))
+
+
+def _affinity_terms(pod: dict):
+    """Extract (required_aff, required_anti, preferred[(weight, term)]) raw term dicts."""
+    aff = (pod.get("spec") or {}).get("affinity") or {}
+    pa = aff.get("podAffinity") or {}
+    paa = aff.get("podAntiAffinity") or {}
+    req_aff = pa.get("requiredDuringSchedulingIgnoredDuringExecution") or []
+    req_anti = paa.get("requiredDuringSchedulingIgnoredDuringExecution") or []
+    pref = [(p.get("weight", 0), p.get("podAffinityTerm") or {}) for p in
+            pa.get("preferredDuringSchedulingIgnoredDuringExecution") or []]
+    pref += [(-p.get("weight", 0), p.get("podAffinityTerm") or {}) for p in
+             paa.get("preferredDuringSchedulingIgnoredDuringExecution") or []]
+    return req_aff, req_anti, pref
+
+
+def _term_namespaces(term: dict, pod: dict) -> frozenset:
+    ns = term.get("namespaces") or []
+    return frozenset(ns) if ns else frozenset([namespace_of(pod)])
+
+
+def _spread_constraints(pod: dict, when: str) -> List[dict]:
+    return [
+        c for c in (pod.get("spec") or {}).get("topologySpreadConstraints") or []
+        if c.get("whenUnsatisfiable", "DoNotSchedule") == when
+    ]
+
+
+def carried_specs_of_pod(pod: dict) -> List[CarrierSpec]:
+    """Carrier terms a pod contributes once placed (interpodaffinity's existing-pod
+    directions: RequiredAntiAffinityTerms for Filter; Required/Preferred terms for Score)."""
+    req_aff, req_anti, pref = _affinity_terms(pod)
+    out = []
+    for t in req_anti:
+        out.append(CarrierSpec("anti", t.get("topologyKey", ""), _term_namespaces(t, pod),
+                               _canon(t.get("labelSelector")), 1.0))
+    for t in req_aff:
+        out.append(CarrierSpec("hard", t.get("topologyKey", ""), _term_namespaces(t, pod),
+                               _canon(t.get("labelSelector")), 1.0))
+    for w, t in pref:
+        if w:
+            out.append(CarrierSpec("pref", t.get("topologyKey", ""), _term_namespaces(t, pod),
+                                   _canon(t.get("labelSelector")), float(w)))
+    return out
+
+
+# --------------------------------------------------------------- group encoding -------
+
+
+def scheduling_signature(pod: dict) -> str:
+    """Pods with equal signatures are interchangeable to every predicate and score."""
+    spec = pod.get("spec") or {}
+    owner_kinds = sorted({r.get("kind", "") for r in (pod.get("metadata") or {}).get("ownerReferences") or []})
+    images = sorted(c.get("image", "") for c in spec.get("containers") or [])
+    sig = {
+        "ns": namespace_of(pod),
+        "labels": labels_of(pod),
+        "nodeSelector": spec.get("nodeSelector"),
+        "affinity": spec.get("affinity"),
+        "tolerations": spec.get("tolerations"),
+        "tsc": spec.get("topologySpreadConstraints"),
+        "nodeName": spec.get("nodeName"),
+        "ports": sorted(pod_host_ports(pod)),
+        "requests": dict(sorted(pod_resource_requests(pod).items())),
+        # NonZero scoring depends on the per-container split, not just the sum
+        "nonzero": list(pod_nonzero_cpu_mem(pod)),
+        "owners": owner_kinds,
+        "images": images,
+    }
+    return _canon(sig)
+
+
+def extract_forced_node(pod: dict, na: NodeArrays) -> Tuple[dict, int]:
+    """Detect the DaemonSet pin pattern — every required term carries matchFields
+    metadata.name In [x] for one node x — and return (pod-sans-pin, node index). The
+    stripped pod keeps its matchExpressions so the group's static mask still applies
+    (models/workloads.py set_daemon_pod_node_affinity keeps both)."""
+    spec = pod.get("spec") or {}
+    required = ((spec.get("affinity") or {}).get("nodeAffinity") or {}).get(
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    )
+    if not required:
+        return pod, -1
+    terms = required.get("nodeSelectorTerms") or []
+    target = None
+    for t in terms:
+        mf = t.get("matchFields") or []
+        if len(mf) != 1 or mf[0].get("key") != "metadata.name" or mf[0].get("operator") != "In":
+            return pod, -1
+        vals = mf[0].get("values") or []
+        if len(vals) != 1 or (target is not None and vals[0] != target):
+            return pod, -1
+        target = vals[0]
+    if target is None or target not in na.index:
+        return pod, -1
+    import copy
+
+    stripped = copy.deepcopy(pod)
+    sterms = stripped["spec"]["affinity"]["nodeAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"]["nodeSelectorTerms"]
+    keep = []
+    for t in sterms:
+        t.pop("matchFields", None)
+        if t.get("matchExpressions"):
+            keep.append(t)
+    if keep:
+        stripped["spec"]["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"]["nodeSelectorTerms"] = keep
+    else:
+        stripped["spec"]["affinity"]["nodeAffinity"].pop(
+            "requiredDuringSchedulingIgnoredDuringExecution")
+    return stripped, na.index[target]
+
+
+@dataclass
+class GroupInfo:
+    template: dict
+    # per-pod static vectors
+    requests: np.ndarray          # [R]
+    nonzero: np.ndarray           # [2]
+    ports: List[tuple]
+    unknown_resource: bool
+    # per-node static vectors
+    static_mask: np.ndarray       # [N] bool
+    mask_taint: np.ndarray        # [N] bool  (component masks kept for diagnostics)
+    mask_unsched: np.ndarray      # [N] bool
+    mask_aff: np.ndarray          # [N] bool
+    simon_raw: np.ndarray         # [N] f32 (0..1+ max share)
+    nodeaff_raw: np.ndarray       # [N] f32
+    taint_raw: np.ndarray         # [N] f32
+    avoid_raw: np.ndarray         # [N] f32 (0 or 100)
+    image_raw: np.ndarray         # [N] f32 (0..100)
+    # term slots (counter ids + params)
+    req_aff: List[int] = field(default_factory=list)
+    req_anti: List[int] = field(default_factory=list)
+    pref: List[Tuple[int, float]] = field(default_factory=list)          # (counter, signed w)
+    spread_dns: List[Tuple[int, float, float]] = field(default_factory=list)  # (counter, maxSkew, self)
+    spread_sa: List[Tuple[int, float, float]] = field(default_factory=list)
+    ss_counter: int = -1
+    ss_skip: bool = False         # pod has explicit topologySpreadConstraints
+    aff_self: bool = False        # pod matches all its own required affinity selectors
+    dns_elig: Optional[np.ndarray] = None  # [N] bool: nodes counted for min-match domains
+    carried: List[CarrierSpec] = field(default_factory=list)
+
+
+class Encoder:
+    """Builds and caches groups/counters/carriers for one Simulator instance."""
+
+    def __init__(self, na: NodeArrays, axis: ResourceAxis, cluster_model) -> None:
+        self.na = na
+        self.axis = axis
+        self.model = cluster_model  # owns services/rc/rs/sts lists + placed pods
+        self.groups: Dict[str, int] = {}
+        self.group_list: List[GroupInfo] = []
+        self.counters: Dict[CounterSpec, int] = {}
+        self.counter_list: List[CounterSpec] = []
+        self.carriers: Dict[CarrierSpec, int] = {}
+        self.carrier_list: List[CarrierSpec] = []
+        self.ports = StringTable()  # (protocol, port) → id; hostIP folded (see kernels)
+
+    # -- interning ---------------------------------------------------------------
+
+    def counter_id(self, topo_key: str, namespaces: frozenset, selector) -> int:
+        spec = CounterSpec(topo_key, namespaces, _canon(selector))
+        i = self.counters.get(spec)
+        if i is None:
+            i = len(self.counter_list)
+            self.counters[spec] = i
+            self.counter_list.append(spec)
+        return i
+
+    def carrier_id(self, spec: CarrierSpec) -> int:
+        i = self.carriers.get(spec)
+        if i is None:
+            i = len(self.carrier_list)
+            self.carriers[spec] = i
+            self.carrier_list.append(spec)
+        return i
+
+    def port_ids(self, ports: Sequence[tuple]) -> List[int]:
+        # fold hostIP: 0.0.0.0 conflicts with everything on (proto, port); we intern
+        # (proto, port) only — a deliberate simplification (distinct specific hostIPs
+        # sharing a port are rare in simulation inputs; documented deviation).
+        return [self.ports.intern((p[0], p[2])) for p in ports]
+
+    # -- group construction ------------------------------------------------------
+
+    def group_of(self, pod: dict) -> int:
+        sig = scheduling_signature(pod)
+        gi = self.groups.get(sig)
+        if gi is None:
+            gi = len(self.group_list)
+            self.groups[sig] = gi
+            self.group_list.append(self._build_group(pod))
+        return gi
+
+    def _build_group(self, pod: dict) -> GroupInfo:
+        na, axis = self.na, self.axis
+        spec = pod.get("spec") or {}
+        tolerations = spec.get("tolerations") or []
+        hard_ok, prefer_cnt = _taint_masks(na, tolerations)
+        unsched_ok = _unschedulable_ok(na, tolerations)
+        aff_ok = node_affinity_vec(na, spec)
+        if spec.get("nodeName"):
+            aff_ok = aff_ok & (na.name_ids == na.values.lookup(spec["nodeName"]))
+        mask = hard_ok & unsched_ok & aff_ok
+
+        requests = axis.pod_vector(pod).astype(np.float32)
+        g = GroupInfo(
+            template=pod,
+            requests=requests,
+            nonzero=pod_nonzero_cpu_mem(pod).astype(np.float32),
+            ports=pod_host_ports(pod),
+            unknown_resource=pod_has_unknown_resource(pod, axis),
+            static_mask=mask,
+            mask_taint=hard_ok,
+            mask_unsched=unsched_ok,
+            mask_aff=aff_ok,
+            simon_raw=self._simon_raw(requests),
+            nodeaff_raw=self._nodeaff_raw(spec),
+            taint_raw=prefer_cnt,
+            avoid_raw=self._avoid_raw(pod),
+            image_raw=self._image_raw(pod),
+            aff_self=True,
+        )
+        # inter-pod affinity terms
+        req_aff, req_anti, pref = _affinity_terms(pod)
+        for t in req_aff:
+            nss = _term_namespaces(t, pod)
+            g.req_aff.append(self.counter_id(t.get("topologyKey", ""), nss, t.get("labelSelector")))
+            if namespace_of(pod) not in nss or not match_label_selector(
+                t.get("labelSelector"), labels_of(pod)
+            ):
+                g.aff_self = False
+        for t in req_anti:
+            g.req_anti.append(
+                self.counter_id(t.get("topologyKey", ""), _term_namespaces(t, pod), t.get("labelSelector"))
+            )
+        for w, t in pref:
+            if w:
+                g.pref.append(
+                    (self.counter_id(t.get("topologyKey", ""), _term_namespaces(t, pod),
+                                     t.get("labelSelector")), float(w))
+                )
+        # topology spread
+        own_ns = frozenset([namespace_of(pod)])
+        podlabels = labels_of(pod)
+        for c in _spread_constraints(pod, "DoNotSchedule"):
+            cid = self.counter_id(c.get("topologyKey", ""), own_ns, c.get("labelSelector"))
+            selfm = 1.0 if match_label_selector(c.get("labelSelector"), podlabels) else 0.0
+            g.spread_dns.append((cid, float(c.get("maxSkew", 1)), selfm))
+        for c in _spread_constraints(pod, "ScheduleAnyway"):
+            cid = self.counter_id(c.get("topologyKey", ""), own_ns, c.get("labelSelector"))
+            selfm = 1.0 if match_label_selector(c.get("labelSelector"), podlabels) else 0.0
+            g.spread_sa.append((cid, float(c.get("maxSkew", 1)), selfm))
+        if g.spread_dns or g.spread_sa:
+            # eligibility for min-match domains / SA counting: nodes passing the pod's
+            # node affinity and carrying every constraint topo key (filtering.go
+            # calPreFilterState + nodeLabelsMatchSpreadConstraints)
+            elig = node_affinity_vec(na, spec)
+            for cid, _, _ in g.spread_dns + g.spread_sa:
+                elig &= na.domain_of(self.counter_list[cid].topo_key) >= 0
+            g.dns_elig = elig
+        # selector spread (only when no explicit constraints, selector_spread.go:49-51)
+        g.ss_skip = bool(spec.get("topologySpreadConstraints"))
+        if not g.ss_skip:
+            sel = self.model.default_spread_selector(pod)
+            if sel is not None:
+                g.ss_counter = self.counter_id(HOSTNAME, own_ns, sel)
+        g.carried = [CarrierSpec(cs.use, cs.topo_key, cs.namespaces, cs.selector_canon, cs.weight)
+                     for cs in carried_specs_of_pod(pod)]
+        for cs in g.carried:
+            self.carrier_id(cs)
+        return g
+
+    # -- static score inputs -------------------------------------------------------
+
+    def _simon_raw(self, requests: np.ndarray) -> np.ndarray:
+        """Simon bin-packing signal (plugin/simon.go:45-68): max over requested
+        resources of req/(alloc-req); Share() semantics at alloc-req == 0. Pods with no
+        requests score MaxNodeScore on every node (→ constant → normalizes to 0)."""
+        alloc = self.na.alloc  # [N, R]
+        req = requests.astype(np.float64).copy()
+        req[PODS_I] = 0.0  # the synthetic pods-slot is not a PodRequestsAndLimits entry
+        if not req.any():
+            return np.ones(self.na.N, np.float32)
+        avail = alloc - req[None, :]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            share = np.where(
+                avail == 0,
+                np.where(req[None, :] > 0, 1.0, 0.0),
+                req[None, :] / avail,
+            )
+        share = np.where(req[None, :] > 0, share, 0.0)  # untouched resources contribute 0
+        return np.max(np.where(alloc > 0, share, 0.0), axis=1).astype(np.float32)
+
+    def _nodeaff_raw(self, spec: dict) -> np.ndarray:
+        raw = np.zeros(self.na.N, np.float32)
+        prefs = ((spec.get("affinity") or {}).get("nodeAffinity") or {}).get(
+            "preferredDuringSchedulingIgnoredDuringExecution"
+        ) or []
+        for p in prefs:
+            w = p.get("weight", 0)
+            if w:
+                raw += w * node_selector_term_vec(self.na, p.get("preference") or {}).astype(np.float32)
+        return raw
+
+    def _avoid_raw(self, pod: dict) -> np.ndarray:
+        """NodePreferAvoidPods (plugin nodepreferavoidpods): 100 unless the node's
+        preferAvoidPods annotation targets the pod's RC/RS controller."""
+        raw = np.full(self.na.N, 100.0, np.float32)
+        owners = (pod.get("metadata") or {}).get("ownerReferences") or []
+        ctrl = next((o for o in owners if o.get("controller") and o.get("kind") in
+                     ("ReplicationController", "ReplicaSet")), None)
+        if ctrl is None:
+            return raw
+        for i, node in enumerate(self.na.nodes):
+            anno = annotations_of(node).get("scheduler.alpha.kubernetes.io/preferAvoidPods")
+            if not anno:
+                continue
+            try:
+                entries = json.loads(anno).get("preferAvoidPods") or []
+            except (ValueError, AttributeError):
+                continue
+            for e in entries:
+                pc = ((e.get("podSignature") or {}).get("podController")) or {}
+                if pc.get("kind") == ctrl.get("kind") and pc.get("uid", ctrl.get("uid")) == ctrl.get("uid"):
+                    raw[i] = 0.0
+        return raw
+
+    def _image_raw(self, pod: dict) -> np.ndarray:
+        """ImageLocality (imagelocality plugin): scaled sum of present image sizes,
+        normalized over [23MB, 1000MB]. Zero when nodes advertise no images."""
+        mb = 1024 * 1024
+        min_t, max_t = 23 * mb, 1000 * mb
+        sizes: List[Dict[str, float]] = []
+        have_any = False
+        for node in self.na.nodes:
+            m: Dict[str, float] = {}
+            for img in (node.get("status") or {}).get("images") or []:
+                for nm in img.get("names") or []:
+                    m[nm] = float(img.get("sizeBytes", 0))
+            if m:
+                have_any = True
+            sizes.append(m)
+        raw = np.zeros(self.na.N, np.float32)
+        if not have_any:
+            return raw
+        images = [c.get("image", "") for c in (pod.get("spec") or {}).get("containers") or []]
+        total_nodes = max(1, self.na.N)
+        num_nodes = {img: sum(1 for m in sizes if img in m) for img in images}
+        for i, m in enumerate(sizes):
+            s = 0.0
+            for img in images:
+                if img in m:
+                    s += m[img] * (num_nodes[img] / total_nodes)
+            if s < min_t:
+                raw[i] = 0.0
+            else:
+                raw[i] = np.float32(int(100 * (min(s, max_t) - min_t) / (max_t - min_t)))
+        return raw
+
+
+# ------------------------------------------------------------- placed records ---------
+
+
+@dataclass
+class PlacedRecord:
+    """Host-side memo of one bound pod: everything seeds need, strings pre-resolved."""
+
+    pod: dict
+    node_i: int
+    sig: str
+    labels: dict
+    namespace: str
+    req_vec: np.ndarray      # [R] f32
+    nonzero: np.ndarray      # [2] f32
+    port_ids: List[int]
+    carrier_ids: List[int]
+
+
+# ---------------------------------------------------------------- batch tables --------
+
+
+@dataclass
+class BatchTables:
+    """Everything the device kernels need for one schedulePods batch (all numpy; the
+    engine moves them to jnp). Dimension names: N nodes, R resources, G groups, T
+    counter rows, Tc carrier rows, D domains (+1 sentinel col), PORT port ids (+1
+    sentinel 0), P pods."""
+
+    # node-side
+    alloc: np.ndarray            # [N, R] f32
+    node_zone: np.ndarray        # [N] i32, 0 = no zone
+    n_zones: int
+    # group-side statics
+    static_mask: np.ndarray      # [G, N] bool
+    mask_taint: np.ndarray       # [G, N] bool
+    mask_unsched: np.ndarray     # [G, N] bool
+    mask_aff: np.ndarray         # [G, N] bool
+    simon_raw: np.ndarray        # [G, N] f32
+    nodeaff_raw: np.ndarray      # [G, N] f32
+    taint_raw: np.ndarray        # [G, N] f32
+    avoid_raw: np.ndarray        # [G, N] f32
+    image_raw: np.ndarray        # [G, N] f32
+    grp_requests: np.ndarray     # [G, R] f32
+    grp_nonzero: np.ndarray      # [G, 2] f32
+    grp_unknown: np.ndarray      # [G] bool
+    grp_ports: np.ndarray        # [G, PP] i32 (0 = pad)
+    # counters
+    counter_dom: np.ndarray      # [T, N] i32 (domain id; D = key-absent sentinel)
+    counter_sel_match_g: np.ndarray  # [T, G] bool: does a group pod match counter t
+    req_aff_t: np.ndarray        # [G, A] i32 (-1 pad)
+    grp_aff_self: np.ndarray     # [G] bool
+    req_anti_t: np.ndarray       # [G, B] i32
+    pref_t: np.ndarray           # [G, Cp] i32
+    pref_w: np.ndarray           # [G, Cp] f32
+    dns_t: np.ndarray            # [G, Sd] i32
+    dns_maxskew: np.ndarray      # [G, Sd] f32
+    dns_self: np.ndarray         # [G, Sd] f32
+    dns_edom: np.ndarray         # [G, Sd, D+1] bool
+    sa_t: np.ndarray             # [G, Ss] i32
+    sa_maxskew: np.ndarray       # [G, Ss] f32
+    sa_self: np.ndarray          # [G, Ss] f32
+    ss_t: np.ndarray             # [G] i32 (-1 = no selector-spread counter)
+    ss_skip: np.ndarray          # [G] bool (explicit constraints → plugin skipped)
+    # carriers
+    carr_dom: np.ndarray         # [Tc, N] i32
+    carr_use_anti: np.ndarray    # [Tc] bool
+    carr_hard_w: np.ndarray      # [Tc] f32
+    carr_pref_w: np.ndarray      # [Tc] f32
+    carr_sel_match_g: np.ndarray  # [Tc, G] bool
+    grp_carries: np.ndarray      # [G, Tc] f32
+    # initial carry
+    seed_requested: np.ndarray   # [N, R] f32
+    seed_nonzero: np.ndarray     # [N, 2] f32
+    seed_port_used: np.ndarray   # [N, PORT+1] bool
+    seed_counter: np.ndarray     # [T, D+1] f32
+    seed_carrier: np.ndarray     # [Tc, D+1] f32
+    # batch pods
+    pod_group: np.ndarray        # [P] i32
+    forced_node: np.ndarray      # [P] i32 (-1 = free)
+    valid: np.ndarray            # [P] bool
+
+    @property
+    def dims(self) -> tuple:
+        return (
+            self.alloc.shape[0], self.alloc.shape[1], self.static_mask.shape[0],
+            self.counter_dom.shape[0], self.carr_dom.shape[0],
+            self.seed_counter.shape[1] - 1, self.seed_port_used.shape[1] - 1,
+            self.pod_group.shape[0],
+        )
+
+
+def _pad_slots(rows: List[List], width: int, fill, dtype) -> np.ndarray:
+    out = np.full((len(rows), max(1, width)), fill, dtype)
+    for i, r in enumerate(rows):
+        for j, v in enumerate(r):
+            out[i, j] = v
+    return out
+
+
+def build_batch_tables(
+    enc: Encoder,
+    batch: List[Tuple[int, int]],          # (group_id, forced_node) per pod, in order
+    placed: List[PlacedRecord],
+    match_cache: Dict[Tuple[int, str], bool],
+    pad_to: Optional[int] = None,
+) -> BatchTables:
+    """Assemble numpy tables for one batch. `match_cache` memoizes counter-selector vs
+    placed-pod-signature matches across batches (engine-owned)."""
+    na, axis = enc.na, enc.axis
+    N, R = na.N, axis.R
+    G = max(1, len(enc.group_list))
+    T = max(1, len(enc.counter_list))
+    Tc = max(1, len(enc.carrier_list))
+
+    groups = enc.group_list or []
+    # Intern every group's host ports BEFORE sizing the port axis, or new ports in this
+    # batch would land out of range and clamp onto other pods' columns.
+    grp_port_ids = [enc.port_ids(g.ports) for g in groups] or [[]]
+    PORT = max(1, len(enc.ports))
+
+    def stack(attr, fill=0.0):
+        if not groups:
+            return np.zeros((G, N), np.float32)
+        return np.stack([getattr(g, attr).astype(np.float32) for g in groups])
+
+    static_mask = (
+        np.stack([g.static_mask for g in groups]) if groups else np.zeros((G, N), bool)
+    )
+    # Intern every topology domain FIRST — D (and the sentinel index) depend on it.
+    counter_dom_raw = [na.domain_of(cs.topo_key) for cs in enc.counter_list]
+    carr_dom_raw = [na.domain_of(cs.topo_key) for cs in enc.carrier_list]
+    D = max(1, na.D)  # StringTable length includes the reserved 0 slot; ids are < D
+
+    counter_dom = np.full((T, N), D, np.int32)
+    for t, dom in enumerate(counter_dom_raw):
+        counter_dom[t] = np.where(dom >= 0, dom, D)
+    carr_dom = np.full((Tc, N), D, np.int32)
+    for t, dom in enumerate(carr_dom_raw):
+        carr_dom[t] = np.where(dom >= 0, dom, D)
+
+    A = max((len(g.req_aff) for g in groups), default=0)
+    B = max((len(g.req_anti) for g in groups), default=0)
+    Cp = max((len(g.pref) for g in groups), default=0)
+    Sd = max((len(g.spread_dns) for g in groups), default=0)
+    Ss = max((len(g.spread_sa) for g in groups), default=0)
+    PP = max((len(g.ports) for g in groups), default=0)
+
+    dns_edom = np.zeros((G, max(1, Sd), D + 1), bool)
+    for gi, g in enumerate(groups):
+        for si, (cid, _, _) in enumerate(g.spread_dns):
+            dom = na.domain_of(enc.counter_list[cid].topo_key)
+            elig = g.dns_elig if g.dns_elig is not None else np.ones(N, bool)
+            for n in range(N):
+                if elig[n] and dom[n] >= 0:
+                    dns_edom[gi, si, dom[n]] = True
+
+    carr_sel_match_g = np.zeros((Tc, G), bool)
+    for t, cs in enumerate(enc.carrier_list):
+        for gi, g in enumerate(groups):
+            carr_sel_match_g[t, gi] = cs.matches_pod(g.template)
+    counter_sel_match_g = np.zeros((T, G), bool)
+    for t, cs in enumerate(enc.counter_list):
+        for gi, g in enumerate(groups):
+            counter_sel_match_g[t, gi] = cs.matches_pod(g.template)
+    grp_carries = np.zeros((G, Tc), np.float32)
+    for gi, g in enumerate(groups):
+        for cs in g.carried:
+            grp_carries[gi, enc.carriers[cs]] = 1.0
+
+    # ---- seeds from placed pods -----------------------------------------------
+    seed_requested = np.zeros((N, R), np.float32)
+    seed_nonzero = np.zeros((N, 2), np.float32)
+    seed_port_used = np.zeros((N, PORT + 1), bool)
+    seed_counter = np.zeros((T, D + 1), np.float32)
+    seed_carrier = np.zeros((Tc, D + 1), np.float32)
+    for rec in placed:
+        seed_requested[rec.node_i] += rec.req_vec
+        seed_nonzero[rec.node_i] += rec.nonzero
+        for pid in rec.port_ids:
+            if pid <= PORT:
+                seed_port_used[rec.node_i, pid] = True
+        for t, cs in enumerate(enc.counter_list):
+            key = (t, rec.sig)
+            m = match_cache.get(key)
+            if m is None:
+                m = match_cache[key] = cs.matches_pod(rec.pod)
+            if m:
+                d = counter_dom[t, rec.node_i]
+                if d < D:
+                    seed_counter[t, d] += 1.0
+        for cid in rec.carrier_ids:
+            d = carr_dom[cid, rec.node_i]
+            if d < D:
+                seed_carrier[cid, d] += 1.0
+
+    # ---- batch pod arrays -------------------------------------------------------
+    P = len(batch)
+    P_pad = max(pad_to or P, P, 1)
+    pod_group = np.zeros(P_pad, np.int32)
+    forced_node = np.full(P_pad, -1, np.int32)
+    valid = np.zeros(P_pad, bool)
+    for i, (gi, fn) in enumerate(batch):
+        pod_group[i] = gi
+        forced_node[i] = fn
+        valid[i] = True
+
+    return BatchTables(
+        alloc=na.alloc.astype(np.float32),
+        node_zone=na.zone_id.astype(np.int32),
+        n_zones=len(na.zones) + 1,
+        static_mask=static_mask,
+        mask_taint=(np.stack([g.mask_taint for g in groups]) if groups else np.zeros((G, N), bool)),
+        mask_unsched=(np.stack([g.mask_unsched for g in groups]) if groups else np.zeros((G, N), bool)),
+        mask_aff=(np.stack([g.mask_aff for g in groups]) if groups else np.zeros((G, N), bool)),
+        simon_raw=stack("simon_raw"),
+        nodeaff_raw=stack("nodeaff_raw"),
+        taint_raw=stack("taint_raw"),
+        avoid_raw=stack("avoid_raw"),
+        image_raw=stack("image_raw"),
+        grp_requests=(
+            np.stack([g.requests for g in groups]) if groups else np.zeros((G, R), np.float32)
+        ),
+        grp_nonzero=(
+            np.stack([g.nonzero for g in groups]) if groups else np.zeros((G, 2), np.float32)
+        ),
+        grp_unknown=np.array([g.unknown_resource for g in groups] or [False], bool),
+        grp_ports=_pad_slots(grp_port_ids, PP, 0, np.int32),
+        counter_dom=counter_dom,
+        counter_sel_match_g=counter_sel_match_g,
+        req_aff_t=_pad_slots([g.req_aff for g in groups] or [[]], A, -1, np.int32),
+        grp_aff_self=np.array([g.aff_self for g in groups] or [False], bool),
+        req_anti_t=_pad_slots([g.req_anti for g in groups] or [[]], B, -1, np.int32),
+        pref_t=_pad_slots([[c for c, _ in g.pref] for g in groups] or [[]], Cp, -1, np.int32),
+        pref_w=_pad_slots([[w for _, w in g.pref] for g in groups] or [[]], Cp, 0.0, np.float32),
+        dns_t=_pad_slots([[c for c, _, _ in g.spread_dns] for g in groups] or [[]], Sd, -1, np.int32),
+        dns_maxskew=_pad_slots([[m for _, m, _ in g.spread_dns] for g in groups] or [[]], Sd, 1.0, np.float32),
+        dns_self=_pad_slots([[s for _, _, s in g.spread_dns] for g in groups] or [[]], Sd, 0.0, np.float32),
+        dns_edom=dns_edom,
+        sa_t=_pad_slots([[c for c, _, _ in g.spread_sa] for g in groups] or [[]], Ss, -1, np.int32),
+        sa_maxskew=_pad_slots([[m for _, m, _ in g.spread_sa] for g in groups] or [[]], Ss, 1.0, np.float32),
+        sa_self=_pad_slots([[s for _, _, s in g.spread_sa] for g in groups] or [[]], Ss, 0.0, np.float32),
+        ss_t=np.array([g.ss_counter for g in groups] or [-1], np.int32),
+        ss_skip=np.array([g.ss_skip for g in groups] or [False], bool),
+        carr_dom=carr_dom,
+        carr_use_anti=np.array(
+            [cs.use == "anti" for cs in enc.carrier_list] or [False], bool
+        ),
+        carr_hard_w=np.array(
+            [1.0 if cs.use == "hard" else 0.0 for cs in enc.carrier_list] or [0.0], np.float32
+        ),
+        carr_pref_w=np.array(
+            [cs.weight if cs.use == "pref" else 0.0 for cs in enc.carrier_list] or [0.0],
+            np.float32,
+        ),
+        carr_sel_match_g=carr_sel_match_g,
+        grp_carries=grp_carries,
+        seed_requested=seed_requested,
+        seed_nonzero=seed_nonzero,
+        seed_port_used=seed_port_used,
+        seed_counter=seed_counter,
+        seed_carrier=seed_carrier,
+        pod_group=pod_group,
+        forced_node=forced_node,
+        valid=valid,
+    )

@@ -1,0 +1,384 @@
+"""The Simulator engine: owns cluster state and drives the batched device scheduler.
+
+Plays the role of the reference's Simulator struct (pkg/simulator/simulator.go:33-57) —
+fake clientset, informers, scheduler wiring, serial schedulePods loop — but TPU-native:
+cluster state is a set of host tables + a device carry, and a whole batch of pods is
+scheduled by one compiled `lax.scan` (ops/kernels.py) instead of one channel handshake
+per pod (simulator.go:309-348).
+
+Behavioral parity notes:
+- Pods arriving with spec.nodeName are committed directly without any filter/capacity
+  check, exactly like fakeclient Create + no scheduling cycle (simulator.go:326-331).
+- Failed pods leave no trace on cluster state (the reference deletes them,
+  simulator.go:333-342).
+- ScheduleApp registers only ConfigMaps/StorageClasses/PDBs from the app — notably NOT
+  Services (simulator.go:252-267), so app services never feed SelectorSpread; cluster
+  services do (syncClusterResourceList:365-447).
+- Unschedulable reasons are rebuilt from per-stage masks in the k8s FitError format
+  ("0/N nodes are available: ..."). They are computed against the end-of-batch state,
+  not the mid-batch state the reference would report (documented deviation; placement
+  itself is unaffected).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import constants as C
+from ..core.types import AppResource, NodeStatus, ResourceTypes, SimulateResult, UnscheduledPod
+from ..algo.queues import sort_affinity, sort_toleration
+from ..models.workloads import generate_valid_pods_from_app
+from ..ops import kernels
+from ..ops.resources import ResourceAxis, pod_nonzero_cpu_mem
+from ..utils.objutil import (
+    find_untolerated_taint,
+    labels_of,
+    match_label_selector,
+    name_of,
+    namespace_of,
+    pod_host_ports,
+    selector_from_set,
+)
+from .encode import (
+    BatchTables,
+    Encoder,
+    NodeArrays,
+    PlacedRecord,
+    build_batch_tables,
+    carried_specs_of_pod,
+    extract_forced_node,
+    scheduling_signature,
+)
+
+_jnp = None  # lazy jax import so host-only paths (ingestion, reports) stay jax-free
+
+
+def _jax():
+    global _jnp
+    if _jnp is None:
+        import jax.numpy as jnp
+
+        _jnp = jnp
+    return _jnp
+
+
+class ClusterModel:
+    """Host registry of non-pod objects that influence scheduling."""
+
+    def __init__(self) -> None:
+        self.services: List[dict] = []
+        self.replication_controllers: List[dict] = []
+        self.replica_sets: List[dict] = []
+        self.stateful_sets: List[dict] = []
+        self.storage_classes: List[dict] = []
+        self.config_maps: List[dict] = []
+        self.pdbs: List[dict] = []
+        self.pvcs: List[dict] = []
+
+    def default_spread_selector(self, pod: dict) -> Optional[dict]:
+        """helper.DefaultSelector (plugins/helper/spread.go:22-57): merge the selectors
+        of every Service/RC (map-style) and RS/STS (set-based) selecting this pod.
+        Returns a LabelSelector dict, or None when empty (SelectorSpread inert)."""
+        ns, lbls = namespace_of(pod), labels_of(pod)
+        merged: Dict[str, str] = {}
+        exprs: List[dict] = []
+        for svc in self.services:
+            sel = (svc.get("spec") or {}).get("selector")
+            if sel and namespace_of(svc) == ns and selector_from_set(sel, lbls):
+                merged.update(sel)
+        for rc in self.replication_controllers:
+            sel = (rc.get("spec") or {}).get("selector")
+            if sel and namespace_of(rc) == ns and selector_from_set(sel, lbls):
+                merged.update(sel)
+        for coll in (self.replica_sets, self.stateful_sets):
+            for obj in coll:
+                sel = (obj.get("spec") or {}).get("selector")
+                if sel and namespace_of(obj) == ns and match_label_selector(sel, lbls):
+                    merged.update(sel.get("matchLabels") or {})
+                    exprs.extend(sel.get("matchExpressions") or [])
+        if not merged and not exprs:
+            return None
+        out: dict = {}
+        if merged:
+            out["matchLabels"] = merged
+        if exprs:
+            out["matchExpressions"] = exprs
+        return out
+
+
+class Simulator:
+    """One simulation run over a fixed node set."""
+
+    def __init__(
+        self,
+        nodes: List[dict],
+        disable_progress: bool = True,
+        patch_pod_funcs: Optional[List[Callable]] = None,
+    ) -> None:
+        self.axis = ResourceAxis()
+        self.axis.discover(nodes, [])
+        self.model = ClusterModel()
+        self.na = NodeArrays(nodes, self.axis)
+        self.encoder = Encoder(self.na, self.axis, self.model)
+        self.placed: List[PlacedRecord] = []
+        self.pods_on_node: List[List[dict]] = [[] for _ in nodes]
+        self.homeless: List[dict] = []  # bound to a node name we don't know
+        self.match_cache: Dict[Tuple[int, str], bool] = {}
+        self.disable_progress = disable_progress
+        self.patch_pod_funcs = patch_pod_funcs or []
+        self._last_tables: Optional[BatchTables] = None
+        self._last_carry = None
+
+    # ------------------------------------------------------------- state ----------
+
+    def _commit_pod(self, pod: dict, node_i: int) -> None:
+        pod.setdefault("spec", {})["nodeName"] = self.na.names[node_i]
+        pod["status"] = {"phase": "Running"}
+        rec = PlacedRecord(
+            pod=pod,
+            node_i=node_i,
+            sig=scheduling_signature(pod),
+            labels=labels_of(pod),
+            namespace=namespace_of(pod),
+            req_vec=self.axis.pod_vector(pod).astype(np.float32),
+            nonzero=pod_nonzero_cpu_mem(pod).astype(np.float32),
+            port_ids=self.encoder.port_ids(pod_host_ports(pod)),
+            carrier_ids=[self.encoder.carrier_id(cs) for cs in carried_specs_of_pod(pod)],
+        )
+        self.placed.append(rec)
+        self.pods_on_node[node_i].append(pod)
+
+    def register_cluster_objects(self, rt: ResourceTypes) -> None:
+        m = self.model
+        m.services.extend(rt.services)
+        m.replication_controllers.extend(rt.replication_controllers)
+        m.replica_sets.extend(rt.replica_sets)
+        m.stateful_sets.extend(rt.stateful_sets)
+        m.storage_classes.extend(rt.storage_classes)
+        m.config_maps.extend(rt.config_maps)
+        m.pdbs.extend(rt.pod_disruption_budgets)
+        m.pvcs.extend(rt.persistent_volume_claims)
+
+    def register_app_objects(self, rt: ResourceTypes) -> None:
+        """ScheduleApp only materializes CM/SC/PDB from apps (simulator.go:252-267)."""
+        self.model.config_maps.extend(rt.config_maps)
+        self.model.storage_classes.extend(rt.storage_classes)
+        self.model.pdbs.extend(rt.pod_disruption_budgets)
+
+    # --------------------------------------------------------- scheduling ---------
+
+    def schedule_pods(self, pods: List[dict]) -> List[UnscheduledPod]:
+        """The schedulePods loop (simulator.go:309-348), batched while preserving the
+        reference's strictly serial order: runs of unbound pods become one compiled
+        scan; a pre-bound pod (spec.nodeName) flushes the run first, then commits
+        directly — so earlier unbound pods never see capacity a later bound pod will
+        take, exactly as in the serial loop."""
+        failed: List[UnscheduledPod] = []
+        run: List[dict] = []
+        for pod in pods:
+            node_name = (pod.get("spec") or {}).get("nodeName")
+            if not node_name:
+                run.append(pod)
+                continue
+            failed.extend(self._schedule_run(run))
+            run = []
+            ni = self.na.index.get(node_name)
+            if ni is None:
+                # Parity: the reference's fakeclient accepts pods bound to unknown
+                # nodes and getClusterNodeStatus (simulator.go:277-301) silently drops
+                # them from every report; we keep them findable on self.homeless.
+                self.homeless.append(pod)
+            else:
+                self._commit_pod(pod, ni)
+        failed.extend(self._schedule_run(run))
+        return failed
+
+    def _schedule_run(self, to_schedule: List[dict]) -> List[UnscheduledPod]:
+        failed: List[UnscheduledPod] = []
+        if not to_schedule:
+            return failed
+        batch: List[Tuple[int, int]] = []
+        for pod in to_schedule:
+            stripped, forced = extract_forced_node(pod, self.na)
+            batch.append((self.encoder.group_of(stripped), forced))
+
+        if self.na.N == 0:
+            return [
+                UnscheduledPod(pod, self._format_reason(pod, {}, 0))
+                for pod in to_schedule
+            ]
+
+        pad = max(8, 1 << (len(batch) - 1).bit_length())
+        bt = build_batch_tables(self.encoder, batch, self.placed, self.match_cache, pad_to=pad)
+        tables, carry = self._to_device(bt)
+        final_carry, choices = kernels.schedule_batch(
+            tables,
+            carry,
+            _jax().asarray(bt.pod_group),
+            _jax().asarray(bt.forced_node),
+            _jax().asarray(bt.valid),
+            n_zones=bt.n_zones,
+        )
+        choices = np.asarray(choices)
+        self._last_tables, self._last_carry = bt, final_carry
+
+        for i, pod in enumerate(to_schedule):
+            node_i = int(choices[i])
+            if node_i >= 0:
+                self._commit_pod(pod, node_i)
+            else:
+                reason = self._explain(pod, batch[i][0], batch[i][1], tables, final_carry)
+                failed.append(UnscheduledPod(pod, reason))
+        return failed
+
+    def _to_device(self, bt: BatchTables):
+        jnp = _jax()
+
+        tables = kernels.Tables(
+            alloc=jnp.asarray(bt.alloc),
+            node_zone=jnp.asarray(bt.node_zone),
+            static_mask=jnp.asarray(bt.static_mask),
+            mask_taint=jnp.asarray(bt.mask_taint),
+            mask_unsched=jnp.asarray(bt.mask_unsched),
+            mask_aff=jnp.asarray(bt.mask_aff),
+            simon_raw=jnp.asarray(bt.simon_raw),
+            nodeaff_raw=jnp.asarray(bt.nodeaff_raw),
+            taint_raw=jnp.asarray(bt.taint_raw),
+            avoid_raw=jnp.asarray(bt.avoid_raw),
+            image_raw=jnp.asarray(bt.image_raw),
+            grp_requests=jnp.asarray(bt.grp_requests),
+            grp_nonzero=jnp.asarray(bt.grp_nonzero),
+            grp_unknown=jnp.asarray(bt.grp_unknown),
+            grp_ports=jnp.asarray(bt.grp_ports),
+            counter_dom=jnp.asarray(bt.counter_dom),
+            counter_sel_match_g=jnp.asarray(bt.counter_sel_match_g),
+            req_aff_t=jnp.asarray(bt.req_aff_t),
+            grp_aff_self=jnp.asarray(bt.grp_aff_self),
+            req_anti_t=jnp.asarray(bt.req_anti_t),
+            pref_t=jnp.asarray(bt.pref_t),
+            pref_w=jnp.asarray(bt.pref_w),
+            dns_t=jnp.asarray(bt.dns_t),
+            dns_maxskew=jnp.asarray(bt.dns_maxskew),
+            dns_self=jnp.asarray(bt.dns_self),
+            dns_edom=jnp.asarray(bt.dns_edom),
+            sa_t=jnp.asarray(bt.sa_t),
+            sa_maxskew=jnp.asarray(bt.sa_maxskew),
+            sa_self=jnp.asarray(bt.sa_self),
+            ss_t=jnp.asarray(bt.ss_t),
+            ss_skip=jnp.asarray(bt.ss_skip),
+            carr_dom=jnp.asarray(bt.carr_dom),
+            carr_use_anti=jnp.asarray(bt.carr_use_anti),
+            carr_hard_w=jnp.asarray(bt.carr_hard_w),
+            carr_pref_w=jnp.asarray(bt.carr_pref_w),
+            carr_sel_match_g=jnp.asarray(bt.carr_sel_match_g),
+            grp_carries=jnp.asarray(bt.grp_carries),
+        )
+        carry = kernels.Carry(
+            requested=jnp.asarray(bt.seed_requested),
+            nonzero=jnp.asarray(bt.seed_nonzero),
+            port_used=jnp.asarray(bt.seed_port_used),
+            counter=jnp.asarray(bt.seed_counter),
+            carrier=jnp.asarray(bt.seed_carrier),
+        )
+        return tables, carry
+
+    # ------------------------------------------------- unschedulable reasons ------
+
+    _STAGE_ORDER = (
+        ("unsched", "node(s) were unschedulable"),
+        ("taint", None),  # expanded per-taint below
+        ("affinity", "node(s) didn't match node selector"),
+        ("ports", "node(s) didn't have free ports for the requested pod ports"),
+        ("fit", None),  # expanded per-resource below
+        ("spread", "node(s) didn't match pod topology spread constraints"),
+        ("pod_affinity", "node(s) didn't match pod affinity rules"),
+        ("pod_anti", "node(s) didn't match pod anti-affinity rules"),
+    )
+
+    def _explain(self, pod: dict, g: int, forced: int, tables, carry) -> str:
+        """Rebuild the FitError message from per-stage masks (generic_scheduler.go
+        findNodesThatFitPod failure accounting; first-failing-plugin per node)."""
+        jnp = _jax()
+
+        feasible, stages = kernels.feasibility_jit(
+            tables, carry, jnp.int32(g), jnp.int32(forced), jnp.asarray(True)
+        )
+        stages = {k: np.asarray(v) for k, v in stages.items()}
+        N = self.na.N
+        remaining = np.ones(N, bool)
+        if forced >= 0:
+            only = np.zeros(N, bool)
+            only[forced] = True
+            remaining &= only
+        reasons: Dict[str, int] = {}
+
+        def take(mask_ok: np.ndarray, label: str):
+            nonlocal remaining
+            fail = remaining & ~mask_ok
+            n = int(fail.sum())
+            if n:
+                reasons[label] = reasons.get(label, 0) + n
+            remaining &= mask_ok
+
+        for stage, label in self._STAGE_ORDER:
+            if stage == "taint":
+                fail = remaining & ~stages["taint"]
+                for i in np.nonzero(fail)[0]:
+                    taint = find_untolerated_taint(self.na.nodes[i], pod, ("NoSchedule", "NoExecute"))
+                    if taint is None:
+                        lbl = "node(s) had taints that the pod didn't tolerate"
+                    else:
+                        lbl = "node(s) had taint {%s: %s}, that the pod didn't tolerate" % (
+                            taint.get("key", ""), taint.get("value") or "")
+                    reasons[lbl] = reasons.get(lbl, 0) + 1
+                remaining &= stages["taint"]
+            elif stage == "fit":
+                fit_each = stages["fit_each"]  # [N, R]
+                fail = remaining & ~stages["fit"]
+                for i in np.nonzero(fail)[0]:
+                    bad = np.nonzero(~fit_each[i])[0]
+                    res = self.axis.names[bad[0]] if len(bad) else "resources"
+                    lbl = "Too many pods" if res == "pods" else f"Insufficient {res}"
+                    reasons[lbl] = reasons.get(lbl, 0) + 1
+                remaining &= stages["fit"]
+            else:
+                take(stages[stage], label)
+        return self._format_reason(pod, reasons, N)
+
+    def _format_reason(self, pod: dict, reasons: Dict[str, int], n_nodes: int) -> str:
+        detail = ", ".join(f"{v} {k}" for k, v in sorted(reasons.items()))
+        if not detail:
+            detail = "no nodes available to schedule pods"
+        msg = f"0/{n_nodes} nodes are available: {detail}."
+        return (
+            f"failed to schedule pod ({namespace_of(pod)}/{name_of(pod)}): "
+            f"{C.PodReasonUnschedulable}: {msg}"
+        )
+
+    # ----------------------------------------------------------- results ----------
+
+    def get_cluster_node_status(self) -> List[NodeStatus]:
+        return [
+            NodeStatus(node=self.na.nodes[i], pods=list(self.pods_on_node[i]))
+            for i in range(self.na.N)
+        ]
+
+    def schedule_app(self, app: AppResource) -> SimulateResult:
+        """ScheduleApp (simulator.go:232-275): expand app, order, register CM/SC/PDB,
+        schedule."""
+        pods = generate_valid_pods_from_app(app.name, app.resource, self.na.nodes)
+        pods = sort_toleration(sort_affinity(pods))
+        for patch in self.patch_pod_funcs:
+            patch(pods)
+        self.register_app_objects(app.resource)
+        failed = self.schedule_pods(pods)
+        return SimulateResult(unscheduled_pods=failed, node_status=self.get_cluster_node_status())
+
+    def run_cluster(self, cluster: ResourceTypes) -> SimulateResult:
+        """RunCluster + syncClusterResourceList (simulator.go:225-230,365-447)."""
+        self.register_cluster_objects(cluster)
+        failed = self.schedule_pods(cluster.pods)
+        return SimulateResult(unscheduled_pods=failed, node_status=self.get_cluster_node_status())
