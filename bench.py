@@ -1,0 +1,88 @@
+"""Headline benchmark: batched scheduling throughput.
+
+Workload (BASELINE.md config #2): 1,000-node synthetic cluster, 10,000 nginx-shaped
+pods with cpu/mem requests — the NodeResourcesFit-dominated shape. The metric is
+end-to-end pods scheduled per second with a warm compile cache: host-side batch
+encoding + one compiled `lax.scan` over all 10k pods on the accelerator, preserving
+the reference's strictly serial placement semantics
+(/root/reference/pkg/simulator/simulator.go:309-348 schedules one pod per channel
+handshake; here one scan step per pod).
+
+Baseline for `vs_baseline` is the BASELINE.json north star: 100k pods onto 10k nodes
+in <2s ⇒ 50,000 pods/s. vs_baseline = value / 50_000.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+N_NODES = 1_000
+N_PODS = 10_000
+BASELINE_PODS_PER_SEC = 50_000.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from open_simulator_tpu.ops import kernels
+    from open_simulator_tpu.simulator.engine import Simulator
+    from open_simulator_tpu.utils.synth import synth_cluster
+
+    nodes, pods = synth_cluster(N_NODES, N_PODS)
+
+    # Host encode (counted): pods -> device tables.
+    t0 = time.perf_counter()
+    sim = Simulator(nodes)
+    bt = sim.encode_batch(pods)
+    t_encode = time.perf_counter() - t0
+
+    tables, carry = sim._to_device(bt)
+    pg = jnp.asarray(bt.pod_group)
+    fn = jnp.asarray(bt.forced_node)
+    vd = jnp.asarray(bt.valid)
+
+    # Cold run: compile + execute (discarded). np.asarray forces a device→host
+    # transfer as the sync point (block_until_ready alone can return early through
+    # remote-device tunnels).
+    out = kernels.schedule_batch(tables, carry, pg, fn, vd, n_zones=bt.n_zones)
+    np.asarray(out[1])
+
+    # Warm runs from the same initial carry.
+    times = []
+    for _ in range(3):
+        t1 = time.perf_counter()
+        final, choices = kernels.schedule_batch(
+            tables, carry, pg, fn, vd, n_zones=bt.n_zones
+        )
+        choices = np.asarray(choices)
+        times.append(time.perf_counter() - t1)
+    t_exec = min(times)
+    scheduled = int((choices[np.asarray(bt.valid)] >= 0).sum())
+    if scheduled != N_PODS:
+        print(
+            f"WARNING: only {scheduled}/{N_PODS} pods schedulable", file=sys.stderr
+        )
+
+    wall = t_encode + t_exec
+    value = scheduled / wall
+    print(json.dumps({
+        "metric": f"pods_scheduled_per_sec_{N_PODS//1000}k_pods_{N_NODES}_nodes",
+        "value": round(value, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(value / BASELINE_PODS_PER_SEC, 4),
+    }))
+    print(
+        f"encode {t_encode*1e3:.1f} ms, device scan {t_exec*1e3:.1f} ms, "
+        f"scheduled {scheduled}/{N_PODS} on {N_NODES} nodes",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

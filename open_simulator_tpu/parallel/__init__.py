@@ -1,0 +1,28 @@
+"""Device-mesh parallelism: shard the node axis (and scenario axis) of the batched
+scheduler over a jax.sharding.Mesh. See mesh.py for the design notes."""
+
+from .mesh import (
+    NODE_AXIS,
+    SCENARIO_AXIS,
+    make_node_mesh,
+    pad_batch_tables,
+    schedule_batch_on_mesh,
+    schedule_scenarios_on_mesh,
+    table_shardings,
+    carry_shardings,
+    tables_from_batch,
+    to_device_sharded,
+)
+
+__all__ = [
+    "NODE_AXIS",
+    "SCENARIO_AXIS",
+    "make_node_mesh",
+    "pad_batch_tables",
+    "schedule_batch_on_mesh",
+    "schedule_scenarios_on_mesh",
+    "table_shardings",
+    "carry_shardings",
+    "tables_from_batch",
+    "to_device_sharded",
+]

@@ -340,27 +340,37 @@ def carried_specs_of_pod(pod: dict) -> List[CarrierSpec]:
 # --------------------------------------------------------------- group encoding -------
 
 
-def scheduling_signature(pod: dict) -> str:
-    """Pods with equal signatures are interchangeable to every predicate and score."""
+def _freeze(o):
+    """Recursively hashable form of a JSON-ish object (much faster than json.dumps
+    canonicalization on the per-pod hot path)."""
+    if isinstance(o, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in o.items()))
+    if isinstance(o, (list, tuple)):
+        return tuple(_freeze(v) for v in o)
+    return o
+
+
+def scheduling_signature(pod: dict):
+    """Pods with equal signatures are interchangeable to every predicate and score.
+    Returns an opaque hashable key."""
     spec = pod.get("spec") or {}
     owner_kinds = sorted({r.get("kind", "") for r in (pod.get("metadata") or {}).get("ownerReferences") or []})
     images = sorted(c.get("image", "") for c in spec.get("containers") or [])
-    sig = {
-        "ns": namespace_of(pod),
-        "labels": labels_of(pod),
-        "nodeSelector": spec.get("nodeSelector"),
-        "affinity": spec.get("affinity"),
-        "tolerations": spec.get("tolerations"),
-        "tsc": spec.get("topologySpreadConstraints"),
-        "nodeName": spec.get("nodeName"),
-        "ports": sorted(pod_host_ports(pod)),
-        "requests": dict(sorted(pod_resource_requests(pod).items())),
+    return (
+        namespace_of(pod),
+        _freeze(labels_of(pod)),
+        _freeze(spec.get("nodeSelector")),
+        _freeze(spec.get("affinity")),
+        _freeze(spec.get("tolerations")),
+        _freeze(spec.get("topologySpreadConstraints")),
+        spec.get("nodeName"),
+        tuple(sorted(pod_host_ports(pod))),
+        tuple(sorted(pod_resource_requests(pod).items())),
         # NonZero scoring depends on the per-container split, not just the sum
-        "nonzero": list(pod_nonzero_cpu_mem(pod)),
-        "owners": owner_kinds,
-        "images": images,
-    }
-    return _canon(sig)
+        tuple(pod_nonzero_cpu_mem(pod)),
+        tuple(owner_kinds),
+        tuple(images),
+    )
 
 
 def extract_forced_node(pod: dict, na: NodeArrays) -> Tuple[dict, int]:
@@ -663,7 +673,7 @@ class PlacedRecord:
 
     pod: dict
     node_i: int
-    sig: str
+    sig: object  # opaque hashable scheduling_signature key
     labels: dict
     namespace: str
     req_vec: np.ndarray      # [R] f32

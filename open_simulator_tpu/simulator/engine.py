@@ -126,7 +126,7 @@ class Simulator:
         self.placed: List[PlacedRecord] = []
         self.pods_on_node: List[List[dict]] = [[] for _ in nodes]
         self.homeless: List[dict] = []  # bound to a node name we don't know
-        self.match_cache: Dict[Tuple[int, str], bool] = {}
+        self.match_cache: Dict[Tuple[int, object], bool] = {}  # (counter id, sched signature)
         self.disable_progress = disable_progress
         self.patch_pod_funcs = patch_pod_funcs or []
         self._last_tables: Optional[BatchTables] = None
@@ -196,14 +196,26 @@ class Simulator:
         failed.extend(self._schedule_run(run))
         return failed
 
-    def _schedule_run(self, to_schedule: List[dict]) -> List[UnscheduledPod]:
-        failed: List[UnscheduledPod] = []
-        if not to_schedule:
-            return failed
+    def encode_batch(self, to_schedule: List[dict]) -> BatchTables:
+        """Encode a pod batch into device-ready tables (no scheduling). Exposed for
+        the bench/graft harnesses and the parallel (mesh-sharded) path."""
         batch: List[Tuple[int, int]] = []
         for pod in to_schedule:
             stripped, forced = extract_forced_node(pod, self.na)
             batch.append((self.encoder.group_of(stripped), forced))
+        # Pad the scan length to bound compile-cache churn: powers of two up to 2048,
+        # then multiples of 2048 (a 10k batch scans 10240 steps, not 16384).
+        P = len(batch)
+        if P <= 2048:
+            pad = max(8, 1 << (P - 1).bit_length())
+        else:
+            pad = ((P + 2047) // 2048) * 2048
+        return build_batch_tables(self.encoder, batch, self.placed, self.match_cache, pad_to=pad)
+
+    def _schedule_run(self, to_schedule: List[dict]) -> List[UnscheduledPod]:
+        failed: List[UnscheduledPod] = []
+        if not to_schedule:
+            return failed
 
         if self.na.N == 0:
             return [
@@ -211,8 +223,7 @@ class Simulator:
                 for pod in to_schedule
             ]
 
-        pad = max(8, 1 << (len(batch) - 1).bit_length())
-        bt = build_batch_tables(self.encoder, batch, self.placed, self.match_cache, pad_to=pad)
+        bt = self.encode_batch(to_schedule)
         tables, carry = self._to_device(bt)
         final_carry, choices = kernels.schedule_batch(
             tables,
@@ -230,52 +241,17 @@ class Simulator:
             if node_i >= 0:
                 self._commit_pod(pod, node_i)
             else:
-                reason = self._explain(pod, batch[i][0], batch[i][1], tables, final_carry)
+                reason = self._explain(
+                    pod, int(bt.pod_group[i]), int(bt.forced_node[i]), tables, final_carry
+                )
                 failed.append(UnscheduledPod(pod, reason))
         return failed
 
     def _to_device(self, bt: BatchTables):
         jnp = _jax()
+        from ..parallel.mesh import tables_from_batch
 
-        tables = kernels.Tables(
-            alloc=jnp.asarray(bt.alloc),
-            node_zone=jnp.asarray(bt.node_zone),
-            static_mask=jnp.asarray(bt.static_mask),
-            mask_taint=jnp.asarray(bt.mask_taint),
-            mask_unsched=jnp.asarray(bt.mask_unsched),
-            mask_aff=jnp.asarray(bt.mask_aff),
-            simon_raw=jnp.asarray(bt.simon_raw),
-            nodeaff_raw=jnp.asarray(bt.nodeaff_raw),
-            taint_raw=jnp.asarray(bt.taint_raw),
-            avoid_raw=jnp.asarray(bt.avoid_raw),
-            image_raw=jnp.asarray(bt.image_raw),
-            grp_requests=jnp.asarray(bt.grp_requests),
-            grp_nonzero=jnp.asarray(bt.grp_nonzero),
-            grp_unknown=jnp.asarray(bt.grp_unknown),
-            grp_ports=jnp.asarray(bt.grp_ports),
-            counter_dom=jnp.asarray(bt.counter_dom),
-            counter_sel_match_g=jnp.asarray(bt.counter_sel_match_g),
-            req_aff_t=jnp.asarray(bt.req_aff_t),
-            grp_aff_self=jnp.asarray(bt.grp_aff_self),
-            req_anti_t=jnp.asarray(bt.req_anti_t),
-            pref_t=jnp.asarray(bt.pref_t),
-            pref_w=jnp.asarray(bt.pref_w),
-            dns_t=jnp.asarray(bt.dns_t),
-            dns_maxskew=jnp.asarray(bt.dns_maxskew),
-            dns_self=jnp.asarray(bt.dns_self),
-            dns_edom=jnp.asarray(bt.dns_edom),
-            sa_t=jnp.asarray(bt.sa_t),
-            sa_maxskew=jnp.asarray(bt.sa_maxskew),
-            sa_self=jnp.asarray(bt.sa_self),
-            ss_t=jnp.asarray(bt.ss_t),
-            ss_skip=jnp.asarray(bt.ss_skip),
-            carr_dom=jnp.asarray(bt.carr_dom),
-            carr_use_anti=jnp.asarray(bt.carr_use_anti),
-            carr_hard_w=jnp.asarray(bt.carr_hard_w),
-            carr_pref_w=jnp.asarray(bt.carr_pref_w),
-            carr_sel_match_g=jnp.asarray(bt.carr_sel_match_g),
-            grp_carries=jnp.asarray(bt.grp_carries),
-        )
+        tables = kernels.Tables(*(jnp.asarray(v) for v in tables_from_batch(bt)))
         carry = kernels.Carry(
             requested=jnp.asarray(bt.seed_requested),
             nonzero=jnp.asarray(bt.seed_nonzero),

@@ -1,0 +1,49 @@
+"""Backend/device plumbing shared by tests, bench, and the multichip dry-run.
+
+Some images inject a TPU plugin that prepends itself to `jax_platforms`, defeating the
+JAX_PLATFORMS=cpu env var; and the virtual-CPU device count flag is only read at the
+CPU backend's lazy initialization. This module is the one place that handles both.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_FLAG = "--xla_force_host_platform_device_count"
+
+
+def request_cpu_devices(n: int) -> None:
+    """Raise the virtual CPU device count to ≥ n via XLA_FLAGS. Must run before the
+    CPU backend's lazy initialization; harmless (but ineffective) afterwards."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(re.escape(_FLAG) + r"=(\d+)", flags)
+    cur = int(m.group(1)) if m else 0
+    if cur < n:
+        flags = re.sub(re.escape(_FLAG) + r"=\d+", "", flags).strip()
+        os.environ["XLA_FLAGS"] = (flags + f" {_FLAG}={n}").strip()
+
+
+def force_cpu_platform() -> None:
+    """Make CPU the default JAX platform regardless of injected plugin priority.
+    Silently a no-op when a backend is already initialized."""
+    import jax
+
+    try:
+        if not str(jax.config.jax_platforms or "").startswith("cpu"):
+            jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def cpu_devices(n: int):
+    """Best-effort list of ≥ n devices, preferring the default platform and falling
+    back to virtual CPU devices. May return fewer if the CPU backend already
+    initialized with a smaller count."""
+    request_cpu_devices(n)
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < n:
+        devs = jax.devices("cpu")
+    return devs

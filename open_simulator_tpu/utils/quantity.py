@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import re
 from decimal import Decimal
+from functools import lru_cache
 
 _BIN = {"Ki": 1024, "Mi": 1024**2, "Gi": 1024**3, "Ti": 1024**4, "Pi": 1024**5, "Ei": 1024**6}
 _DEC = {
@@ -44,16 +45,23 @@ def parse_decimal(value) -> Decimal:
     """Parse a k8s quantity (str/int/float) into an exact Decimal of base units."""
     if isinstance(value, bool):
         raise InvalidQuantity(f"boolean is not a quantity: {value!r}")
+    if isinstance(value, str):
+        return _parse_decimal_str(value)
     if isinstance(value, (int, float)):
         return Decimal(str(value))
     if value is None:
         return Decimal(0)
-    s = str(value).strip()
+    return _parse_decimal_str(str(value))
+
+
+@lru_cache(maxsize=65536)
+def _parse_decimal_str(value: str) -> Decimal:
+    s = value.strip()
     if not s:
         return Decimal(0)
     m = _QUANT_RE.match(s)
     if not m:
-        raise InvalidQuantity(f"unparseable quantity: {value!r}")
+        raise InvalidQuantity(f"unparseable quantity: {s!r}")
     num = Decimal(m.group("num"))
     if m.group("sign") == "-":
         num = -num

@@ -1,0 +1,109 @@
+"""Synthetic cluster/workload generators for benchmarks and harness dry-runs.
+
+Shapes mirror BASELINE.md's configs (1k nodes / 10k nginx replicas; hard-predicate
+stress with taints + affinities) without copying any reference fixture files.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+
+def synth_node(
+    i: int,
+    cpu_milli: int = 32000,
+    mem_bytes: int = 128 << 30,
+    pods: int = 256,
+    n_zones: int = 0,
+    taint_every: int = 0,
+) -> dict:
+    name = f"node-{i:05d}"
+    labels = {"kubernetes.io/hostname": name, "node-index": str(i)}
+    if n_zones:
+        labels["topology.kubernetes.io/zone"] = f"zone-{i % n_zones}"
+    alloc = {"cpu": f"{cpu_milli}m", "memory": str(mem_bytes), "pods": str(pods)}
+    node = {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": labels},
+        "spec": {},
+        "status": {"allocatable": dict(alloc), "capacity": dict(alloc)},
+    }
+    if taint_every and i % taint_every == 0:
+        node["spec"]["taints"] = [
+            {"key": "synth/dedicated", "value": "batch", "effect": "NoSchedule"}
+        ]
+    return node
+
+
+def synth_pod(
+    i: int,
+    cpu_milli: int = 100,
+    mem_bytes: int = 256 << 20,
+    labels: Optional[dict] = None,
+    tolerate: bool = False,
+    anti_affinity_on: Optional[str] = None,
+    spread_zone: bool = False,
+) -> dict:
+    spec: dict = {
+        "containers": [
+            {
+                "name": "app",
+                "image": "nginx:1.25",
+                "resources": {
+                    "requests": {"cpu": f"{cpu_milli}m", "memory": str(mem_bytes)}
+                },
+            }
+        ]
+    }
+    lbl = {"app": "synth", **(labels or {})}
+    if tolerate:
+        spec["tolerations"] = [
+            {"key": "synth/dedicated", "operator": "Equal", "value": "batch",
+             "effect": "NoSchedule"}
+        ]
+    if anti_affinity_on:
+        spec["affinity"] = {
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "labelSelector": {"matchLabels": {"app": anti_affinity_on}},
+                        "topologyKey": "kubernetes.io/hostname",
+                    }
+                ]
+            }
+        }
+    if spread_zone:
+        spec["topologySpreadConstraints"] = [
+            {
+                "maxSkew": 2,
+                "topologyKey": "topology.kubernetes.io/zone",
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": "synth"}},
+            }
+        ]
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": f"pod-{i:06d}", "namespace": "default", "labels": lbl},
+        "spec": spec,
+    }
+
+
+def synth_cluster(
+    n_nodes: int,
+    n_pods: int,
+    hard_predicates: bool = False,
+) -> Tuple[List[dict], List[dict]]:
+    """(nodes, pods). With hard_predicates, adds zones, a tainted slice of nodes,
+    tolerating pods, and zone topology-spread — BASELINE.md's stress shape."""
+    if hard_predicates:
+        nodes = [synth_node(i, n_zones=8, taint_every=10) for i in range(n_nodes)]
+        pods = [
+            synth_pod(i, tolerate=(i % 3 == 0), spread_zone=True)
+            for i in range(n_pods)
+        ]
+    else:
+        nodes = [synth_node(i) for i in range(n_nodes)]
+        pods = [synth_pod(i) for i in range(n_pods)]
+    return nodes, pods

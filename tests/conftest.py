@@ -4,9 +4,9 @@ without TPU hardware (the driver separately dry-runs `__graft_entry__.dryrun_mul
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from open_simulator_tpu.utils.devices import force_cpu_platform, request_cpu_devices
+
+request_cpu_devices(8)
+force_cpu_platform()
