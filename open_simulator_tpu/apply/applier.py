@@ -1,0 +1,453 @@
+"""The Applier: apply-mode orchestration + capacity planning.
+
+Mirrors /root/reference/pkg/apply/apply.go:
+- config load + validation (NewApplier :61-101, validate :269-306)
+- cluster from customConfig dir or kubeconfig (Run step 1, :114-127)
+- app list from raw YAML dirs or helm charts (step 2, :129-152)
+- newNode template (+ node-name-matched local-storage JSON) (step 3, :156-168)
+- the add-node loop (step 4, :203-259) — interactively prompting like the reference's
+  survey menu, or (non-interactive extension) automatically searching the minimal
+  node count that schedules everything within the MaxCPU/MaxMemory/MaxVG envelope
+  (satisfyResourceSetting :689-775). The reference asks the user for each node count;
+  the auto-search is this build's capacity-planning mode (deviation, documented).
+- report tables (report* :309-687) as plain aligned-text tables instead of pterm.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, TextIO
+
+from ..api.v1alpha1 import ConfigError, SimonConfig, parse_simon_config, validate_config
+from ..core import constants as C
+from ..core.types import AppResource, NodeStatus, ResourceTypes, SimulateResult
+from ..models.fakenode import new_fake_nodes
+from ..simulator.core import simulate
+from ..utils.objutil import annotations_of, labels_of, name_of, namespace_of, pod_resource_requests
+from ..utils.quantity import format_quantity, parse_milli, parse_quantity
+from ..utils.storage import NodeStorage
+from ..utils.yamlio import load_json_files, load_resources_from_directory
+
+MAX_AUTO_NODES = 10_000  # auto-search upper bound before giving up
+
+
+@dataclass
+class Options:
+    simon_config: str = ""
+    default_scheduler_config: str = ""
+    use_greed: bool = False
+    interactive: bool = False
+    extended_resources: List[str] = field(default_factory=list)
+    output_file: str = ""
+
+
+class Applier:
+    def __init__(self, opts: Options) -> None:
+        self.opts = opts
+        self.cfg: SimonConfig = parse_simon_config(opts.simon_config)
+        validate_config(self.cfg, opts.default_scheduler_config)
+        self.out: TextIO = sys.stdout
+        self._close_out = False
+        if opts.output_file:
+            self.out = open(opts.output_file, "w")
+            self._close_out = True
+
+    # ------------------------------------------------------------------ inputs ----
+
+    def _load_cluster(self) -> ResourceTypes:
+        c = self.cfg.spec.cluster
+        if c.kube_config:
+            from ..simulator.live import create_cluster_resource_from_client
+
+            return create_cluster_resource_from_client(c.kube_config)
+        return load_resources_from_directory(c.custom_cluster)
+
+    def _load_apps(self) -> List[AppResource]:
+        apps: List[AppResource] = []
+        for app in self.cfg.spec.app_list:
+            if app.chart:
+                from ..chart.render import process_chart
+
+                docs = process_chart(app.name, app.path)
+                from ..utils.yamlio import bucket_objects
+
+                rt = bucket_objects(docs)
+            else:
+                rt = load_resources_from_directory(app.path)
+            apps.append(AppResource(name=app.name, resource=rt))
+        return apps
+
+    def _load_new_node(self) -> Optional[dict]:
+        path = self.cfg.spec.new_node
+        if not path:
+            return None
+        rt = load_resources_from_directory(path)
+        if not rt.nodes:
+            return None
+        storage = load_json_files(path)
+        node = rt.nodes[0]
+        info = storage.get(name_of(node))
+        if info is not None:
+            node.setdefault("metadata", {}).setdefault("annotations", {})[
+                C.AnnoNodeLocalStorage
+            ] = json.dumps(info)
+        return node
+
+    # ------------------------------------------------------------------- run ------
+
+    def run(self) -> Optional[SimulateResult]:
+        try:
+            return self._run()
+        finally:
+            if self._close_out:
+                self.out.close()
+                self._close_out = False
+
+    def _run(self) -> Optional[SimulateResult]:
+        cluster = self._load_cluster()
+        apps = self._load_apps()
+        if self.opts.interactive:
+            apps = self._select_apps(apps)
+        new_node = self._load_new_node()
+
+        patch_funcs = []
+        if self.opts.use_greed:
+            from ..algo.queues import sort_greed
+
+            def greed_patch(pods, _cluster=cluster):
+                pods[:] = sort_greed(pods, _cluster.nodes)
+
+            patch_funcs.append(greed_patch)
+
+        result, n_added = self._plan(cluster, apps, new_node, patch_funcs)
+        if result is None:
+            return None
+
+        self._println("Simulation success!")
+        if n_added:
+            self._println(f"(added {n_added} node(s) to make everything schedulable)")
+        self.report(result.node_status, [a.name for a in apps])
+        return result
+
+    def _simulate_with(self, cluster, apps, new_node, n, patch_funcs) -> SimulateResult:
+        trial = cluster.copy()
+        trial.nodes = list(trial.nodes) + new_fake_nodes(new_node, n)
+        return simulate(trial, apps, patch_pod_funcs=patch_funcs)
+
+    def _plan(self, cluster, apps, new_node, patch_funcs):
+        """Returns (result, nodes_added) or (None, 0) when the user exits / search
+        fails. Interactive: the reference's survey loop. Non-interactive: auto-search
+        the minimal node count (doubling + binary search; each probe is one full
+        simulation, as in the reference's re-simulate-per-iteration loop)."""
+        if self.opts.interactive:
+            return self._plan_interactive(cluster, apps, new_node, patch_funcs)
+
+        def ok(res: SimulateResult) -> bool:
+            satisfied, _ = satisfy_resource_setting(res.node_status)
+            return not res.unscheduled_pods and satisfied
+
+        res = self._simulate_with(cluster, apps, new_node, 0, patch_funcs)
+        if ok(res):
+            return res, 0
+        if new_node is None:
+            for up in res.unscheduled_pods:
+                self._println(f"  {namespace_of(up.pod)}/{name_of(up.pod)}: {up.reason}")
+            self._println(
+                f"{len(res.unscheduled_pods)} pod(s) unschedulable and no newNode "
+                "spec configured; cannot add capacity"
+            )
+            return None, 0
+
+        fails = {0: len(res.unscheduled_pods)}
+        lo, hi, res_hi = 0, 1, None
+        while hi <= MAX_AUTO_NODES:
+            res_hi = self._simulate_with(cluster, apps, new_node, hi, patch_funcs)
+            if ok(res_hi):
+                break
+            fails[hi] = len(res_hi.unscheduled_pods)
+            # Give up when 4x capacity brought no progress: the remaining pods fail
+            # for reasons new nodes cannot fix (bad selectors, impossible affinity).
+            ref = fails.get(max(hi // 4, 0))
+            if hi >= 4 and ref is not None and fails[hi] >= ref > 0:
+                for up in res_hi.unscheduled_pods:
+                    self._println(f"  {namespace_of(up.pod)}/{name_of(up.pod)}: {up.reason}")
+                self._println(
+                    f"{fails[hi]} pod(s) still unschedulable after adding {hi} "
+                    "nodes with no improvement; they cannot be fixed by capacity"
+                )
+                return None, 0
+            lo, hi = hi, hi * 2
+        else:
+            self._println(f"gave up after {MAX_AUTO_NODES} added nodes")
+            return None, 0
+
+        best_n, best = hi, res_hi
+        while lo + 1 < hi:
+            mid = (lo + hi) // 2
+            res_mid = self._simulate_with(cluster, apps, new_node, mid, patch_funcs)
+            if ok(res_mid):
+                hi, best_n, best = mid, mid, res_mid
+            else:
+                lo = mid
+        return best, best_n
+
+    def _plan_interactive(self, cluster, apps, new_node, patch_funcs):
+        n = 0
+        res = self._simulate_with(cluster, apps, new_node, n, patch_funcs)
+        while True:
+            satisfied, reason = satisfy_resource_setting(res.node_status)
+            if not res.unscheduled_pods and satisfied:
+                return res, n
+            if not res.unscheduled_pods:
+                self._println(reason)
+            msg = (
+                f"there are still {len(res.unscheduled_pods)} pod(s) that can not be "
+                f"scheduled when add {n} nodes, you can:"
+            )
+            choice = self._ask(
+                msg,
+                ["show error event of unscheduled pods", "add node(s)", "exit"],
+            )
+            if choice == 0:
+                for i, up in enumerate(res.unscheduled_pods):
+                    self._println(
+                        f"{i:4d} {namespace_of(up.pod)}/{name_of(up.pod)}: {up.reason}"
+                    )
+                continue  # no re-simulation, like the reference's SurveyShowResults
+            if choice == 1:
+                try:
+                    n = int(input("input node number: "))
+                except (ValueError, EOFError):
+                    n = 0
+                res = self._simulate_with(cluster, apps, new_node, n, patch_funcs)
+                continue
+            return None, 0
+
+    def _select_apps(self, apps: List[AppResource]) -> List[AppResource]:
+        if not apps:
+            return apps
+        self._println("Confirm your apps (comma-separated indices, empty = all):")
+        for i, a in enumerate(apps):
+            self._println(f"  [{i}] {a.name}")
+        try:
+            line = input("> ").strip()
+        except EOFError:
+            return apps
+        if not line:
+            return apps
+        picked = []
+        for tok in line.split(","):
+            tok = tok.strip()
+            if tok.isdigit() and int(tok) < len(apps):
+                picked.append(apps[int(tok)])
+        return picked or apps
+
+    def _ask(self, msg: str, options: List[str]) -> int:
+        self._println(msg)
+        for i, o in enumerate(options):
+            self._println(f"  [{i}] {o}")
+        try:
+            line = input("> ").strip()
+        except EOFError:
+            return len(options) - 1  # exit
+        return int(line) if line.isdigit() and int(line) < len(options) else 0
+
+    # ----------------------------------------------------------------- report -----
+
+    def _println(self, s: str = "") -> None:
+        print(s, file=self.out)
+
+    def report(self, node_statuses: List[NodeStatus], app_names: List[str]) -> None:
+        ext = self.opts.extended_resources
+        self._report_cluster(node_statuses, ext)
+        self._report_apps(node_statuses, app_names)
+
+    def _report_cluster(self, node_statuses: List[NodeStatus], ext: List[str]) -> None:
+        self._println("Node Info")
+        header = ["Node", "CPU Allocatable", "CPU Requests", "Memory Allocatable",
+                  "Memory Requests", "Pod Count", "New Node"]
+        rows = [header]
+        for st in node_statuses:
+            alloc = (st.node.get("status") or {}).get("allocatable") or {}
+            cpu_alloc = parse_milli(alloc.get("cpu", 0))
+            mem_alloc = parse_quantity(alloc.get("memory", 0))
+            cpu_req = sum(pod_resource_requests(p).get("cpu", 0.0) for p in st.pods)
+            mem_req = sum(pod_resource_requests(p).get("memory", 0.0) for p in st.pods)
+            cpu_frac = int(cpu_req / cpu_alloc * 100) if cpu_alloc else 0
+            mem_frac = int(mem_req / mem_alloc * 100) if mem_alloc else 0
+            is_new = "√" if C.LabelNewNode in labels_of(st.node) else ""
+            rows.append([
+                name_of(st.node),
+                _fmt_cpu(cpu_alloc),
+                f"{_fmt_cpu(cpu_req)}({cpu_frac}%)",
+                format_quantity(mem_alloc, binary=True),
+                f"{format_quantity(mem_req, binary=True)}({mem_frac}%)",
+                str(len(st.pods)),
+                is_new,
+            ])
+        self._render_table(rows)
+        self._println()
+        if any("open-local" in e for e in ext):
+            self._report_local_storage(node_statuses)
+        if any("gpu" in e for e in ext):
+            self._report_gpu(node_statuses)
+
+    def _report_local_storage(self, node_statuses: List[NodeStatus]) -> None:
+        self._println("Node Local Storage")
+        rows = [["Node", "Storage Kind", "Storage Name", "Storage Allocatable",
+                 "Storage Requests"]]
+        for st in node_statuses:
+            raw = annotations_of(st.node).get(C.AnnoNodeLocalStorage)
+            if not raw:
+                continue
+            try:
+                storage = NodeStorage.from_json(raw)
+            except (json.JSONDecodeError, TypeError):
+                continue
+            for vg in storage.vgs:
+                pct = int(vg.requested / vg.capacity * 100) if vg.capacity else 0
+                rows.append([name_of(st.node), "VG", vg.name,
+                             format_quantity(vg.capacity, binary=True),
+                             f"{format_quantity(vg.requested, binary=True)}({pct}%)"])
+            for dev in storage.devices:
+                rows.append([name_of(st.node), f"Device({dev.media_type})",
+                             dev.device,
+                             format_quantity(dev.capacity, binary=True),
+                             "used" if dev.is_allocated else "unused"])
+        self._render_table(rows)
+        self._println()
+
+    def _report_gpu(self, node_statuses: List[NodeStatus]) -> None:
+        self._println("GPU Node Resource")
+        rows = [["Node", "GPU ID", "GPU Request/Capacity", "Pod List"]]
+        for st in node_statuses:
+            raw = annotations_of(st.node).get(C.AnnoNodeGpuShare)
+            if not raw:
+                continue
+            try:
+                info = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            total = info.get("gpuTotalMemory", 0)
+            used = sum(_pod_gpu_mem(p) for p in st.pods)
+            pct = int(used / total * 100) if total else 0
+            rows.append([
+                f"{name_of(st.node)} ({info.get('gpuModel', '')})",
+                f"{info.get('gpuCount', 0)} GPUs",
+                f"{format_quantity(used, binary=True)}/"
+                f"{format_quantity(total, binary=True)}({pct}%)",
+                f"{info.get('numPods', 0)} Pods",
+            ])
+            def _dev_key(kv):
+                k = kv[0]
+                return (0, int(k)) if str(k).isdigit() else (1, str(k))
+
+            for idx, dev in sorted((info.get("devs") or {}).items(), key=_dev_key):
+                dcap = dev.get("gpuTotalMemory", 0)
+                if dcap <= 0:
+                    continue
+                duse = dev.get("gpuUsedMemory", 0)
+                dpct = int(duse / dcap * 100) if dcap else 0
+                rows.append([
+                    f"{name_of(st.node)} ({info.get('gpuModel', '')})",
+                    str(idx),
+                    f"{format_quantity(duse, binary=True)}/"
+                    f"{format_quantity(dcap, binary=True)}({dpct}%)",
+                    ", ".join(dev.get("podList") or []),
+                ])
+        self._render_table(rows)
+        self._println()
+
+    def _report_apps(self, node_statuses: List[NodeStatus], app_names: List[str]) -> None:
+        self._println("App Info")
+        rows = [["App", "Pod Count", "Nodes"]]
+        for app in app_names:
+            nodes: Dict[str, int] = {}
+            count = 0
+            for st in node_statuses:
+                for p in st.pods:
+                    if labels_of(p).get(C.LabelAppName) == app:
+                        count += 1
+                        nodes[name_of(st.node)] = nodes.get(name_of(st.node), 0) + 1
+            spread = ", ".join(f"{k}({v})" for k, v in sorted(nodes.items()))
+            rows.append([app, str(count), spread])
+        self._render_table(rows)
+        self._println()
+
+    def _render_table(self, rows: List[List[str]]) -> None:
+        if not rows:
+            return
+        widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+        for r in rows:
+            self._println("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+
+
+def _fmt_cpu(milli: float) -> str:
+    """CPU quantities print in cores when whole, else milli (resource.Quantity.String)."""
+    if milli % 1000 == 0:
+        return str(int(milli // 1000))
+    return f"{int(milli)}m"
+
+
+def _pod_gpu_mem(pod: dict) -> float:
+    anns = annotations_of(pod)
+    try:
+        mem = float(anns.get(C.AnnoGpuMem, 0))
+        cnt = float(anns.get(C.AnnoGpuCount, 1) or 1)
+    except ValueError:
+        return 0.0
+    return mem * max(cnt, 1)
+
+
+def satisfy_resource_setting(node_statuses: List[NodeStatus]):
+    """satisfyResourceSetting (apply.go:689-775): average cpu/mem (and local-storage
+    VG) occupancy must not exceed the MaxCPU/MaxMemory/MaxVG env percentages."""
+    def env_pct(name: str) -> int:
+        s = os.environ.get(name, "")
+        if not s:
+            return 100
+        try:
+            v = int(s)
+        except ValueError:
+            raise ConfigError(f"failed to convert env {name} to int: {s!r}")
+        return v if 0 <= v <= 100 else 100
+
+    maxcpu, maxmem, maxvg = env_pct(C.EnvMaxCPU), env_pct(C.EnvMaxMemory), env_pct(C.EnvMaxVG)
+
+    cpu_alloc = mem_alloc = cpu_used = mem_used = 0.0
+    vg_cap = vg_req = 0.0
+    for st in node_statuses:
+        alloc = (st.node.get("status") or {}).get("allocatable") or {}
+        cpu_alloc += parse_milli(alloc.get("cpu", 0))
+        mem_alloc += parse_quantity(alloc.get("memory", 0))
+        for p in st.pods:
+            req = pod_resource_requests(p)
+            cpu_used += req.get("cpu", 0.0)
+            mem_used += req.get("memory", 0.0)
+        raw = annotations_of(st.node).get(C.AnnoNodeLocalStorage)
+        if raw:
+            try:
+                storage = NodeStorage.from_json(raw)
+            except (json.JSONDecodeError, TypeError):
+                return False, f"error when unmarshal json data, node is {name_of(st.node)}"
+            for vg in storage.vgs:
+                vg_cap += vg.capacity
+                vg_req += vg.requested
+
+    cpu_rate = int(cpu_used / cpu_alloc * 100) if cpu_alloc else 0
+    mem_rate = int(mem_used / mem_alloc * 100) if mem_alloc else 0
+    if cpu_rate > maxcpu:
+        return False, (f"the average occupancy rate({cpu_rate}%) of cpu goes beyond "
+                       f"the env setting({maxcpu}%)")
+    if mem_rate > maxmem:
+        return False, (f"the average occupancy rate({mem_rate}%) of memory goes "
+                       f"beyond the env setting({maxmem}%)")
+    if vg_cap:
+        vg_rate = int(vg_req / vg_cap * 100)
+        if vg_rate > maxvg:
+            return False, (f"the average occupancy rate({vg_rate}%) of vg goes "
+                           f"beyond the env setting({maxvg}%)")
+    return True, ""

@@ -1,0 +1,662 @@
+"""A Go text/template + sprig subset interpreter, sized for rendering Helm charts.
+
+The reference renders charts with helm.sh/helm/v3's engine
+(/root/reference/pkg/chart/chart.go:81). No helm binary or Go runtime exists in this
+environment, so this module interprets the template constructs real-world charts use:
+
+- actions: `{{ expr }}` with `{{-`/`-}}` whitespace trimming
+- control: if/else if/else, range (lists + dicts, with `$i, $v :=` forms), with,
+  define/include/template, end
+- data: .Values / .Release / .Chart / .Capabilities paths, `$` root, variables
+  (`$x := ...`), string/int/float/bool literals
+- pipelines `a | f b | g` and ~40 sprig/builtin functions (default, quote, toYaml,
+  nindent, printf, trunc, contains, semverCompare-lite, dict/list helpers, ...)
+
+Unsupported constructs raise TemplateError with the template name/offset so chart
+authorship bugs surface clearly instead of silently mis-rendering.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import yaml
+
+
+class TemplateError(ValueError):
+    pass
+
+
+# ------------------------------------------------------------------ lexing ----------
+
+_ACTION_RE = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}", re.S)
+
+
+def _tokenize(src: str, name: str) -> List[Tuple[str, Any]]:
+    """[('text', str) | ('action', (code, trim_before, trim_after))]."""
+    out: List[Tuple[str, Any]] = []
+    pos = 0
+    for m in _ACTION_RE.finditer(src):
+        if m.start() > pos:
+            out.append(("text", src[pos : m.start()]))
+        raw = src[m.start() : m.end()]
+        trim_before = raw.startswith("{{-")
+        trim_after = raw.endswith("-}}")
+        out.append(("action", (m.group(1), trim_before, trim_after)))
+        pos = m.end()
+    if pos < len(src):
+        out.append(("text", src[pos:]))
+    return out
+
+
+# ----------------------------------------------------------------- parsing ----------
+
+
+class Node:
+    pass
+
+
+class Text(Node):
+    def __init__(self, s: str) -> None:
+        self.s = s
+
+
+class Action(Node):
+    def __init__(self, code: str) -> None:
+        self.code = code
+
+
+class If(Node):
+    def __init__(self) -> None:
+        self.branches: List[Tuple[Optional[str], List[Node]]] = []  # (cond, body); None=else
+
+
+class Range(Node):
+    def __init__(self, code: str) -> None:
+        self.code = code
+        self.body: List[Node] = []
+        self.else_body: List[Node] = []
+
+
+class With(Node):
+    def __init__(self, code: str) -> None:
+        self.code = code
+        self.body: List[Node] = []
+        self.else_body: List[Node] = []
+
+
+class Define(Node):
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.body: List[Node] = []
+
+
+_KEYWORD_RE = re.compile(r"^(if|else if|else|range|with|define|block|template|include|end)\b\s*(.*)$", re.S)
+
+
+def _parse(tokens: List[Tuple[str, Any]], name: str) -> Tuple[List[Node], Dict[str, List[Node]]]:
+    defines: Dict[str, List[Node]] = {}
+    root: List[Node] = []
+    # stack of (node_list_to_append_to, owner) frames
+    stack: List[Tuple[List[Node], Any]] = [(root, None)]
+
+    # apply whitespace trimming first: walk tokens, mutate neighboring text
+    toks = [list(t) for t in tokens]
+    for i, t in enumerate(toks):
+        if t[0] != "action":
+            continue
+        code, tb, ta = t[1]
+        if tb and i > 0 and toks[i - 1][0] == "text":
+            toks[i - 1][1] = toks[i - 1][1].rstrip(" \t").rstrip("\n\r\t ")
+        if ta and i + 1 < len(toks) and toks[i + 1][0] == "text":
+            toks[i + 1][1] = toks[i + 1][1].lstrip(" \t").lstrip("\n\r\t ")
+
+    for t in toks:
+        if t[0] == "text":
+            if t[1]:
+                stack[-1][0].append(Text(t[1]))
+            continue
+        code = t[1][0].strip()
+        if code.startswith("/*") and code.endswith("*/"):
+            continue  # comment
+        m = _KEYWORD_RE.match(code)
+        if not m:
+            stack[-1][0].append(Action(code))
+            continue
+        kw, rest = m.group(1), m.group(2).strip()
+        if kw == "if":
+            node = If()
+            node.branches.append((rest, []))
+            stack[-1][0].append(node)
+            stack.append((node.branches[-1][1], node))
+        elif kw == "else if":
+            owner = stack[-1][1]
+            if not isinstance(owner, If):
+                raise TemplateError(f"{name}: 'else if' outside if")
+            stack.pop()
+            owner.branches.append((rest, []))
+            stack.append((owner.branches[-1][1], owner))
+        elif kw == "else":
+            owner = stack[-1][1]
+            stack.pop()
+            if isinstance(owner, If):
+                owner.branches.append((None, []))
+                stack.append((owner.branches[-1][1], owner))
+            elif isinstance(owner, (Range, With)):
+                stack.append((owner.else_body, owner))
+            else:
+                raise TemplateError(f"{name}: 'else' outside if/range/with")
+        elif kw == "range":
+            node = Range(rest)
+            stack[-1][0].append(node)
+            stack.append((node.body, node))
+        elif kw == "with":
+            node = With(rest)
+            stack[-1][0].append(node)
+            stack.append((node.body, node))
+        elif kw in ("define", "block"):
+            tpl_name = rest.strip().strip('"')
+            node = Define(tpl_name)
+            defines[tpl_name] = node.body
+            stack.append((node.body, node))
+        elif kw in ("template", "include"):
+            stack[-1][0].append(Action(f"{kw} {rest}"))
+        elif kw == "end":
+            if len(stack) == 1:
+                raise TemplateError(f"{name}: unbalanced 'end'")
+            stack.pop()
+    if len(stack) != 1:
+        raise TemplateError(f"{name}: missing 'end'")
+    return root, defines
+
+
+# -------------------------------------------------------------- expressions ---------
+
+_TOKEN_EXPR = re.compile(
+    r"""
+    \s*(?:
+      (?P<pipe>\|)
+    | (?P<lparen>\()
+    | (?P<rparen>\))
+    | (?P<str>"(?:\\.|[^"\\])*"|`[^`]*`)
+    | (?P<num>-?\d+\.\d+|-?\d+)
+    | (?P<rootpath>\$\.[A-Za-z0-9_.]*)
+    | (?P<varpath>\$[A-Za-z0-9_]+\.[A-Za-z0-9_.]+)
+    | (?P<var>\$[A-Za-z0-9_]*)
+    | (?P<path>\.[A-Za-z0-9_.]*)
+    | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+    | (?P<assign>:=|=)
+    | (?P<comma>,)
+    )
+    """,
+    re.X,
+)
+
+
+def _lex_expr(code: str, name: str) -> List[Tuple[str, str]]:
+    toks = []
+    i = 0
+    while i < len(code):
+        m = _TOKEN_EXPR.match(code, i)
+        if not m or m.end() == i:
+            if code[i:].strip() == "":
+                break
+            raise TemplateError(f"{name}: cannot lex expression {code[i:]!r}")
+        i = m.end()
+        for kind in ("pipe", "lparen", "rparen", "str", "num", "rootpath", "varpath",
+                     "var", "path", "ident", "assign", "comma"):
+            v = m.group(kind)
+            if v is not None:
+                toks.append((kind, v))
+                break
+    return toks
+
+
+class _Ctx:
+    def __init__(self, root: Any, defines: Dict[str, List[Node]], funcs, name: str) -> None:
+        self.root = root
+        self.defines = defines
+        self.funcs = funcs
+        self.name = name
+        self.vars: Dict[str, Any] = {}
+
+
+def _resolve_path(dot: Any, root: Any, path: str):
+    """Resolve `.a.b.c` against dot ('.': dot itself). Missing keys yield None,
+    matching template nil semantics."""
+    cur = dot if not path.startswith(".$") else root
+    if path == ".":
+        return dot
+    for part in path.lstrip(".").split("."):
+        if not part:
+            continue
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        else:
+            cur = getattr(cur, part, None)
+        if cur is None:
+            return None
+    return cur
+
+
+def _truthy(v: Any) -> bool:
+    if v is None or v is False:
+        return False
+    if isinstance(v, (str, list, dict, tuple)):
+        return len(v) > 0
+    if isinstance(v, (int, float)):
+        return v != 0
+    return True
+
+
+class _Evaluator:
+    def __init__(self, ctx: _Ctx, dot: Any) -> None:
+        self.ctx = ctx
+        self.dot = dot
+
+    def eval(self, code: str) -> Any:
+        toks = _lex_expr(code, self.ctx.name)
+        # variable assignment: $x := expr
+        if len(toks) >= 2 and toks[0][0] == "var" and toks[1][0] == "assign":
+            val = self._eval_pipeline(toks[2:])
+            self.ctx.vars[toks[0][1]] = val
+            return ""
+        return self._eval_pipeline(toks)
+
+    def _eval_pipeline(self, toks: List[Tuple[str, str]]) -> Any:
+        stages: List[List[Tuple[str, str]]] = [[]]
+        depth = 0
+        for t in toks:
+            if t[0] == "pipe" and depth == 0:
+                stages.append([])
+                continue
+            if t[0] == "lparen":
+                depth += 1
+            elif t[0] == "rparen":
+                depth -= 1
+            stages[-1].append(t)
+        val = None
+        first = True
+        for stage in stages:
+            if not stage:
+                raise TemplateError(f"{self.ctx.name}: empty pipeline stage")
+            if first:
+                val = self._eval_call(stage, piped=None)
+                first = False
+            else:
+                val = self._eval_call(stage, piped=val)
+        return val
+
+    def _eval_call(self, toks: List[Tuple[str, str]], piped) -> Any:
+        args: List[Any] = []
+        i = 0
+        fname: Optional[str] = None
+        if toks and toks[0][0] == "ident":
+            fname = toks[0][1]
+            i = 1
+        while i < len(toks):
+            kind, v = toks[i]
+            if kind == "lparen":
+                depth, j = 1, i + 1
+                while j < len(toks) and depth:
+                    if toks[j][0] == "lparen":
+                        depth += 1
+                    elif toks[j][0] == "rparen":
+                        depth -= 1
+                    j += 1
+                args.append(self._eval_pipeline(toks[i + 1 : j - 1]))
+                i = j
+                continue
+            if kind == "str":
+                s = v[1:-1]
+                if v[0] == '"':
+                    s = bytes(s, "utf-8").decode("unicode_escape")
+                args.append(s)
+            elif kind == "num":
+                args.append(float(v) if "." in v else int(v))
+            elif kind == "rootpath":
+                args.append(_resolve_path(self.ctx.root, self.ctx.root, v[1:]))
+            elif kind == "varpath":
+                var, _, rest = v.partition(".")
+                base = self.ctx.root if var == "$" else self.ctx.vars.get(var)
+                args.append(_resolve_path(base, self.ctx.root, "." + rest))
+            elif kind == "var":
+                if v == "$":
+                    args.append(self.ctx.root)
+                else:
+                    args.append(self.ctx.vars.get(v))
+            elif kind == "path":
+                args.append(_resolve_path(self.dot, self.ctx.root, v))
+            elif kind == "ident":
+                kwmap = {"true": True, "false": False, "nil": None}
+                if v in kwmap:
+                    args.append(kwmap[v])
+                else:
+                    raise TemplateError(f"{self.ctx.name}: bare identifier {v!r} mid-args")
+            elif kind == "comma":
+                pass
+            else:
+                raise TemplateError(f"{self.ctx.name}: unexpected token {v!r}")
+            i += 1
+
+        if fname is None:
+            if piped is not None:
+                raise TemplateError(f"{self.ctx.name}: pipeline into non-function")
+            if len(args) != 1:
+                raise TemplateError(f"{self.ctx.name}: expected single value, got {args!r}")
+            return args[0]
+        if piped is not None:
+            args.append(piped)
+        fn = self.ctx.funcs.get(fname)
+        if fn is None:
+            raise TemplateError(f"{self.ctx.name}: unknown function {fname!r}")
+        try:
+            return fn(self, *args)
+        except TemplateError:
+            raise
+        except Exception as e:
+            raise TemplateError(
+                f"{self.ctx.name}: {fname}({', '.join(map(repr, args))}): {e}"
+            ) from e
+
+
+# ---------------------------------------------------------------- rendering ---------
+
+
+def _to_yaml(v: Any) -> str:
+    return yaml.safe_dump(v, default_flow_style=False, sort_keys=False).rstrip("\n")
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return ""
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return str(v)
+
+
+def _go_printf(fmt: str, *args) -> str:
+    out, ai = [], 0
+    i = 0
+    while i < len(fmt):
+        c = fmt[i]
+        if c == "%" and i + 1 < len(fmt):
+            spec = fmt[i + 1]
+            if spec == "%":
+                out.append("%")
+            elif spec in "sdvq":
+                a = args[ai] if ai < len(args) else ""
+                ai += 1
+                if spec == "q":
+                    out.append(json.dumps(_fmt(a)))
+                elif spec == "d":
+                    out.append(str(int(a)))
+                else:
+                    out.append(_fmt(a))
+            else:
+                out.append(c + spec)
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _builtin_funcs() -> Dict[str, Callable]:
+    def f(fn):
+        return lambda ev, *a: fn(*a)
+
+    funcs: Dict[str, Callable] = {
+        # logic / comparison (Go template builtins)
+        "eq": f(lambda a, b, *r: a == b or any(a == x for x in r)),
+        "ne": f(lambda a, b: a != b),
+        "lt": f(lambda a, b: a < b),
+        "le": f(lambda a, b: a <= b),
+        "gt": f(lambda a, b: a > b),
+        "ge": f(lambda a, b: a >= b),
+        "and": f(lambda *a: next((x for x in a if not _truthy(x)), a[-1] if a else None)),
+        "or": f(lambda *a: next((x for x in a if _truthy(x)), a[-1] if a else None)),
+        "not": f(lambda a: not _truthy(a)),
+        "len": f(lambda a: len(a) if a is not None else 0),
+        "index": f(lambda c, *ks: _index(c, ks)),
+        "print": f(lambda *a: "".join(_fmt(x) for x in a)),
+        "printf": f(_go_printf),
+        # sprig: strings
+        "quote": f(lambda *a: " ".join(json.dumps(_fmt(x)) for x in a)),
+        "squote": f(lambda *a: " ".join("'" + _fmt(x) + "'" for x in a)),
+        "upper": f(lambda s: _fmt(s).upper()),
+        "lower": f(lambda s: _fmt(s).lower()),
+        "title": f(lambda s: _fmt(s).title()),
+        "trim": f(lambda s: _fmt(s).strip()),
+        "trimSuffix": f(lambda suf, s: _fmt(s)[: -len(suf)] if _fmt(s).endswith(suf) else _fmt(s)),
+        "trimPrefix": f(lambda pre, s: _fmt(s)[len(pre):] if _fmt(s).startswith(pre) else _fmt(s)),
+        "trunc": f(lambda n, s: _fmt(s)[:n] if n >= 0 else _fmt(s)[n:]),
+        "replace": f(lambda old, new, s: _fmt(s).replace(old, new)),
+        "contains": f(lambda sub, s: sub in _fmt(s)),
+        "hasPrefix": f(lambda pre, s: _fmt(s).startswith(pre)),
+        "hasSuffix": f(lambda suf, s: _fmt(s).endswith(suf)),
+        "repeat": f(lambda n, s: _fmt(s) * n),
+        "join": f(lambda sep, lst: sep.join(_fmt(x) for x in (lst or []))),
+        "split": f(lambda sep, s: {str(i): p for i, p in enumerate(_fmt(s).split(sep))}),
+        "splitList": f(lambda sep, s: _fmt(s).split(sep)),
+        "nospace": f(lambda s: re.sub(r"\s+", "", _fmt(s))),
+        "snakecase": f(lambda s: re.sub(r"(?<=[a-z0-9])([A-Z])", r"_\1", _fmt(s)).lower()),
+        "kebabcase": f(lambda s: re.sub(r"(?<=[a-z0-9])([A-Z])", r"-\1", _fmt(s)).lower()),
+        "camelcase": f(lambda s: "".join(w.title() for w in re.split(r"[_-]", _fmt(s)))),
+        "indent": f(lambda n, s: "\n".join(" " * n + l if l else l for l in _fmt(s).split("\n"))),
+        "nindent": f(lambda n, s: "\n" + "\n".join(" " * n + l if l else l for l in _fmt(s).split("\n"))),
+        # sprig: defaults & type
+        "default": f(lambda d, v=None: v if _truthy(v) else d),
+        "empty": f(lambda v: not _truthy(v)),
+        "coalesce": f(lambda *a: next((x for x in a if _truthy(x)), None)),
+        "ternary": f(lambda t, fv, c: t if _truthy(c) else fv),
+        "toString": f(_fmt),
+        "toJson": f(lambda v: json.dumps(v)),
+        "toYaml": f(_to_yaml),
+        "fromYaml": f(lambda s: yaml.safe_load(s) or {}),
+        "toToml": f(lambda v: _to_yaml(v)),  # close enough for value passthrough
+        "int": f(lambda v: int(float(v)) if v not in (None, "") else 0),
+        "int64": f(lambda v: int(float(v)) if v not in (None, "") else 0),
+        "float64": f(lambda v: float(v) if v not in (None, "") else 0.0),
+        "b64enc": f(lambda s: __import__("base64").b64encode(_fmt(s).encode()).decode()),
+        "b64dec": f(lambda s: __import__("base64").b64decode(_fmt(s)).decode()),
+        "sha256sum": f(lambda s: __import__("hashlib").sha256(_fmt(s).encode()).hexdigest()),
+        # sprig: math
+        "add": f(lambda *a: sum(int(x) for x in a)),
+        "add1": f(lambda a: int(a) + 1),
+        "sub": f(lambda a, b: int(a) - int(b)),
+        "mul": f(lambda *a: __import__("math").prod(int(x) for x in a)),
+        "div": f(lambda a, b: int(int(a) / int(b))),
+        "mod": f(lambda a, b: int(a) % int(b)),
+        "max": f(lambda *a: max(int(x) for x in a)),
+        "min": f(lambda *a: min(int(x) for x in a)),
+        # sprig: collections
+        "list": f(lambda *a: list(a)),
+        "dict": f(lambda *a: {str(a[i]): a[i + 1] for i in range(0, len(a) - 1, 2)}),
+        "get": f(lambda d, k: (d or {}).get(k)),
+        "set": f(lambda d, k, v: ({**(d or {}), k: v})),
+        "hasKey": f(lambda d, k: k in (d or {})),
+        "keys": f(lambda d: list((d or {}).keys())),
+        "values": f(lambda d: list((d or {}).values())),
+        "pluck": f(lambda k, *ds: [d[k] for d in ds if isinstance(d, dict) and k in d]),
+        "merge": f(_merge),
+        "mergeOverwrite": f(lambda dst, *srcs: _merge(dst, *srcs, overwrite=True)),
+        "deepCopy": f(lambda v: json.loads(json.dumps(v))),
+        "first": f(lambda lst: (lst or [None])[0]),
+        "last": f(lambda lst: (lst or [None])[-1]),
+        "rest": f(lambda lst: (lst or [])[1:]),
+        "initial": f(lambda lst: (lst or [])[:-1]),
+        "append": f(lambda lst, v: list(lst or []) + [v]),
+        "prepend": f(lambda lst, v: [v] + list(lst or [])),
+        "concat": f(lambda *ls: [x for l in ls for x in (l or [])]),
+        "uniq": f(lambda lst: list(dict.fromkeys(lst or []))),
+        "without": f(lambda lst, *xs: [v for v in (lst or []) if v not in xs]),
+        "has": f(lambda v, lst: v in (lst or [])),
+        "sortAlpha": f(lambda lst: sorted(_fmt(x) for x in (lst or []))),
+        "reverse": f(lambda lst: list(reversed(lst or []))),
+        "until": f(lambda n: list(range(int(n)))),
+        "untilStep": f(lambda a, b, s: list(range(int(a), int(b), int(s)))),
+        "seq": f(lambda a, b=None: list(range(1, int(a) + 1)) if b is None else list(range(int(a), int(b) + 1))),
+        # misc chart helpers
+        "required": f(lambda msg, v: v if v is not None else (_ for _ in ()).throw(TemplateError(msg))),
+        "fail": f(lambda msg: (_ for _ in ()).throw(TemplateError(msg))),
+        "lookup": f(lambda *a: {}),  # cluster lookups resolve to empty, like helm template
+        "tpl": _tpl,
+        "include": _include,
+        "template": _include,
+        "randAlphaNum": f(lambda n: "x" * int(n)),  # deterministic: templates must not be random
+        "now": f(lambda: "1970-01-01T00:00:00Z"),
+        "uuidv4": f(lambda: "00000000-0000-4000-8000-000000000000"),
+        "semverCompare": f(_semver_compare),
+        "kindIs": f(lambda kind, v: _kind_of(v) == kind),
+        "typeOf": f(lambda v: _kind_of(v)),
+        "regexMatch": f(lambda pat, s: bool(re.search(pat, _fmt(s)))),
+        "regexReplaceAll": f(lambda pat, s, repl: re.sub(pat, repl.replace("$", "\\"), _fmt(s))),
+    }
+    return funcs
+
+
+def _index(c, ks):
+    cur = c
+    for k in ks:
+        if cur is None:
+            return None
+        if isinstance(cur, dict):
+            cur = cur.get(k)
+        elif isinstance(cur, (list, tuple)):
+            cur = cur[int(k)] if 0 <= int(k) < len(cur) else None
+        else:
+            return None
+    return cur
+
+
+def _merge(dst, *srcs, overwrite=False):
+    out = dict(dst or {})
+    for src in srcs:
+        for k, v in (src or {}).items():
+            if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+                out[k] = _merge(out[k], v, overwrite=overwrite)
+            elif overwrite or k not in out or not _truthy(out[k]):
+                out[k] = v
+    return out
+
+
+def _kind_of(v) -> str:
+    if isinstance(v, bool):
+        return "bool"
+    if isinstance(v, int):
+        return "int64"
+    if isinstance(v, float):
+        return "float64"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, list):
+        return "slice"
+    if isinstance(v, dict):
+        return "map"
+    return "invalid"
+
+
+def _semver_cmp_key(v: str):
+    return [int(x) for x in re.findall(r"\d+", v)[:3]] or [0]
+
+
+def _semver_compare(constraint: str, version: str) -> bool:
+    m = re.match(r"^\s*(>=|<=|>|<|=|\^|~)?\s*v?(.*)$", constraint.strip())
+    op = m.group(1) or "="
+    a, b = _semver_cmp_key(version), _semver_cmp_key(m.group(2))
+    if op in ("=", "^", "~"):
+        return a[:1] == b[:1] if op == "^" else (a[:2] == b[:2] if op == "~" else a == b)
+    return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b}[op]
+
+
+def _include(ev: "_Evaluator", name: str, dot=None) -> str:
+    body = ev.ctx.defines.get(name)
+    if body is None:
+        raise TemplateError(f"{ev.ctx.name}: include of undefined template {name!r}")
+    return _render_nodes(body, ev.ctx, dot if dot is not None else ev.dot)
+
+
+def _tpl(ev: "_Evaluator", src: str, dot=None) -> str:
+    dot = dot if dot is not None else ev.dot
+    nodes, defs = _parse(_tokenize(src, "tpl"), "tpl")
+    sub = _Ctx(ev.ctx.root, {**ev.ctx.defines, **defs}, ev.ctx.funcs, ev.ctx.name + ":tpl")
+    sub.vars = ev.ctx.vars
+    return _render_nodes(nodes, sub, dot)
+
+
+def _render_nodes(nodes: List[Node], ctx: _Ctx, dot: Any) -> str:
+    out: List[str] = []
+    for node in nodes:
+        if isinstance(node, Text):
+            out.append(node.s)
+        elif isinstance(node, Action):
+            ev = _Evaluator(ctx, dot)
+            out.append(_fmt(ev.eval(node.code)))
+        elif isinstance(node, If):
+            for cond, body in node.branches:
+                if cond is None or _truthy(_Evaluator(ctx, dot).eval(cond)):
+                    out.append(_render_nodes(body, ctx, dot))
+                    break
+        elif isinstance(node, With):
+            v = _Evaluator(ctx, dot).eval(node.code)
+            if _truthy(v):
+                out.append(_render_nodes(node.body, ctx, v))
+            else:
+                out.append(_render_nodes(node.else_body, ctx, dot))
+        elif isinstance(node, Range):
+            out.append(_render_range(node, ctx, dot))
+        elif isinstance(node, Define):
+            pass
+        else:  # pragma: no cover
+            raise TemplateError(f"{ctx.name}: unknown node {node!r}")
+    return "".join(out)
+
+
+def _render_range(node: Range, ctx: _Ctx, dot: Any) -> str:
+    code = node.code
+    var_names: List[str] = []
+    m = re.match(r"^\s*((?:\$[A-Za-z0-9_]+\s*,\s*)?\$[A-Za-z0-9_]+)\s*:=\s*(.*)$", code, re.S)
+    if m:
+        var_names = [v.strip() for v in m.group(1).split(",")]
+        code = m.group(2)
+    coll = _Evaluator(ctx, dot).eval(code)
+    if not _truthy(coll):
+        return _render_nodes(node.else_body, ctx, dot)
+    out: List[str] = []
+    if isinstance(coll, dict):
+        items = list(coll.items())
+    else:
+        items = list(enumerate(coll))
+    for k, v in items:
+        if len(var_names) == 2:
+            ctx.vars[var_names[0]], ctx.vars[var_names[1]] = k, v
+        elif len(var_names) == 1:
+            ctx.vars[var_names[0]] = v
+        out.append(_render_nodes(node.body, ctx, v))
+    return "".join(out)
+
+
+def render_template(
+    src: str,
+    data: Any,
+    name: str = "template",
+    extra_defines: Optional[Dict[str, List[Node]]] = None,
+) -> str:
+    nodes, defines = _parse(_tokenize(src, name), name)
+    if extra_defines:
+        defines = {**extra_defines, **defines}
+    ctx = _Ctx(data, defines, _builtin_funcs(), name)
+    return _render_nodes(nodes, ctx, data)
+
+
+def parse_defines(src: str, name: str) -> Dict[str, List[Node]]:
+    """Collect {{ define }} blocks (e.g. from _helpers.tpl) for cross-file includes."""
+    _, defines = _parse(_tokenize(src, name), name)
+    return defines

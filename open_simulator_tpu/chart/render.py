@@ -1,0 +1,208 @@
+"""Helm chart loading + rendering.
+
+Mirrors ProcessChart (/root/reference/pkg/chart/chart.go:18-118): load the chart,
+verify it is installable, coalesce values, render every template with the release
+context, drop NOTES.txt/empty docs, and sort manifests in install order. The Go helm
+engine is replaced by the gotmpl interpreter (gotmpl.py).
+"""
+
+from __future__ import annotations
+
+import os
+import tarfile
+import tempfile
+from typing import Dict, List, Optional
+
+import yaml
+
+from .gotmpl import TemplateError, parse_defines, render_template
+
+DEFAULT_RELEASE_NAME = "simon-release"
+DEFAULT_NAMESPACE = "default"
+
+# helm releaseutil.InstallOrder
+INSTALL_ORDER = [
+    "Namespace", "NetworkPolicy", "ResourceQuota", "LimitRange",
+    "PodSecurityPolicy", "PodDisruptionBudget", "ServiceAccount", "Secret",
+    "SecretList", "ConfigMap", "StorageClass", "PersistentVolume",
+    "PersistentVolumeClaim", "CustomResourceDefinition", "ClusterRole",
+    "ClusterRoleList", "ClusterRoleBinding", "ClusterRoleBindingList", "Role",
+    "RoleList", "RoleBinding", "RoleBindingList", "Service", "DaemonSet", "Pod",
+    "ReplicationController", "ReplicaSet", "Deployment",
+    "HorizontalPodAutoscaler", "StatefulSet", "Job", "CronJob", "Ingress",
+    "APIService",
+]
+_ORDER_IDX = {k: i for i, k in enumerate(INSTALL_ORDER)}
+
+
+class ChartError(ValueError):
+    pass
+
+
+class Chart:
+    def __init__(self, root: str) -> None:
+        meta_path = os.path.join(root, "Chart.yaml")
+        if not os.path.exists(meta_path):
+            raise ChartError(f"{root}: no Chart.yaml")
+        with open(meta_path) as f:
+            self.metadata: dict = yaml.safe_load(f) or {}
+        values_path = os.path.join(root, "values.yaml")
+        self.values: dict = {}
+        if os.path.exists(values_path):
+            with open(values_path) as f:
+                self.values = yaml.safe_load(f) or {}
+        self.templates: Dict[str, str] = {}
+        tdir = os.path.join(root, "templates")
+        if os.path.isdir(tdir):
+            for base, _, files in os.walk(tdir):
+                for fname in sorted(files):
+                    if fname.endswith((".yaml", ".yml", ".tpl", ".txt")):
+                        rel = os.path.relpath(os.path.join(base, fname), root)
+                        with open(os.path.join(base, fname)) as f:
+                            self.templates[rel] = f.read()
+        self.subcharts: List[Chart] = []
+        cdir = os.path.join(root, "charts")
+        if os.path.isdir(cdir):
+            for sub in sorted(os.listdir(cdir)):
+                subpath = os.path.join(cdir, sub)
+                if os.path.isdir(subpath) and os.path.exists(
+                    os.path.join(subpath, "Chart.yaml")
+                ):
+                    self.subcharts.append(Chart(subpath))
+
+    @property
+    def name(self) -> str:
+        return self.metadata.get("name", "")
+
+    def is_installable(self) -> bool:
+        # chart.go:44-52: only 'application' (or unset) type charts install
+        return self.metadata.get("type", "application") in ("", "application")
+
+
+def load_chart(path: str) -> Chart:
+    """Load a chart directory or .tgz archive."""
+    if os.path.isdir(path):
+        return Chart(path)
+    if path.endswith((".tgz", ".tar.gz")) and os.path.exists(path):
+        tmp = tempfile.mkdtemp(prefix="simon-chart-")
+        with tarfile.open(path) as tf:
+            tf.extractall(tmp, filter="data")
+        entries = [e for e in os.listdir(tmp) if os.path.isdir(os.path.join(tmp, e))]
+        if len(entries) != 1:
+            raise ChartError(f"{path}: expected a single chart root in archive")
+        return Chart(os.path.join(tmp, entries[0]))
+    raise ChartError(f"{path}: not a chart directory or .tgz")
+
+
+def coalesce_values(chart: Chart, overrides: Optional[dict] = None) -> dict:
+    """helm chartutil.CoalesceValues: overrides win over chart values; subchart
+    values nest under the subchart name."""
+    def deep_merge(base: dict, over: dict) -> dict:
+        out = dict(base)
+        for k, v in (over or {}).items():
+            if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+                out[k] = deep_merge(out[k], v)
+            else:
+                out[k] = v
+        return out
+
+    values = dict(chart.values)
+    for sub in chart.subcharts:
+        values[sub.name] = deep_merge(sub.values, values.get(sub.name) or {})
+    return deep_merge(values, overrides or {})
+
+
+def _release_context(chart: Chart, values: dict, release_name: str, namespace: str) -> dict:
+    return {
+        "Values": values,
+        "Release": {
+            "Name": release_name,
+            "Namespace": namespace,
+            "Service": "Helm",
+            "IsInstall": True,
+            "IsUpgrade": False,
+            "Revision": 1,
+        },
+        "Chart": {
+            # template convention: .Chart.Name capitalized keys
+            **{k[:1].upper() + k[1:]: v for k, v in chart.metadata.items()},
+        },
+        "Capabilities": {
+            "KubeVersion": {"Version": "v1.20.5", "Major": "1", "Minor": "20"},
+            "APIVersions": [],
+            "HelmVersion": {"Version": "v3"},
+        },
+        "Template": {"Name": "", "BasePath": chart.name + "/templates"},
+    }
+
+
+def render_chart(
+    chart: Chart,
+    overrides: Optional[dict] = None,
+    release_name: str = DEFAULT_RELEASE_NAME,
+    namespace: str = DEFAULT_NAMESPACE,
+) -> List[str]:
+    """Render all templates → YAML document strings in install order (chart.go:80-118:
+    NOTES.txt stripped, manifests sorted with helm's InstallOrder)."""
+    if not chart.is_installable():
+        raise ChartError(f"chart {chart.name} is not installable (library chart)")
+    values = coalesce_values(chart, overrides)
+    data = _release_context(chart, values, release_name, namespace)
+
+    defines: Dict[str, object] = {}
+    charts = [chart] + chart.subcharts
+    for ch in charts:
+        for tname, src in ch.templates.items():
+            if tname.endswith(".tpl"):
+                try:
+                    defines.update(parse_defines(src, tname))
+                except TemplateError as e:
+                    raise ChartError(f"{chart.name}/{tname}: {e}") from e
+
+    docs: List[str] = []
+    for ch in charts:
+        if ch is not chart:
+            sub_values = values.get(ch.name) or {}
+            sub_data = {**data, "Values": {**sub_values, "global": values.get("global") or {}},
+                        "Chart": {k[:1].upper() + k[1:]: v for k, v in ch.metadata.items()}}
+        else:
+            sub_data = data
+        for tname in sorted(ch.templates):
+            base = os.path.basename(tname)
+            if tname.endswith(".tpl") or base == "NOTES.txt" or base.startswith("_"):
+                continue
+            src = ch.templates[tname]
+            try:
+                rendered = render_template(src, sub_data, name=f"{ch.name}/{tname}",
+                                           extra_defines=defines)
+            except TemplateError as e:
+                raise ChartError(f"{ch.name}/{tname}: {e}") from e
+            for doc in rendered.split("\n---"):
+                if doc.strip().startswith("---"):
+                    doc = doc.strip()[3:]
+                if doc.strip():
+                    docs.append(doc)
+
+    def order_key(doc: str):
+        try:
+            obj = yaml.safe_load(doc)
+        except yaml.YAMLError:
+            return (len(INSTALL_ORDER), "")
+        kind = (obj or {}).get("kind", "")
+        return (_ORDER_IDX.get(kind, len(INSTALL_ORDER)), kind)
+
+    parsed = [(order_key(d), i, d) for i, d in enumerate(docs)]
+    parsed.sort(key=lambda t: (t[0], t[1]))  # stable within same kind
+    return [d for _, _, d in parsed if yaml.safe_load(d)]
+
+
+def process_chart(app_name: str, path: str, overrides: Optional[dict] = None) -> List[dict]:
+    """ProcessChart equivalent: chart path → decoded k8s objects, install-ordered.
+    Uses the app name as the release name so generated object names are stable."""
+    chart = load_chart(path)
+    out: List[dict] = []
+    for doc in render_chart(chart, overrides, release_name=app_name):
+        obj = yaml.safe_load(doc)
+        if isinstance(obj, dict) and obj.get("kind"):
+            out.append(obj)
+    return out

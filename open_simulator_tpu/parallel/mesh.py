@@ -21,7 +21,6 @@ N must divide the shard count; `pad_batch_tables` appends infeasible phantom nod
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Optional, Tuple
 
 import numpy as np
@@ -30,7 +29,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import kernels
-from ..simulator.encode import BatchTables
+from ..simulator.encode import BatchTables, pad_batch_tables as _pad_batch_tables
 
 NODE_AXIS = "nodes"
 SCENARIO_AXIS = "scenarios"
@@ -55,40 +54,9 @@ def make_node_mesh(
     return Mesh(devs, (NODE_AXIS,))
 
 
-def pad_batch_tables(bt: BatchTables, multiple: int) -> BatchTables:
-    """Pad the node axis of every table/seed to a multiple of `multiple` with
-    phantom nodes that no pod can be placed on."""
-    N = bt.alloc.shape[0]
-    pad = (-N) % multiple
-    if pad == 0:
-        return bt
-    D = bt.seed_counter.shape[1] - 1
-
-    def pad_n(a: np.ndarray, axis: int, fill) -> np.ndarray:
-        widths = [(0, 0)] * a.ndim
-        widths[axis] = (0, pad)
-        return np.pad(a, widths, constant_values=fill)
-
-    return dataclasses.replace(
-        bt,
-        alloc=pad_n(bt.alloc, 0, 0.0),
-        node_zone=pad_n(bt.node_zone, 0, 0),
-        static_mask=pad_n(bt.static_mask, 1, False),
-        mask_taint=pad_n(bt.mask_taint, 1, False),
-        mask_unsched=pad_n(bt.mask_unsched, 1, False),
-        mask_aff=pad_n(bt.mask_aff, 1, False),
-        simon_raw=pad_n(bt.simon_raw, 1, 0.0),
-        nodeaff_raw=pad_n(bt.nodeaff_raw, 1, 0.0),
-        taint_raw=pad_n(bt.taint_raw, 1, 0.0),
-        avoid_raw=pad_n(bt.avoid_raw, 1, 0.0),
-        image_raw=pad_n(bt.image_raw, 1, 0.0),
-        # phantom nodes carry the key-absent sentinel domain D: counters never move
-        counter_dom=pad_n(bt.counter_dom, 1, D),
-        carr_dom=pad_n(bt.carr_dom, 1, D),
-        seed_requested=pad_n(bt.seed_requested, 0, 0.0),
-        seed_nonzero=pad_n(bt.seed_nonzero, 0, 0.0),
-        seed_port_used=pad_n(bt.seed_port_used, 0, False),
-    )
+# Node-axis padding lives with the encoder (numpy-only); re-exported here because
+# the mesh path is its main consumer.
+pad_batch_tables = _pad_batch_tables
 
 
 def table_shardings(mesh: Mesh) -> kernels.Tables:

@@ -755,6 +755,151 @@ class BatchTables:
         )
 
 
+def _bucket(n: int) -> int:
+    """Next power of two (≥1) — the padding granularity for encoder-derived axes."""
+    return 1 << max(0, (n - 1)).bit_length() if n > 1 else 1
+
+
+def bucket_capped(n: int, cap: int, floor: int = 8) -> int:
+    """Padding target for the pod/node axes: powers of two up to `cap`, then
+    multiples of `cap` (bounds compile-cache churn at both small and large sizes)."""
+    if n <= 0:
+        return floor
+    if n <= cap:
+        return max(floor, _bucket(n))
+    return ((n + cap - 1) // cap) * cap
+
+
+def _pad_axis(a: np.ndarray, axis: int, target: int, fill) -> np.ndarray:
+    cur = a.shape[axis]
+    if cur >= target:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, target - cur)
+    return np.pad(a, widths, constant_values=fill)
+
+
+def pad_batch_tables(bt: "BatchTables", multiple: int) -> "BatchTables":
+    """Pad the node axis of every table/seed to a multiple of `multiple` with
+    phantom nodes that no pod can be placed on (static_mask False everywhere; the
+    key-absent sentinel domain, so counters never move)."""
+    import dataclasses
+
+    N = bt.alloc.shape[0]
+    target = N + ((-N) % multiple)
+    if target == N:
+        return bt
+    D = bt.seed_counter.shape[1] - 1
+    return dataclasses.replace(
+        bt,
+        alloc=_pad_axis(bt.alloc, 0, target, 0.0),
+        node_zone=_pad_axis(bt.node_zone, 0, target, 0),
+        static_mask=_pad_axis(bt.static_mask, 1, target, False),
+        mask_taint=_pad_axis(bt.mask_taint, 1, target, False),
+        mask_unsched=_pad_axis(bt.mask_unsched, 1, target, False),
+        mask_aff=_pad_axis(bt.mask_aff, 1, target, False),
+        simon_raw=_pad_axis(bt.simon_raw, 1, target, 0.0),
+        nodeaff_raw=_pad_axis(bt.nodeaff_raw, 1, target, 0.0),
+        taint_raw=_pad_axis(bt.taint_raw, 1, target, 0.0),
+        avoid_raw=_pad_axis(bt.avoid_raw, 1, target, 0.0),
+        image_raw=_pad_axis(bt.image_raw, 1, target, 0.0),
+        counter_dom=_pad_axis(bt.counter_dom, 1, target, D),
+        carr_dom=_pad_axis(bt.carr_dom, 1, target, D),
+        seed_requested=_pad_axis(bt.seed_requested, 0, target, 0.0),
+        seed_nonzero=_pad_axis(bt.seed_nonzero, 0, target, 0.0),
+        seed_port_used=_pad_axis(bt.seed_port_used, 0, target, False),
+    )
+
+
+def pad_encoder_axes(bt: "BatchTables") -> "BatchTables":
+    """Pad every encoder-derived axis (groups G, counters T, carriers Tc, port ids
+    PORT, domains D, and the per-group term-slot axes) to power-of-two buckets with
+    inert rows/columns.
+
+    Why: the encoder interns groups/counters/domains cumulatively across apps, so
+    every ScheduleApp batch otherwise gets brand-new table shapes and a fresh XLA
+    compile (~20-40s on TPU). Bucketing bounds the number of distinct compiled
+    shapes to a few per decade of growth. Inertness invariants:
+    - pad G rows are never indexed (pod_group only holds real ids);
+    - pad T/Tc rows carry the key-absent sentinel domain and match no group, so
+      they never accumulate or block;
+    - pad D columns sit between the real domains and the sentinel column, which
+      moves from index D to index D_pad (ids in *_dom are remapped);
+    - pad term slots use the same -1/0 fills as ordinary short rows.
+    """
+    import dataclasses
+
+    G, N = bt.static_mask.shape
+    T = bt.counter_dom.shape[0]
+    Tc = bt.carr_dom.shape[0]
+    D = bt.seed_counter.shape[1] - 1
+    PORT = bt.seed_port_used.shape[1] - 1
+    Gp, Tp, Tcp, Dp = _bucket(G), _bucket(T), _bucket(Tc), _bucket(D)
+    PORTp = _bucket(PORT)
+    pad_axis = _pad_axis
+
+    def pad_dom(dom: np.ndarray) -> np.ndarray:
+        # remap sentinel D -> Dp, then pad new rows entirely with the sentinel
+        return np.where(dom == D, Dp, dom)
+
+    def pad_counter_width(a: np.ndarray) -> np.ndarray:
+        # [*, D+1] -> [*, Dp+1]: real cols 0..D-1 keep, sentinel col moves to Dp
+        out = np.zeros(a.shape[:-1] + (Dp + 1,), a.dtype)
+        out[..., :D] = a[..., :D]
+        out[..., Dp] = a[..., D]
+        return out
+
+    r = dataclasses.replace(
+        bt,
+        # G axis
+        static_mask=pad_axis(bt.static_mask, 0, Gp, False),
+        mask_taint=pad_axis(bt.mask_taint, 0, Gp, False),
+        mask_unsched=pad_axis(bt.mask_unsched, 0, Gp, False),
+        mask_aff=pad_axis(bt.mask_aff, 0, Gp, False),
+        simon_raw=pad_axis(bt.simon_raw, 0, Gp, 0.0),
+        nodeaff_raw=pad_axis(bt.nodeaff_raw, 0, Gp, 0.0),
+        taint_raw=pad_axis(bt.taint_raw, 0, Gp, 0.0),
+        avoid_raw=pad_axis(bt.avoid_raw, 0, Gp, 0.0),
+        image_raw=pad_axis(bt.image_raw, 0, Gp, 0.0),
+        grp_requests=pad_axis(bt.grp_requests, 0, Gp, 0.0),
+        grp_nonzero=pad_axis(bt.grp_nonzero, 0, Gp, 0.0),
+        grp_unknown=pad_axis(bt.grp_unknown, 0, Gp, False),
+        grp_ports=pad_axis(pad_axis(bt.grp_ports, 0, Gp, 0), 1, _bucket(bt.grp_ports.shape[1]), 0),
+        grp_aff_self=pad_axis(bt.grp_aff_self, 0, Gp, False),
+        ss_t=pad_axis(bt.ss_t, 0, Gp, -1),
+        ss_skip=pad_axis(bt.ss_skip, 0, Gp, False),
+        grp_carries=pad_axis(pad_axis(bt.grp_carries, 0, Gp, 0.0), 1, Tcp, 0.0),
+        # per-group term slots (pad G rows AND slot width)
+        req_aff_t=pad_axis(pad_axis(bt.req_aff_t, 0, Gp, -1), 1, _bucket(bt.req_aff_t.shape[1]), -1),
+        req_anti_t=pad_axis(pad_axis(bt.req_anti_t, 0, Gp, -1), 1, _bucket(bt.req_anti_t.shape[1]), -1),
+        pref_t=pad_axis(pad_axis(bt.pref_t, 0, Gp, -1), 1, _bucket(bt.pref_t.shape[1]), -1),
+        pref_w=pad_axis(pad_axis(bt.pref_w, 0, Gp, 0.0), 1, _bucket(bt.pref_w.shape[1]), 0.0),
+        dns_t=pad_axis(pad_axis(bt.dns_t, 0, Gp, -1), 1, _bucket(bt.dns_t.shape[1]), -1),
+        dns_maxskew=pad_axis(pad_axis(bt.dns_maxskew, 0, Gp, 1.0), 1, _bucket(bt.dns_maxskew.shape[1]), 1.0),
+        dns_self=pad_axis(pad_axis(bt.dns_self, 0, Gp, 0.0), 1, _bucket(bt.dns_self.shape[1]), 0.0),
+        dns_edom=pad_counter_width(
+            pad_axis(pad_axis(bt.dns_edom, 0, Gp, False), 1, _bucket(bt.dns_edom.shape[1]), False)
+        ),
+        sa_t=pad_axis(pad_axis(bt.sa_t, 0, Gp, -1), 1, _bucket(bt.sa_t.shape[1]), -1),
+        sa_maxskew=pad_axis(pad_axis(bt.sa_maxskew, 0, Gp, 1.0), 1, _bucket(bt.sa_maxskew.shape[1]), 1.0),
+        sa_self=pad_axis(pad_axis(bt.sa_self, 0, Gp, 0.0), 1, _bucket(bt.sa_self.shape[1]), 0.0),
+        # T axis
+        counter_dom=pad_axis(pad_dom(bt.counter_dom), 0, Tp, Dp),
+        counter_sel_match_g=pad_axis(pad_axis(bt.counter_sel_match_g, 0, Tp, False), 1, Gp, False),
+        seed_counter=pad_axis(pad_counter_width(bt.seed_counter), 0, Tp, 0.0),
+        # Tc axis
+        carr_dom=pad_axis(pad_dom(bt.carr_dom), 0, Tcp, Dp),
+        carr_use_anti=pad_axis(bt.carr_use_anti, 0, Tcp, False),
+        carr_hard_w=pad_axis(bt.carr_hard_w, 0, Tcp, 0.0),
+        carr_pref_w=pad_axis(bt.carr_pref_w, 0, Tcp, 0.0),
+        carr_sel_match_g=pad_axis(pad_axis(bt.carr_sel_match_g, 0, Tcp, False), 1, Gp, False),
+        seed_carrier=pad_axis(pad_counter_width(bt.seed_carrier), 0, Tcp, 0.0),
+        # PORT axis
+        seed_port_used=pad_axis(bt.seed_port_used, 1, PORTp + 1, False),
+    )
+    return r
+
+
 def _pad_slots(rows: List[List], width: int, fill, dtype) -> np.ndarray:
     out = np.full((len(rows), max(1, width)), fill, dtype)
     for i, r in enumerate(rows):

@@ -47,9 +47,12 @@ from .encode import (
     Encoder,
     NodeArrays,
     PlacedRecord,
+    bucket_capped,
     build_batch_tables,
     carried_specs_of_pod,
     extract_forced_node,
+    pad_batch_tables,
+    pad_encoder_axes,
     scheduling_signature,
 )
 
@@ -205,12 +208,16 @@ class Simulator:
             batch.append((self.encoder.group_of(stripped), forced))
         # Pad the scan length to bound compile-cache churn: powers of two up to 2048,
         # then multiples of 2048 (a 10k batch scans 10240 steps, not 16384).
-        P = len(batch)
-        if P <= 2048:
-            pad = max(8, 1 << (P - 1).bit_length())
-        else:
-            pad = ((P + 2047) // 2048) * 2048
-        return build_batch_tables(self.encoder, batch, self.placed, self.match_cache, pad_to=pad)
+        pad = bucket_capped(len(batch), 2048)
+        bt = build_batch_tables(self.encoder, batch, self.placed, self.match_cache, pad_to=pad)
+        # Pad encoder-derived axes (G/T/Tc/D/ports/term slots) to pow2 buckets: the
+        # encoder interns cumulatively across apps, so without this every
+        # ScheduleApp batch would get fresh shapes and a fresh XLA compile.
+        bt = pad_encoder_axes(bt)
+        # Pad the node axis the same way: the capacity planner re-simulates at N,
+        # N+1, N+2... nodes (apply.go:203-259) — bucketed N keeps the XLA compile
+        # cache warm across probes. Phantom nodes are infeasible by construction.
+        return pad_batch_tables(bt, bucket_capped(self.na.N, 1024))
 
     def _schedule_run(self, to_schedule: List[dict]) -> List[UnscheduledPod]:
         failed: List[UnscheduledPod] = []
@@ -236,15 +243,21 @@ class Simulator:
         choices = np.asarray(choices)
         self._last_tables, self._last_carry = bt, final_carry
 
+        reason_cache: Dict[Tuple[int, int], Dict[str, int]] = {}
         for i, pod in enumerate(to_schedule):
             node_i = int(choices[i])
             if node_i >= 0:
                 self._commit_pod(pod, node_i)
             else:
-                reason = self._explain(
-                    pod, int(bt.pod_group[i]), int(bt.forced_node[i]), tables, final_carry
-                )
-                failed.append(UnscheduledPod(pod, reason))
+                # Pods of one group share tolerations/requests, so the per-stage
+                # failure counts are identical — diagnose once per (group, forced).
+                key = (int(bt.pod_group[i]), int(bt.forced_node[i]))
+                reasons = reason_cache.get(key)
+                if reasons is None:
+                    reasons = reason_cache[key] = self._explain_reasons(
+                        pod, key[0], key[1], tables, final_carry
+                    )
+                failed.append(UnscheduledPod(pod, self._format_reason(pod, reasons, self.na.N)))
         return failed
 
     def _to_device(self, bt: BatchTables):
@@ -274,16 +287,17 @@ class Simulator:
         ("pod_anti", "node(s) didn't match pod anti-affinity rules"),
     )
 
-    def _explain(self, pod: dict, g: int, forced: int, tables, carry) -> str:
-        """Rebuild the FitError message from per-stage masks (generic_scheduler.go
-        findNodesThatFitPod failure accounting; first-failing-plugin per node)."""
+    def _explain_reasons(self, pod: dict, g: int, forced: int, tables, carry) -> Dict[str, int]:
+        """Rebuild the FitError reason counts from per-stage masks
+        (generic_scheduler.go findNodesThatFitPod failure accounting;
+        first-failing-plugin per node)."""
         jnp = _jax()
 
         feasible, stages = kernels.feasibility_jit(
             tables, carry, jnp.int32(g), jnp.int32(forced), jnp.asarray(True)
         )
-        stages = {k: np.asarray(v) for k, v in stages.items()}
-        N = self.na.N
+        N = self.na.N  # stages arrays may carry phantom node padding; slice it off
+        stages = {k: np.asarray(v)[:N] for k, v in stages.items()}
         remaining = np.ones(N, bool)
         if forced >= 0:
             only = np.zeros(N, bool)
@@ -322,7 +336,7 @@ class Simulator:
                 remaining &= stages["fit"]
             else:
                 take(stages[stage], label)
-        return self._format_reason(pod, reasons, N)
+        return reasons
 
     def _format_reason(self, pod: dict, reasons: Dict[str, int], n_nodes: int) -> str:
         detail = ", ".join(f"{v} {k}" for k, v in sorted(reasons.items()))

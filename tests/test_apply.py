@@ -1,0 +1,119 @@
+"""Apply layer: config CR, fake nodes, capacity planning loop, resource guard."""
+
+import os
+
+import pytest
+
+from open_simulator_tpu.api.v1alpha1 import (
+    ConfigError,
+    parse_simon_config,
+    validate_config,
+)
+from open_simulator_tpu.apply.applier import (
+    Applier,
+    Options,
+    satisfy_resource_setting,
+)
+from open_simulator_tpu.core.types import NodeStatus
+from open_simulator_tpu.models.fakenode import new_fake_nodes
+
+from fixtures import make_node, make_pod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIG = os.path.join(REPO, "examples", "simon-config.yaml")
+
+
+def test_parse_simon_config():
+    cfg = parse_simon_config(CONFIG)
+    assert cfg.api_version == "simon/v1alpha1"
+    assert cfg.kind == "Config"
+    assert cfg.spec.cluster.custom_cluster == "examples/cluster/demo"
+    assert [a.name for a in cfg.spec.app_list] == ["simple"]
+    assert cfg.spec.new_node == "examples/newnode"
+
+
+def test_validate_config_xor(tmp_path):
+    cfg = parse_simon_config(CONFIG)
+    os.chdir(REPO)
+    validate_config(cfg)  # ok
+    cfg.spec.cluster.kube_config = "/nonexistent/kubeconfig"
+    with pytest.raises(ConfigError):
+        validate_config(cfg)  # both set -> XOR violation
+    cfg.spec.cluster.custom_cluster = ""
+    with pytest.raises(ConfigError):
+        validate_config(cfg)  # kube_config path doesn't exist
+
+
+def test_new_fake_nodes():
+    template = make_node("tmpl", cpu="4", memory="8Gi")
+    nodes = new_fake_nodes(template, 3, seed=7)
+    assert len(nodes) == 3
+    names = {n["metadata"]["name"] for n in nodes}
+    assert len(names) == 3
+    for n in nodes:
+        name = n["metadata"]["name"]
+        assert name.startswith("simon-")
+        assert n["metadata"]["labels"]["kubernetes.io/hostname"] == name
+        assert "simon/new-node" in n["metadata"]["labels"]
+    # template itself is never mutated
+    assert template["metadata"]["name"] == "tmpl"
+
+
+def test_new_fake_nodes_none_template():
+    assert new_fake_nodes(None, 0) == []
+    with pytest.raises(ValueError):
+        new_fake_nodes(None, 2)
+
+
+def test_satisfy_resource_setting_env(monkeypatch):
+    node = make_node("n1", cpu="10", memory="10Gi")
+    pods = [make_pod(f"p{i}", cpu="2", memory="2Gi", node_name="n1") for i in range(4)]
+    statuses = [NodeStatus(node=node, pods=pods)]
+    ok, _ = satisfy_resource_setting(statuses)
+    assert ok  # 80% <= default 100%
+    monkeypatch.setenv("MaxCPU", "60")
+    ok, reason = satisfy_resource_setting(statuses)
+    assert not ok and "cpu" in reason
+    monkeypatch.setenv("MaxCPU", "80")
+    ok, _ = satisfy_resource_setting(statuses)
+    assert ok  # rate 80 is not > 80
+    monkeypatch.setenv("MaxCPU", "bogus")
+    with pytest.raises(ConfigError):
+        satisfy_resource_setting(statuses)
+
+
+def test_applier_auto_capacity_planning(tmp_path):
+    """6 pods of 2cpu/4Gi on 2×(8cpu/16Gi) nodes: 12cpu needed, 16 available — but
+    the app asks 24Gi while 32Gi exist, fits; then force overflow via MaxCPU."""
+    os.chdir(REPO)
+    out = tmp_path / "report.txt"
+    applier = Applier(Options(simon_config=CONFIG, output_file=str(out)))
+    result = applier.run()
+    assert result is not None
+    assert not result.unscheduled_pods
+    placed = sum(len(ns.pods) for ns in result.node_status)
+    assert placed == 6
+    report = out.read_text()
+    assert "Node Info" in report and "App Info" in report
+    assert "demo-node-1" in report
+
+
+def test_applier_adds_nodes_when_needed(tmp_path, monkeypatch):
+    """With MaxCPU=40 the base cluster (75% cpu) violates the envelope: the planner
+    must add fake nodes until average utilization fits."""
+    os.chdir(REPO)
+    monkeypatch.setenv("MaxCPU", "40")
+    out = tmp_path / "report.txt"
+    applier = Applier(Options(simon_config=CONFIG, output_file=str(out)))
+    result = applier.run()
+    assert result is not None
+    assert not result.unscheduled_pods
+    added = [
+        ns for ns in result.node_status
+        if "simon/new-node" in (ns.node["metadata"].get("labels") or {})
+    ]
+    assert added, "expected fake nodes to be added"
+    # envelope satisfied at the end
+    ok, _ = satisfy_resource_setting(result.node_status)
+    assert ok
+    assert "added" in out.read_text()

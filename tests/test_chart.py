@@ -1,0 +1,160 @@
+"""Chart renderer: Go-template subset + chart loading/values/install-order."""
+
+import os
+import textwrap
+
+import pytest
+
+from open_simulator_tpu.chart.gotmpl import TemplateError, render_template
+from open_simulator_tpu.chart.render import ChartError, load_chart, process_chart, render_chart
+
+
+# ------------------------------------------------------------ template engine -------
+
+V = {"Values": {"name": "web", "replicas": 3, "enabled": True,
+                "labels": {"team": "infra", "tier": "backend"},
+                "ports": [80, 443],
+                "resources": {"requests": {"cpu": "100m"}}},
+     "Release": {"Name": "rel", "Namespace": "default"},
+     "Chart": {"Name": "demo", "Version": "0.1.0"}}
+
+
+def test_basic_substitution():
+    assert render_template("name: {{ .Values.name }}", V) == "name: web"
+    assert render_template("{{ .Release.Name }}-{{ .Chart.Name }}", V) == "rel-demo"
+
+
+def test_missing_path_is_empty():
+    assert render_template("x{{ .Values.absent.deep }}y", V) == "xy"
+
+
+def test_pipelines_and_functions():
+    assert render_template('{{ .Values.name | upper | quote }}', V) == '"WEB"'
+    assert render_template('{{ default "fallback" .Values.absent }}', V) == "fallback"
+    assert render_template('{{ printf "%s-%d" .Values.name 7 }}', V) == "web-7"
+    assert render_template('{{ .Values.name | trunc 2 }}', V) == "we"
+
+
+def test_if_else():
+    t = "{{ if .Values.enabled }}on{{ else }}off{{ end }}"
+    assert render_template(t, V) == "on"
+    t2 = "{{ if eq .Values.name \"nope\" }}a{{ else if eq .Values.name \"web\" }}b{{ else }}c{{ end }}"
+    assert render_template(t2, V) == "b"
+
+
+def test_range_list_and_dict():
+    t = "{{ range .Values.ports }}p{{ . }} {{ end }}"
+    assert render_template(t, V) == "p80 p443 "
+    t2 = "{{ range $k, $v := .Values.labels }}{{ $k }}={{ $v }};{{ end }}"
+    assert render_template(t2, V) == "team=infra;tier=backend;"
+
+
+def test_with_and_toyaml_nindent():
+    t = "resources:{{ with .Values.resources }}{{ toYaml . | nindent 2 }}{{ end }}"
+    out = render_template(t, V)
+    assert "requests:" in out and "\n  requests:" in out
+
+
+def test_whitespace_trimming():
+    t = "a\n{{- if .Values.enabled }}\nb\n{{- end }}"
+    assert render_template(t, V) == "a\nb"
+
+
+def test_variables():
+    t = '{{ $n := .Values.name }}{{ $n }}-{{ $n }}'
+    assert render_template(t, V) == "web-web"
+
+
+def test_define_include():
+    t = ('{{ define "lbl" }}app: {{ .Values.name }}{{ end }}'
+         '{{ include "lbl" . }}')
+    assert render_template(t, V) == "app: web"
+
+
+def test_unknown_function_raises():
+    with pytest.raises(TemplateError):
+        render_template("{{ .Values.name | definitelynotafunc }}", V)
+
+
+# ----------------------------------------------------------------- chart dirs -------
+
+
+@pytest.fixture()
+def demo_chart(tmp_path):
+    root = tmp_path / "demo"
+    (root / "templates").mkdir(parents=True)
+    (root / "Chart.yaml").write_text("name: demo\nversion: 0.1.0\napiVersion: v2\n")
+    (root / "values.yaml").write_text(textwrap.dedent("""\
+        replicas: 2
+        image: nginx:1.25
+        service:
+          enabled: true
+    """))
+    (root / "templates" / "_helpers.tpl").write_text(
+        '{{ define "demo.fullname" }}{{ .Release.Name }}-demo{{ end }}'
+    )
+    (root / "templates" / "deploy.yaml").write_text(textwrap.dedent("""\
+        apiVersion: apps/v1
+        kind: Deployment
+        metadata:
+          name: {{ include "demo.fullname" . }}
+        spec:
+          replicas: {{ .Values.replicas }}
+          selector:
+            matchLabels:
+              app: demo
+          template:
+            metadata:
+              labels:
+                app: demo
+            spec:
+              containers:
+                - name: app
+                  image: {{ .Values.image }}
+    """))
+    (root / "templates" / "svc.yaml").write_text(textwrap.dedent("""\
+        {{- if .Values.service.enabled }}
+        apiVersion: v1
+        kind: Service
+        metadata:
+          name: {{ include "demo.fullname" . }}
+        spec:
+          selector:
+            app: demo
+        {{- end }}
+    """))
+    (root / "templates" / "NOTES.txt").write_text("Thanks for installing {{ .Chart.Name }}")
+    return str(root)
+
+
+def test_load_and_render_chart(demo_chart):
+    chart = load_chart(demo_chart)
+    assert chart.name == "demo"
+    docs = render_chart(chart, release_name="myapp")
+    # NOTES.txt dropped; Service sorts before Deployment (install order)
+    import yaml as _y
+    kinds = [(_y.safe_load(d) or {}).get("kind") for d in docs]
+    assert kinds == ["Service", "Deployment"]
+
+
+def test_process_chart_objects(demo_chart):
+    objs = process_chart("myapp", demo_chart)
+    dep = [o for o in objs if o["kind"] == "Deployment"][0]
+    assert dep["metadata"]["name"] == "myapp-demo"
+    assert dep["spec"]["replicas"] == 2
+
+
+def test_values_override_disables_service(demo_chart):
+    chart = load_chart(demo_chart)
+    docs = render_chart(chart, overrides={"service": {"enabled": False}})
+    import yaml as _y
+    kinds = [(_y.safe_load(d) or {}).get("kind") for d in docs]
+    assert kinds == ["Deployment"]
+
+
+def test_library_chart_rejected(tmp_path):
+    root = tmp_path / "lib"
+    root.mkdir()
+    (root / "Chart.yaml").write_text("name: lib\nversion: 0.1.0\ntype: library\n")
+    with pytest.raises(ChartError):
+        render_chart(load_chart(str(root)))
