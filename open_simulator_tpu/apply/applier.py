@@ -321,43 +321,29 @@ class Applier:
         self._println()
 
     def _report_gpu(self, node_statuses: List[NodeStatus]) -> None:
+        from ..plugins.gpushare import gpu_report_rows, pod_gpu_index
+
         self._println("GPU Node Resource")
         rows = [["Node", "GPU ID", "GPU Request/Capacity", "Pod List"]]
+        all_pods: List[dict] = []
         for st in node_statuses:
-            raw = annotations_of(st.node).get(C.AnnoNodeGpuShare)
-            if not raw:
-                continue
-            try:
-                info = json.loads(raw)
-            except json.JSONDecodeError:
-                continue
-            total = info.get("gpuTotalMemory", 0)
-            used = sum(_pod_gpu_mem(p) for p in st.pods)
-            pct = int(used / total * 100) if total else 0
+            rows.extend(gpu_report_rows(st.node, st.pods))
+            all_pods.extend(st.pods)
+        self._render_table(rows)
+        self._println()
+        # Pod -> Node map (apply.go:502-524)
+        self._println("Pod -> Node Map")
+        rows = [["Pod", "CPU Req", "Mem Req", "GPU Req", "Host Node", "GPU IDX"]]
+        for p in sorted(all_pods, key=name_of):
+            req = pod_resource_requests(p)
             rows.append([
-                f"{name_of(st.node)} ({info.get('gpuModel', '')})",
-                f"{info.get('gpuCount', 0)} GPUs",
-                f"{format_quantity(used, binary=True)}/"
-                f"{format_quantity(total, binary=True)}({pct}%)",
-                f"{info.get('numPods', 0)} Pods",
+                name_of(p),
+                _fmt_cpu(req.get("cpu", 0.0)),
+                format_quantity(req.get("memory", 0.0), binary=True),
+                format_quantity(_pod_gpu_mem(p), binary=True),
+                (p.get("spec") or {}).get("nodeName", ""),
+                pod_gpu_index(p),
             ])
-            def _dev_key(kv):
-                k = kv[0]
-                return (0, int(k)) if str(k).isdigit() else (1, str(k))
-
-            for idx, dev in sorted((info.get("devs") or {}).items(), key=_dev_key):
-                dcap = dev.get("gpuTotalMemory", 0)
-                if dcap <= 0:
-                    continue
-                duse = dev.get("gpuUsedMemory", 0)
-                dpct = int(duse / dcap * 100) if dcap else 0
-                rows.append([
-                    f"{name_of(st.node)} ({info.get('gpuModel', '')})",
-                    str(idx),
-                    f"{format_quantity(duse, binary=True)}/"
-                    f"{format_quantity(dcap, binary=True)}({dpct}%)",
-                    ", ".join(dev.get("podList") or []),
-                ])
         self._render_table(rows)
         self._println()
 
@@ -393,13 +379,10 @@ def _fmt_cpu(milli: float) -> str:
 
 
 def _pod_gpu_mem(pod: dict) -> float:
-    anns = annotations_of(pod)
-    try:
-        mem = float(anns.get(C.AnnoGpuMem, 0))
-        cnt = float(anns.get(C.AnnoGpuCount, 1) or 1)
-    except ValueError:
-        return 0.0
-    return mem * max(cnt, 1)
+    """Total GPU memory request: per-GPU mem × count (apply.go:377-380)."""
+    from ..plugins.gpushare import pod_gpu_count, pod_gpu_mem
+
+    return float(pod_gpu_mem(pod) * pod_gpu_count(pod))
 
 
 def satisfy_resource_setting(node_statuses: List[NodeStatus]):

@@ -43,12 +43,13 @@ Node = "Node"
 WorkloadKinds = (Deployment, ReplicaSet, ReplicationController, StatefulSet, DaemonSet, Job, CronJob)
 
 # --- gpu-share annotations (pkg/type/open-gpu-share/utils/const.go:3-9) -------------------
-AnnoGpuMem = "alibabacloud.com/gpu-mem"            # pod: per-GPU memory request
-AnnoGpuCount = "alibabacloud.com/gpu-count"        # pod: number of GPUs wanted
+AnnoGpuMem = "alibabacloud.com/gpu-mem"            # pod: per-GPU memory request (ResourceName)
+AnnoGpuCount = "alibabacloud.com/gpu-count"        # pod: number of GPUs wanted (CountName)
 AnnoGpuIndex = "alibabacloud.com/gpu-index"        # pod: assigned device id(s), e.g. "0-2"
-AnnoGpuModel = "alibabacloud.com/gpu-card-model"   # node: card model
-ResourceGpuMem = "alibabacloud.com/gpu-mem"        # node allocatable: total sharable GPU mem
-ResourceGpuCount = "nvidia.com/gpu"                # node allocatable: whole-GPU count
+AnnoGpuAssumeTime = "alibabacloud.com/assume-time" # pod: set at Reserve
+AnnoGpuModel = "alibabacloud.com/gpu-card-model"   # node label: card model
+ResourceGpuMem = "alibabacloud.com/gpu-mem"        # node capacity: total sharable GPU mem
+ResourceGpuCount = "alibabacloud.com/gpu-count"    # node capacity: whole-GPU count
 
 # --- fake node factory (pkg/type/const.go:11, pkg/utils/utils.go:885-915) -----------------
 NewNodeNamePrefix = "simon"

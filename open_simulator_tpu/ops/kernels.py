@@ -48,6 +48,11 @@ W_PTS = 2.0
 W_TAINT = 1.0
 W_SS = 1.0
 W_SIMON = 1.0
+# Open-Gpu-Share's Score (open-gpu-share.go:86-110) is the same max-share formula
+# and min-max normalization as Simon's, and both plugins are always enabled
+# (GetAndSetSchedulerConfig, pkg/simulator/utils.go:321-333) — so its contribution
+# is exactly a second Simon term.
+W_GPUSHARE = 1.0
 
 _F32 = jnp.float32
 
@@ -92,6 +97,12 @@ class Tables(NamedTuple):
     carr_pref_w: jax.Array
     carr_sel_match_g: jax.Array
     grp_carries: jax.Array
+    # GPU-share (open-gpu-share.go Filter; per-device ledger in the carry)
+    grp_gpu_mem: jax.Array   # [G] f32: per-GPU memory request (0 = no GPU)
+    grp_gpu_num: jax.Array   # [G] f32: number of GPUs requested
+    grp_gpu_pre: jax.Array   # [G] bool: valid pre-assigned gpu-index present
+    grp_gpu_take: jax.Array  # [G, MAXDEV] f32: unit counts per device when pre-assigned
+    dev_total: jax.Array     # [N, MAXDEV] f32: per-device total memory (0 = absent)
 
 
 class Carry(NamedTuple):
@@ -102,6 +113,7 @@ class Carry(NamedTuple):
     port_used: jax.Array    # [N, PORT+1] bool
     counter: jax.Array      # [T, D+1] f32
     carrier: jax.Array      # [Tc, D+1] f32
+    dev_used: jax.Array     # [N, MAXDEV] f32: per-GPU-device used memory
 
 
 def _flr(x):
@@ -165,7 +177,27 @@ def feasibility(tb: Tables, cry: Carry, g, forced, valid) -> Tuple[jax.Array, di
     dns_ok_each = key_present[dids] & (skew <= tb.dns_maxskew[g][:, None])
     dns_ok = jnp.all(dns_ok_each | ~dvalid[:, None], axis=0)
 
-    feasible = smask & fit & ~conflict & aff_ok & ~blocked_in & ~blocked_ex & dns_ok
+    # Open-Gpu-Share Filter (open-gpu-share.go:51-81): node total memory must cover
+    # the per-GPU request AND the devices must fit all requested units. A device can
+    # host multiple units (two-pointer greedy packs units onto one GPU), so the
+    # feasibility condition is sum(floor(idle/mem)) >= num.
+    gmem = tb.grp_gpu_mem[g]
+    gnum = tb.grp_gpu_num[g]
+    has_gpu = gmem > 0
+    safe_mem = jnp.maximum(gmem, 1.0)
+    gidle = tb.dev_total - cry.dev_used                                    # [N, MAXDEV]
+    gunits = jnp.where(tb.dev_total > 0, jnp.floor(gidle / safe_mem), 0.0)
+    gunits = jnp.maximum(gunits, 0.0)
+    node_gpu_total = jnp.sum(tb.dev_total, axis=1)
+    gpu_fit = (node_gpu_total >= gmem) & (jnp.sum(gunits, axis=1) >= gnum) & (gnum > 0)
+    # pre-assigned gpu-index: AllocateGpuId returns the id without checking device
+    # fit (gpunodeinfo.go:247-253); only the node-total check and device existence
+    # apply.
+    gpu_pre_fit = (node_gpu_total >= gmem) & (gnum > 0) & jnp.any(tb.dev_total > 0, axis=1)
+    gpu_fit = jnp.where(tb.grp_gpu_pre[g], gpu_pre_fit, gpu_fit)
+    gpu_ok = jnp.where(has_gpu, gpu_fit, jnp.ones_like(gpu_fit))
+
+    feasible = smask & fit & ~conflict & aff_ok & ~blocked_in & ~blocked_ex & dns_ok & gpu_ok
     feasible &= valid
     iota = jnp.arange(N)
     feasible = jnp.where(forced >= 0, feasible & (iota == forced), feasible)
@@ -181,6 +213,7 @@ def feasibility(tb: Tables, cry: Carry, g, forced, valid) -> Tuple[jax.Array, di
         "pod_affinity": aff_ok,
         "pod_anti": ~(blocked_in | blocked_ex),
         "spread": dns_ok,
+        "gpu": gpu_ok,
     }
     return feasible, stages
 
@@ -283,7 +316,7 @@ def scores(tb: Tables, cry: Carry, g, feasible, n_zones: int) -> jax.Array:
     total = (
         W_LEAST * least
         + W_BALANCED * balanced
-        + W_SIMON * simon
+        + (W_SIMON + W_GPUSHARE) * simon  # Open-Gpu-Share Score ≡ Simon Score
         + W_NODEAFF * nodeaff
         + W_TAINT * taint
         + W_INTERPOD * interpod
@@ -316,7 +349,29 @@ def commit(tb: Tables, cry: Carry, g, choice, do) -> Carry:
     cinc = tb.grp_carries[g] * (cdom_col < D) * dof
     carrier = cry.carrier.at[jnp.arange(Tc), cdom_col].add(cinc)
 
-    return Carry(requested, nonzero, port_used, counter, carrier)
+    # GPU device allocation (AllocateGpuId, gpunodeinfo.go:232-290): tightest-fit
+    # for a single GPU; in-order greedy (multiple units may pack onto one device)
+    # for multi-GPU. Mirrored exactly by the host ledger in plugins/gpushare.py.
+    gmem = tb.grp_gpu_mem[g]
+    gnum = tb.grp_gpu_num[g]
+    safe_mem = jnp.maximum(gmem, 1.0)
+    dev_total_c = tb.dev_total[c]                                   # [MAXDEV]
+    idle_c = dev_total_c - cry.dev_used[c]
+    units_c = jnp.maximum(jnp.where(dev_total_c > 0, jnp.floor(idle_c / safe_mem), 0.0), 0.0)
+    # multi-GPU: first `gnum` units in device order
+    cum = jnp.cumsum(units_c)
+    take_multi = jnp.clip(gnum - (cum - units_c), 0.0, units_c)
+    # single GPU: lowest-index tightest fit
+    fit_dev = (idle_c >= gmem) & (dev_total_c > 0)
+    cand = jnp.argmin(jnp.where(fit_dev, idle_c, jnp.inf))
+    take_one = (jnp.arange(idle_c.shape[0]) == cand).astype(_F32)
+    take = jnp.where(gnum == 1, take_one, take_multi)
+    # pre-assigned ids charge exactly the annotated devices (host ledger add_pod)
+    take = jnp.where(tb.grp_gpu_pre[g], tb.grp_gpu_take[g], take)
+    gdo = dof * (gmem > 0)
+    dev_used = cry.dev_used.at[c].add(take * gmem * gdo)
+
+    return Carry(requested, nonzero, port_used, counter, carrier, dev_used)
 
 
 def _step(tb: Tables, cry: Carry, xs, n_zones: int):

@@ -82,6 +82,8 @@ def table_shardings(mesh: Mesh) -> kernels.Tables:
         ss_t=s(r), ss_skip=s(r),
         carr_dom=s(n), carr_use_anti=s(r), carr_hard_w=s(r), carr_pref_w=s(r),
         carr_sel_match_g=s(r), grp_carries=s(r),
+        grp_gpu_mem=s(r), grp_gpu_num=s(r), grp_gpu_pre=s(r), grp_gpu_take=s(r),
+        dev_total=s(P(NODE_AXIS, None)),
     )
 
 
@@ -95,6 +97,7 @@ def carry_shardings(mesh: Mesh) -> kernels.Carry:
         port_used=s(P(NODE_AXIS, None)),
         counter=s(P()),   # [T, D+1] domain counters are global state → replicated
         carrier=s(P()),
+        dev_used=s(P(NODE_AXIS, None)),
     )
 
 
@@ -116,6 +119,7 @@ def to_device_sharded(
         port_used=jax.device_put(bt.seed_port_used, cs.port_used),
         counter=jax.device_put(bt.seed_counter, cs.counter),
         carrier=jax.device_put(bt.seed_carrier, cs.carrier),
+        dev_used=jax.device_put(bt.seed_dev_used, cs.dev_used),
     )
     return tables, carry, bt
 
@@ -180,6 +184,7 @@ def schedule_scenarios_on_mesh(bt: BatchTables, mesh: Mesh, seed_requested_s: np
         port_used=jax.device_put(rep(bt.seed_port_used), sh(P(SCENARIO_AXIS, NODE_AXIS, None))),
         counter=jax.device_put(rep(bt.seed_counter), sh(P(SCENARIO_AXIS, None, None))),
         carrier=jax.device_put(rep(bt.seed_carrier), sh(P(SCENARIO_AXIS, None, None))),
+        dev_used=jax.device_put(rep(bt.seed_dev_used), sh(P(SCENARIO_AXIS, NODE_AXIS, None))),
     )
     vmapped = jax.vmap(
         lambda c: kernels.schedule_batch(

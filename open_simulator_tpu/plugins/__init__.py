@@ -1,0 +1,8 @@
+"""Extended-resource plugins: GPU-share memory bin-packing and Open-Local storage.
+
+These mirror the reference's out-of-tree scheduler plugins
+(/root/reference/pkg/simulator/plugin/), re-designed for the batched TPU engine:
+feasibility/score terms are evaluated as dense per-node tensors inside the scan
+kernel, while a host-side ledger replays allocations to assign device ids / volume
+groups and maintain the report annotations.
+"""

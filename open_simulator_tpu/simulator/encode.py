@@ -370,6 +370,11 @@ def scheduling_signature(pod: dict):
         tuple(pod_nonzero_cpu_mem(pod)),
         tuple(owner_kinds),
         tuple(images),
+        # gpu-share annotations change Filter/commit behavior (plugins/gpushare.py)
+        tuple(
+            annotations_of(pod).get(k)
+            for k in (C.AnnoGpuMem, C.AnnoGpuCount, C.AnnoGpuIndex)
+        ),
     )
 
 
@@ -444,6 +449,9 @@ class GroupInfo:
     aff_self: bool = False        # pod matches all its own required affinity selectors
     dns_elig: Optional[np.ndarray] = None  # [N] bool: nodes counted for min-match domains
     carried: List[CarrierSpec] = field(default_factory=list)
+    gpu_mem: float = 0.0          # per-GPU memory request (gpu-share annotations)
+    gpu_num: float = 0.0
+    gpu_pre_ids: Optional[List[int]] = None  # pre-assigned device ids (gpu-index)
 
 
 class Encoder:
@@ -460,6 +468,7 @@ class Encoder:
         self.carriers: Dict[CarrierSpec, int] = {}
         self.carrier_list: List[CarrierSpec] = []
         self.ports = StringTable()  # (protocol, port) → id; hostIP folded (see kernels)
+        self.gpu_host = None  # plugins.gpushare.GpuShareHost, set by the engine
 
     # -- interning ---------------------------------------------------------------
 
@@ -526,6 +535,17 @@ class Encoder:
             image_raw=self._image_raw(pod),
             aff_self=True,
         )
+        from ..plugins.gpushare import gpu_id_str_to_list, pod_gpu_count, pod_gpu_index, pod_gpu_mem
+
+        g.gpu_mem = float(pod_gpu_mem(pod))
+        g.gpu_num = float(pod_gpu_count(pod))
+        pre = pod_gpu_index(pod)
+        if pre:
+            try:
+                ids = gpu_id_str_to_list(pre)
+                g.gpu_pre_ids = ids or None
+            except ValueError:
+                g.gpu_pre_ids = None  # invalid id falls back to normal allocation
         # inter-pod affinity terms
         req_aff, req_anti, pref = _affinity_terms(pod)
         for t in req_aff:
@@ -734,12 +754,19 @@ class BatchTables:
     carr_pref_w: np.ndarray      # [Tc] f32
     carr_sel_match_g: np.ndarray  # [Tc, G] bool
     grp_carries: np.ndarray      # [G, Tc] f32
+    # gpu-share
+    grp_gpu_mem: np.ndarray      # [G] f32
+    grp_gpu_num: np.ndarray      # [G] f32
+    grp_gpu_pre: np.ndarray      # [G] bool: pod carries a valid pre-assigned gpu-index
+    grp_gpu_take: np.ndarray     # [G, MAXDEV] f32: unit counts per device when pre-assigned
+    dev_total: np.ndarray        # [N, MAXDEV] f32
     # initial carry
     seed_requested: np.ndarray   # [N, R] f32
     seed_nonzero: np.ndarray     # [N, 2] f32
     seed_port_used: np.ndarray   # [N, PORT+1] bool
     seed_counter: np.ndarray     # [T, D+1] f32
     seed_carrier: np.ndarray     # [Tc, D+1] f32
+    seed_dev_used: np.ndarray    # [N, MAXDEV] f32
     # batch pods
     pod_group: np.ndarray        # [P] i32
     forced_node: np.ndarray      # [P] i32 (-1 = free)
@@ -805,9 +832,11 @@ def pad_batch_tables(bt: "BatchTables", multiple: int) -> "BatchTables":
         image_raw=_pad_axis(bt.image_raw, 1, target, 0.0),
         counter_dom=_pad_axis(bt.counter_dom, 1, target, D),
         carr_dom=_pad_axis(bt.carr_dom, 1, target, D),
+        dev_total=_pad_axis(bt.dev_total, 0, target, 0.0),
         seed_requested=_pad_axis(bt.seed_requested, 0, target, 0.0),
         seed_nonzero=_pad_axis(bt.seed_nonzero, 0, target, 0.0),
         seed_port_used=_pad_axis(bt.seed_port_used, 0, target, False),
+        seed_dev_used=_pad_axis(bt.seed_dev_used, 0, target, 0.0),
     )
 
 
@@ -866,6 +895,10 @@ def pad_encoder_axes(bt: "BatchTables") -> "BatchTables":
         grp_unknown=pad_axis(bt.grp_unknown, 0, Gp, False),
         grp_ports=pad_axis(pad_axis(bt.grp_ports, 0, Gp, 0), 1, _bucket(bt.grp_ports.shape[1]), 0),
         grp_aff_self=pad_axis(bt.grp_aff_self, 0, Gp, False),
+        grp_gpu_mem=pad_axis(bt.grp_gpu_mem, 0, Gp, 0.0),
+        grp_gpu_num=pad_axis(bt.grp_gpu_num, 0, Gp, 0.0),
+        grp_gpu_pre=pad_axis(bt.grp_gpu_pre, 0, Gp, False),
+        grp_gpu_take=pad_axis(bt.grp_gpu_take, 0, Gp, 0.0),
         ss_t=pad_axis(bt.ss_t, 0, Gp, -1),
         ss_skip=pad_axis(bt.ss_skip, 0, Gp, False),
         grp_carries=pad_axis(pad_axis(bt.grp_carries, 0, Gp, 0.0), 1, Tcp, 0.0),
@@ -1004,6 +1037,25 @@ def build_batch_tables(
             if d < D:
                 seed_carrier[cid, d] += 1.0
 
+    # ---- gpu-share tables -------------------------------------------------------
+    gpu_host = enc.gpu_host
+    if gpu_host is not None and gpu_host.enabled:
+        maxdev = _bucket(gpu_host.max_devs)
+        dev_total = gpu_host.dev_total_matrix(maxdev)
+        seed_dev_used = gpu_host.dev_used_matrix(maxdev)
+    else:
+        maxdev = 1
+        dev_total = np.zeros((N, 1), np.float32)
+        seed_dev_used = np.zeros((N, 1), np.float32)
+    grp_gpu_pre = np.zeros(G, bool)
+    grp_gpu_take = np.zeros((G, maxdev), np.float32)
+    for gi, g in enumerate(groups):
+        if g.gpu_pre_ids:
+            grp_gpu_pre[gi] = True
+            for d in g.gpu_pre_ids:
+                if 0 <= d < maxdev:  # out-of-range ids are skipped (reference warns)
+                    grp_gpu_take[gi, d] += 1.0
+
     # ---- batch pod arrays -------------------------------------------------------
     P = len(batch)
     P_pad = max(pad_to or P, P, 1)
@@ -1065,6 +1117,12 @@ def build_batch_tables(
         ),
         carr_sel_match_g=carr_sel_match_g,
         grp_carries=grp_carries,
+        grp_gpu_mem=np.array([g.gpu_mem for g in groups] or [0.0], np.float32),
+        grp_gpu_num=np.array([g.gpu_num for g in groups] or [0.0], np.float32),
+        grp_gpu_pre=grp_gpu_pre,
+        grp_gpu_take=grp_gpu_take,
+        dev_total=dev_total,
+        seed_dev_used=seed_dev_used,
         seed_requested=seed_requested,
         seed_nonzero=seed_nonzero,
         seed_port_used=seed_port_used,

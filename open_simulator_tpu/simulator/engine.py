@@ -126,6 +126,10 @@ class Simulator:
         self.model = ClusterModel()
         self.na = NodeArrays(nodes, self.axis)
         self.encoder = Encoder(self.na, self.axis, self.model)
+        from ..plugins.gpushare import GpuShareHost
+
+        self.gpu_host = GpuShareHost(self.na.nodes)
+        self.encoder.gpu_host = self.gpu_host
         self.placed: List[PlacedRecord] = []
         self.pods_on_node: List[List[dict]] = [[] for _ in nodes]
         self.homeless: List[dict] = []  # bound to a node name we don't know
@@ -137,13 +141,24 @@ class Simulator:
 
     # ------------------------------------------------------------- state ----------
 
-    def _commit_pod(self, pod: dict, node_i: int) -> None:
+    def _commit_pod(self, pod: dict, node_i: int, scheduled: bool = True) -> None:
         pod.setdefault("spec", {})["nodeName"] = self.na.names[node_i]
         pod["status"] = {"phase": "Running"}
+        # Snapshot the signature BEFORE reserve() writes gpu-index/assume-time
+        # annotations, so identical pods keep one signature (match-cache key).
+        sig = scheduling_signature(pod)
+        if scheduled:
+            # Open-Gpu-Share Reserve: assign device ids, write the gpu-index pod
+            # annotation + simon/node-gpu-share node annotation, adjust whole-GPU
+            # allocatable (open-gpu-share.go:147-188).
+            self.gpu_host.reserve(pod, node_i)
+        elif self.gpu_host.enabled:
+            # pre-bound pod with an existing gpu-index (live snapshot): account it
+            self.gpu_host.seed_pod(pod, node_i)
         rec = PlacedRecord(
             pod=pod,
             node_i=node_i,
-            sig=scheduling_signature(pod),
+            sig=sig,
             labels=labels_of(pod),
             namespace=namespace_of(pod),
             req_vec=self.axis.pod_vector(pod).astype(np.float32),
@@ -195,7 +210,7 @@ class Simulator:
                 # them from every report; we keep them findable on self.homeless.
                 self.homeless.append(pod)
             else:
-                self._commit_pod(pod, ni)
+                self._commit_pod(pod, ni, scheduled=False)
         failed.extend(self._schedule_run(run))
         return failed
 
@@ -271,6 +286,7 @@ class Simulator:
             port_used=jnp.asarray(bt.seed_port_used),
             counter=jnp.asarray(bt.seed_counter),
             carrier=jnp.asarray(bt.seed_carrier),
+            dev_used=jnp.asarray(bt.seed_dev_used),
         )
         return tables, carry
 
@@ -285,6 +301,7 @@ class Simulator:
         ("spread", "node(s) didn't match pod topology spread constraints"),
         ("pod_affinity", "node(s) didn't match pod affinity rules"),
         ("pod_anti", "node(s) didn't match pod anti-affinity rules"),
+        ("gpu", None),  # expanded per-node below (gpu-share Filter says "Node:<name>")
     )
 
     def _explain_reasons(self, pod: dict, g: int, forced: int, tables, carry) -> Dict[str, int]:
@@ -325,6 +342,13 @@ class Simulator:
                             taint.get("key", ""), taint.get("value") or "")
                     reasons[lbl] = reasons.get(lbl, 0) + 1
                 remaining &= stages["taint"]
+            elif stage == "gpu":
+                # Open-Gpu-Share Filter returns "Node:<name>" (open-gpu-share.go:66,76)
+                fail = remaining & ~stages["gpu"]
+                for i in np.nonzero(fail)[0]:
+                    lbl = f"Node:{self.na.names[i]}"
+                    reasons[lbl] = reasons.get(lbl, 0) + 1
+                remaining &= stages["gpu"]
             elif stage == "fit":
                 fit_each = stages["fit_each"]  # [N, R]
                 fail = remaining & ~stages["fit"]

@@ -1,0 +1,185 @@
+"""GPU-share plugin: allocator parity, batched filter, reserve/annotations."""
+
+import json
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu import simulate
+from open_simulator_tpu.core.types import AppResource, ResourceTypes
+from open_simulator_tpu.plugins.gpushare import (
+    allocate_gpu_ids,
+    gpu_id_str_to_list,
+    pod_gpu_count,
+    pod_gpu_mem,
+)
+
+from fixtures import make_node, make_pod
+
+GI = 1 << 30
+
+
+def gpu_node(name, count=2, total_mem=32 * GI, cpu="64", mem="256Gi", model="V100"):
+    return make_node(
+        name, cpu=cpu, memory=mem,
+        labels={"alibabacloud.com/gpu-card-model": model},
+        extra_resources={
+            "alibabacloud.com/gpu-count": str(count),
+            "alibabacloud.com/gpu-mem": str(total_mem),
+        },
+    )
+
+
+def gpu_pod(name, mem_gi=1, count=1, cpu="1", memory="1Gi"):
+    pod = make_pod(name, cpu=cpu, memory=memory)
+    pod["metadata"]["annotations"] = {
+        "alibabacloud.com/gpu-mem": f"{mem_gi}Gi",
+        "alibabacloud.com/gpu-count": str(count),
+    }
+    return pod
+
+
+# ------------------------------------------------------------------- allocator ------
+
+
+def test_allocator_single_tightest_fit():
+    # dev0 idle 10, dev1 idle 4, dev2 idle 6 -> request 3 lands on dev1 (tightest)
+    ids, found = allocate_gpu_ids([10, 10, 10], [0, 6, 4], 3, 1)
+    assert found and ids == "1"
+
+
+def test_allocator_single_lowest_index_on_tie():
+    ids, found = allocate_gpu_ids([10, 10], [2, 2], 4, 1)
+    assert found and ids == "0"
+
+
+def test_allocator_multi_packs_one_device():
+    # 3 units of 2 onto dev0 (idle 10): two-pointer packs all on dev0
+    ids, found = allocate_gpu_ids([10, 10], [0, 0], 2, 3)
+    assert found and ids == "0-0-0"
+
+
+def test_allocator_multi_spills_in_order():
+    # dev0 idle 3 (1 unit of 2), dev1 idle 10 (rest)
+    ids, found = allocate_gpu_ids([10, 10], [7, 0], 2, 3)
+    assert found and ids == "0-1-1"
+
+
+def test_allocator_infeasible():
+    ids, found = allocate_gpu_ids([4, 4], [3, 3], 2, 3)
+    assert not found
+    assert allocate_gpu_ids([4], [0], 5, 1) == ("", False)
+    assert allocate_gpu_ids([4], [0], 0, 1) == ("", False)
+    assert allocate_gpu_ids([4], [0], 2, 0) == ("", False)
+
+
+def test_allocator_preassigned_id_wins():
+    ids, found = allocate_gpu_ids([10], [0], 2, 1, preassigned="7")
+    assert found and ids == "7"
+
+
+# ------------------------------------------------------------------ annotations -----
+
+
+def test_pod_annotation_parsing():
+    p = gpu_pod("p", mem_gi=2, count=3)
+    assert pod_gpu_mem(p) == 2 * GI
+    assert pod_gpu_count(p) == 3
+    assert gpu_id_str_to_list("2-3-4") == [2, 3, 4]
+    assert gpu_id_str_to_list("") == []
+    assert pod_gpu_mem(make_pod("x")) == 0
+
+
+# -------------------------------------------------------------------- simulation ----
+
+
+def _sim(nodes, pods):
+    cluster = ResourceTypes(nodes=nodes)
+    rt = ResourceTypes(pods=pods)
+    return simulate(cluster, [AppResource(name="gpu", resource=rt)])
+
+
+def test_gpu_pods_scheduled_and_annotated():
+    nodes = [gpu_node("g0", count=2, total_mem=4 * GI)]
+    pods = [gpu_pod(f"p{i}", mem_gi=1, count=1) for i in range(4)]
+    res = _sim(nodes, pods)
+    assert not res.unscheduled_pods
+    placed = res.node_status[0].pods
+    assert len(placed) == 4
+    for p in placed:
+        assert p["metadata"]["annotations"]["alibabacloud.com/gpu-index"] in ("0", "1")
+    # 2 devices × 2Gi each, 4 × 1Gi pods → 2 per device
+    info = json.loads(
+        res.node_status[0].node["metadata"]["annotations"]["simon/node-gpu-share"]
+    )
+    assert info["GpuCount"] == 2
+    assert info["GpuAllocatable"] == 0  # both devices full
+    assert info["NumPods"] == 4
+    assert res.node_status[0].node["status"]["allocatable"]["alibabacloud.com/gpu-count"] == "0"
+
+
+def test_gpu_memory_exhaustion_unschedulable():
+    nodes = [gpu_node("g0", count=1, total_mem=2 * GI)]
+    pods = [gpu_pod(f"p{i}", mem_gi=1, count=1) for i in range(3)]
+    res = _sim(nodes, pods)
+    assert len(res.unscheduled_pods) == 1
+    assert "Node:g0" in res.unscheduled_pods[0].reason
+
+
+def test_gpu_count_annotation_required():
+    nodes = [gpu_node("g0")]
+    pod = gpu_pod("p0", mem_gi=1)
+    del pod["metadata"]["annotations"]["alibabacloud.com/gpu-count"]
+    res = _sim(nodes, [pod])
+    # GetGpuCountFromPodAnnotation -> 0 -> AllocateGpuId not found -> unschedulable
+    assert len(res.unscheduled_pods) == 1
+
+
+def test_non_gpu_node_filtered_for_gpu_pod():
+    nodes = [make_node("cpu-only"), gpu_node("g0", count=1, total_mem=4 * GI)]
+    res = _sim(nodes, [gpu_pod("p0", mem_gi=1)])
+    assert not res.unscheduled_pods
+    by_name = {ns.node["metadata"]["name"]: ns.pods for ns in res.node_status}
+    assert len(by_name["g0"]) == 1 and not by_name["cpu-only"]
+
+
+def test_multi_gpu_pod_allocation():
+    nodes = [gpu_node("g0", count=4, total_mem=16 * GI)]  # 4 devs × 4Gi
+    res = _sim(nodes, [gpu_pod("p0", mem_gi=3, count=3)])
+    assert not res.unscheduled_pods
+    idx = res.node_status[0].pods[0]["metadata"]["annotations"]["alibabacloud.com/gpu-index"]
+    assert idx == "0-1-2"  # one 3Gi unit fits per 4Gi device
+
+
+def test_preassigned_gpu_index_respected():
+    """A pod with an existing gpu-index bypasses device-fit (reference early-return,
+    gpunodeinfo.go:247-253) and charges the annotated device — even past capacity."""
+    nodes = [gpu_node("g0", count=2, total_mem=4 * GI)]  # 2 devs × 2Gi
+    pinned = gpu_pod("pinned", mem_gi=2, count=1)
+    pinned["metadata"]["annotations"]["alibabacloud.com/gpu-index"] = "1"
+    filler = gpu_pod("filler", mem_gi=2, count=1)  # must land on dev0 (dev1 full)
+    res = _sim(nodes, [pinned, filler])
+    assert not res.unscheduled_pods
+    by_name = {p["metadata"]["name"]: p for p in res.node_status[0].pods}
+    assert by_name["pinned"]["metadata"]["annotations"]["alibabacloud.com/gpu-index"] == "1"
+    assert by_name["filler"]["metadata"]["annotations"]["alibabacloud.com/gpu-index"] == "0"
+
+
+def test_reference_gpushare_example():
+    """Drive the reference's gpushare example cluster + pods end to end."""
+    import os
+
+    from open_simulator_tpu.utils.yamlio import load_resources_from_directory
+
+    base = "/root/reference/example"
+    if not os.path.isdir(os.path.join(base, "cluster/gpushare")):
+        pytest.skip("reference examples not mounted")
+    cluster = load_resources_from_directory(os.path.join(base, "cluster/gpushare"))
+    apps = load_resources_from_directory(os.path.join(base, "application/gpushare"))
+    res = simulate(cluster, [AppResource(name="gpushare", resource=apps)])
+    placed = [p for ns in res.node_status for p in ns.pods]
+    # raw gpu pods 00-02 carry annotations and must be placed with device ids
+    gpu_placed = [p for p in placed if pod_gpu_mem(p) > 0]
+    assert gpu_placed, "expected annotated gpu pods to be placed"
+    for p in gpu_placed:
+        assert p["metadata"]["annotations"].get("alibabacloud.com/gpu-index")
