@@ -29,7 +29,11 @@ from ..simulator.core import simulate
 from ..utils.objutil import annotations_of, labels_of, name_of, namespace_of, pod_resource_requests
 from ..utils.quantity import format_quantity, parse_milli, parse_quantity
 from ..utils.storage import NodeStorage
-from ..utils.yamlio import load_json_files, load_resources_from_directory
+from ..utils.yamlio import (
+    load_cluster_from_directory,
+    load_resources_from_directory,
+    match_and_set_local_storage_annotation,
+)
 
 MAX_AUTO_NODES = 10_000  # auto-search upper bound before giving up
 
@@ -63,7 +67,7 @@ class Applier:
             from ..simulator.live import create_cluster_resource_from_client
 
             return create_cluster_resource_from_client(c.kube_config)
-        return load_resources_from_directory(c.custom_cluster)
+        return load_cluster_from_directory(c.custom_cluster)
 
     def _load_apps(self) -> List[AppResource]:
         apps: List[AppResource] = []
@@ -87,14 +91,8 @@ class Applier:
         rt = load_resources_from_directory(path)
         if not rt.nodes:
             return None
-        storage = load_json_files(path)
-        node = rt.nodes[0]
-        info = storage.get(name_of(node))
-        if info is not None:
-            node.setdefault("metadata", {}).setdefault("annotations", {})[
-                C.AnnoNodeLocalStorage
-            ] = json.dumps(info)
-        return node
+        match_and_set_local_storage_annotation(rt.nodes, path)
+        return rt.nodes[0]
 
     # ------------------------------------------------------------------- run ------
 

@@ -53,6 +53,7 @@ W_SIMON = 1.0
 # (GetAndSetSchedulerConfig, pkg/simulator/utils.go:321-333) — so its contribution
 # is exactly a second Simon term.
 W_GPUSHARE = 1.0
+W_OPENLOCAL = 1.0
 
 _F32 = jnp.float32
 
@@ -103,6 +104,15 @@ class Tables(NamedTuple):
     grp_gpu_pre: jax.Array   # [G] bool: valid pre-assigned gpu-index present
     grp_gpu_take: jax.Array  # [G, MAXDEV] f32: unit counts per device when pre-assigned
     dev_total: jax.Array     # [N, MAXDEV] f32: per-device total memory (0 = absent)
+    # Open-Local storage (plugins/openlocal.py; VG/device state in the carry)
+    grp_lvm_size: jax.Array   # [G, SL] f32: LVM volume sizes (0 = unused slot)
+    grp_lvm_vg: jax.Array     # [G, SL] i32: VG name id (0 = unnamed → Binpack)
+    grp_sdev_size: jax.Array  # [G, SD] f32: device volume sizes (ssd-asc then hdd-asc)
+    grp_sdev_media: jax.Array  # [G, SD] i32: 1 hdd / 2 ssd (0 = unused)
+    vg_cap: jax.Array         # [N, MAXVG] f32 (0 = absent VG)
+    vg_nameid: jax.Array      # [N, MAXVG] i32
+    sdev_cap: jax.Array       # [N, MAXSD] f32
+    sdev_media: jax.Array     # [N, MAXSD] i32
 
 
 class Carry(NamedTuple):
@@ -114,10 +124,95 @@ class Carry(NamedTuple):
     counter: jax.Array      # [T, D+1] f32
     carrier: jax.Array      # [Tc, D+1] f32
     dev_used: jax.Array     # [N, MAXDEV] f32: per-GPU-device used memory
+    vg_req: jax.Array       # [N, MAXVG] f32: LVM volume-group requested bytes
+    sdev_alloc: jax.Array   # [N, MAXSD] f32: 1.0 = exclusive device allocated
 
 
 def _flr(x):
     return jnp.floor(x)
+
+
+def storage_alloc(tb: Tables, cry: Carry, g):
+    """Simulate Open-Local allocation of group g's volumes on EVERY node at once.
+
+    Sequential semantics per volume slot (named-VG exact / unnamed Binpack
+    tightest-fit; devices: smallest fitting free device of the media type), with a
+    small unrolled loop over the (bucketed, tiny) slot axes. Returns a dict with:
+    ok [N], lvm_add [N,V], dev_add [N,Dv] (one-hot allocations), raw score [N]
+    (int LVM + int device, Binpack strategy), has_storage (scalar bool).
+
+    Called from feasibility, scores, and commit with identical inputs — XLA's CSE
+    collapses the three evaluations into one inside the fused scan step.
+    """
+    N, V = tb.vg_cap.shape
+    Dv = tb.sdev_cap.shape[1]
+    SL = tb.grp_lvm_size.shape[1]
+    SD = tb.grp_sdev_size.shape[1]
+
+    ok = jnp.ones(N, bool)
+    lvm_add = jnp.zeros((N, V), _F32)
+    for s in range(SL):
+        size = tb.grp_lvm_size[g, s]
+        nid = tb.grp_lvm_vg[g, s]
+        active = size > 0
+        free = tb.vg_cap - (cry.vg_req + lvm_add)
+        named = nid > 0
+        slot_named = tb.vg_nameid == nid
+        named_fit = jnp.any(slot_named & (free >= size), axis=1)
+        t_named = jnp.argmax(slot_named, axis=1)
+        cand = (tb.vg_cap > 0) & (free >= size)
+        un_fit = jnp.any(cand, axis=1)
+        t_un = jnp.argmin(jnp.where(cand, free, jnp.inf), axis=1)
+        fit = jnp.where(named, named_fit, un_fit)
+        tgt = jnp.where(named, t_named, t_un)
+        take = (jnp.arange(V)[None, :] == tgt[:, None]).astype(_F32)
+        lvm_add = lvm_add + take * size * (fit & active)[:, None]
+        ok &= fit | ~active
+
+    dev_add = jnp.zeros((N, Dv), _F32)
+    dev_acc = jnp.zeros(N, _F32)
+    dev_units = jnp.float32(0.0)
+    for s in range(SD):
+        size = tb.grp_sdev_size[g, s]
+        media = tb.grp_sdev_media[g, s]
+        active = size > 0
+        free_dev = (
+            (tb.sdev_media == media) & (cry.sdev_alloc + dev_add < 0.5)
+            & (tb.sdev_cap >= size) & (tb.sdev_cap > 0)
+        )
+        fit = jnp.any(free_dev, axis=1)
+        tgt = jnp.argmin(jnp.where(free_dev, tb.sdev_cap, jnp.inf), axis=1)
+        take = (jnp.arange(Dv)[None, :] == tgt[:, None]).astype(_F32)
+        take = take * (fit & active)[:, None]
+        dev_add = dev_add + take
+        ok &= fit | ~active
+        chosen_cap = jnp.sum(take * tb.sdev_cap, axis=1)
+        dev_acc += jnp.where(active & fit, size / jnp.maximum(chosen_cap, 1.0), 0.0)
+        dev_units += active.astype(_F32)
+
+    has_lvm = jnp.any(tb.grp_lvm_size[g] > 0)
+    has_dev = jnp.any(tb.grp_sdev_size[g] > 0)
+    has_storage = has_lvm | has_dev
+
+    # ScoreLVM (Binpack): avg over used VGs of used/capacity × 10, int-truncated
+    used_mask = lvm_add > 0
+    vg_frac = jnp.where(used_mask & (tb.vg_cap > 0), lvm_add / jnp.maximum(tb.vg_cap, 1.0), 0.0)
+    n_used = jnp.sum(used_mask.astype(_F32), axis=1)
+    lvm_raw = jnp.where(
+        has_lvm & (n_used > 0),
+        _flr(jnp.sum(vg_frac, axis=1) / jnp.maximum(n_used, 1.0) * 10.0),
+        0.0,
+    )
+    dev_raw = jnp.where(
+        has_dev & (dev_units > 0), _flr(dev_acc / jnp.maximum(dev_units, 1.0) * 10.0), 0.0
+    )
+    return {
+        "ok": ok | ~has_storage,
+        "lvm_add": lvm_add,
+        "dev_add": dev_add,
+        "raw": lvm_raw + dev_raw,
+        "has_storage": has_storage,
+    }
 
 
 def feasibility(tb: Tables, cry: Carry, g, forced, valid) -> Tuple[jax.Array, dict]:
@@ -197,7 +292,11 @@ def feasibility(tb: Tables, cry: Carry, g, forced, valid) -> Tuple[jax.Array, di
     gpu_fit = jnp.where(tb.grp_gpu_pre[g], gpu_pre_fit, gpu_fit)
     gpu_ok = jnp.where(has_gpu, gpu_fit, jnp.ones_like(gpu_fit))
 
-    feasible = smask & fit & ~conflict & aff_ok & ~blocked_in & ~blocked_ex & dns_ok & gpu_ok
+    # Open-Local Filter (open-local.go:51-92)
+    storage_ok = storage_alloc(tb, cry, g)["ok"]
+
+    feasible = (smask & fit & ~conflict & aff_ok & ~blocked_in & ~blocked_ex
+                & dns_ok & gpu_ok & storage_ok)
     feasible &= valid
     iota = jnp.arange(N)
     feasible = jnp.where(forced >= 0, feasible & (iota == forced), feasible)
@@ -214,6 +313,7 @@ def feasibility(tb: Tables, cry: Carry, g, forced, valid) -> Tuple[jax.Array, di
         "pod_anti": ~(blocked_in | blocked_ex),
         "spread": dns_ok,
         "gpu": gpu_ok,
+        "storage": storage_ok,
     }
     return feasible, stages
 
@@ -313,9 +413,23 @@ def scores(tb: Tables, cry: Carry, g, feasible, n_zones: int) -> jax.Array:
         jnp.where(sa_max > 0, _flr((sa_max + sa_min - sa_raw) * 100.0 / sa_max), 100.0),
     )
 
+    # Open-Local Score (open-local.go:94-172): Binpack LVM + device ints, then the
+    # plugin's own min-max NormalizeScore. Pods without volumes raw-score 0 on
+    # every node → constant → normalizes to 0 (inert).
+    st = storage_alloc(tb, cry, g)
+    st_raw = st["raw"]
+    st_hi = jnp.maximum(jnp.max(jnp.where(F, st_raw, -jnp.inf)), 0.0)
+    st_lo_raw = jnp.min(jnp.where(F, st_raw, jnp.inf))
+    st_lo = jnp.where(jnp.isfinite(st_lo_raw), st_lo_raw, 0.0)
+    st_rng = st_hi - st_lo
+    openlocal = jnp.where(
+        st["has_storage"] & (st_rng > 0), _flr((st_raw - st_lo) * 100.0 / st_rng), 0.0
+    )
+
     total = (
         W_LEAST * least
         + W_BALANCED * balanced
+        + W_OPENLOCAL * openlocal
         + (W_SIMON + W_GPUSHARE) * simon  # Open-Gpu-Share Score ≡ Simon Score
         + W_NODEAFF * nodeaff
         + W_TAINT * taint
@@ -371,7 +485,14 @@ def commit(tb: Tables, cry: Carry, g, choice, do) -> Carry:
     gdo = dof * (gmem > 0)
     dev_used = cry.dev_used.at[c].add(take * gmem * gdo)
 
-    return Carry(requested, nonzero, port_used, counter, carrier, dev_used)
+    # Open-Local Bind: bump VG requested, mark devices allocated (open-local.go:215-250)
+    st = storage_alloc(tb, cry, g)
+    sdo = dof * st["has_storage"].astype(_F32)
+    vg_req = cry.vg_req.at[c].add(st["lvm_add"][c] * sdo)
+    sdev_alloc = cry.sdev_alloc.at[c].add(st["dev_add"][c] * sdo)
+
+    return Carry(requested, nonzero, port_used, counter, carrier, dev_used,
+                 vg_req, sdev_alloc)
 
 
 def _step(tb: Tables, cry: Carry, xs, n_zones: int):

@@ -84,6 +84,9 @@ def table_shardings(mesh: Mesh) -> kernels.Tables:
         carr_sel_match_g=s(r), grp_carries=s(r),
         grp_gpu_mem=s(r), grp_gpu_num=s(r), grp_gpu_pre=s(r), grp_gpu_take=s(r),
         dev_total=s(P(NODE_AXIS, None)),
+        grp_lvm_size=s(r), grp_lvm_vg=s(r), grp_sdev_size=s(r), grp_sdev_media=s(r),
+        vg_cap=s(P(NODE_AXIS, None)), vg_nameid=s(P(NODE_AXIS, None)),
+        sdev_cap=s(P(NODE_AXIS, None)), sdev_media=s(P(NODE_AXIS, None)),
     )
 
 
@@ -98,6 +101,8 @@ def carry_shardings(mesh: Mesh) -> kernels.Carry:
         counter=s(P()),   # [T, D+1] domain counters are global state → replicated
         carrier=s(P()),
         dev_used=s(P(NODE_AXIS, None)),
+        vg_req=s(P(NODE_AXIS, None)),
+        sdev_alloc=s(P(NODE_AXIS, None)),
     )
 
 
@@ -120,6 +125,8 @@ def to_device_sharded(
         counter=jax.device_put(bt.seed_counter, cs.counter),
         carrier=jax.device_put(bt.seed_carrier, cs.carrier),
         dev_used=jax.device_put(bt.seed_dev_used, cs.dev_used),
+        vg_req=jax.device_put(bt.seed_vg_req, cs.vg_req),
+        sdev_alloc=jax.device_put(bt.seed_sdev_alloc, cs.sdev_alloc),
     )
     return tables, carry, bt
 
@@ -185,6 +192,8 @@ def schedule_scenarios_on_mesh(bt: BatchTables, mesh: Mesh, seed_requested_s: np
         counter=jax.device_put(rep(bt.seed_counter), sh(P(SCENARIO_AXIS, None, None))),
         carrier=jax.device_put(rep(bt.seed_carrier), sh(P(SCENARIO_AXIS, None, None))),
         dev_used=jax.device_put(rep(bt.seed_dev_used), sh(P(SCENARIO_AXIS, NODE_AXIS, None))),
+        vg_req=jax.device_put(rep(bt.seed_vg_req), sh(P(SCENARIO_AXIS, NODE_AXIS, None))),
+        sdev_alloc=jax.device_put(rep(bt.seed_sdev_alloc), sh(P(SCENARIO_AXIS, NODE_AXIS, None))),
     )
     vmapped = jax.vmap(
         lambda c: kernels.schedule_batch(

@@ -370,10 +370,10 @@ def scheduling_signature(pod: dict):
         tuple(pod_nonzero_cpu_mem(pod)),
         tuple(owner_kinds),
         tuple(images),
-        # gpu-share annotations change Filter/commit behavior (plugins/gpushare.py)
+        # extended-resource annotations change Filter/commit behavior (plugins/)
         tuple(
             annotations_of(pod).get(k)
-            for k in (C.AnnoGpuMem, C.AnnoGpuCount, C.AnnoGpuIndex)
+            for k in (C.AnnoGpuMem, C.AnnoGpuCount, C.AnnoGpuIndex, C.AnnoPodLocalStorage)
         ),
     )
 
@@ -452,6 +452,11 @@ class GroupInfo:
     gpu_mem: float = 0.0          # per-GPU memory request (gpu-share annotations)
     gpu_num: float = 0.0
     gpu_pre_ids: Optional[List[int]] = None  # pre-assigned device ids (gpu-index)
+    # open-local volume slots, in processing order (plugins/openlocal.py)
+    lvm_sizes: List[float] = field(default_factory=list)
+    lvm_vg_ids: List[int] = field(default_factory=list)   # 0 = unnamed (Binpack)
+    sdev_sizes: List[float] = field(default_factory=list)
+    sdev_media: List[int] = field(default_factory=list)   # 1 hdd / 2 ssd
 
 
 class Encoder:
@@ -469,6 +474,7 @@ class Encoder:
         self.carrier_list: List[CarrierSpec] = []
         self.ports = StringTable()  # (protocol, port) → id; hostIP folded (see kernels)
         self.gpu_host = None  # plugins.gpushare.GpuShareHost, set by the engine
+        self.local_host = None  # plugins.openlocal.OpenLocalHost, set by the engine
 
     # -- interning ---------------------------------------------------------------
 
@@ -546,6 +552,20 @@ class Encoder:
                 g.gpu_pre_ids = ids or None
             except ValueError:
                 g.gpu_pre_ids = None  # invalid id falls back to normal allocation
+
+        if self.local_host is not None:
+            # Volumes are encoded even when NO node has local storage: the filter
+            # then fails everywhere, matching the reference's nil-node-cache
+            # Unschedulable (open-local.go:60-70).
+            from ..plugins.openlocal import resolve_pod_volumes
+
+            lvm, dev = resolve_pod_volumes(pod, self.model.storage_classes)
+            g.lvm_sizes = [float(v.size) for v in lvm]
+            g.lvm_vg_ids = [
+                self.local_host.vg_name_id(v.vg_name) if v.vg_name else 0 for v in lvm
+            ]
+            g.sdev_sizes = [float(v.size) for v in dev]
+            g.sdev_media = [2 if v.media == "ssd" else 1 for v in dev]
         # inter-pod affinity terms
         req_aff, req_anti, pref = _affinity_terms(pod)
         for t in req_aff:
@@ -760,6 +780,15 @@ class BatchTables:
     grp_gpu_pre: np.ndarray      # [G] bool: pod carries a valid pre-assigned gpu-index
     grp_gpu_take: np.ndarray     # [G, MAXDEV] f32: unit counts per device when pre-assigned
     dev_total: np.ndarray        # [N, MAXDEV] f32
+    # open-local
+    grp_lvm_size: np.ndarray     # [G, SL] f32
+    grp_lvm_vg: np.ndarray       # [G, SL] i32 (0 = unnamed)
+    grp_sdev_size: np.ndarray    # [G, SD] f32
+    grp_sdev_media: np.ndarray   # [G, SD] i32 (1 hdd / 2 ssd; 0 unused)
+    vg_cap: np.ndarray           # [N, MAXVG] f32
+    vg_nameid: np.ndarray        # [N, MAXVG] i32
+    sdev_cap: np.ndarray         # [N, MAXSD] f32
+    sdev_media: np.ndarray       # [N, MAXSD] i32
     # initial carry
     seed_requested: np.ndarray   # [N, R] f32
     seed_nonzero: np.ndarray     # [N, 2] f32
@@ -767,6 +796,8 @@ class BatchTables:
     seed_counter: np.ndarray     # [T, D+1] f32
     seed_carrier: np.ndarray     # [Tc, D+1] f32
     seed_dev_used: np.ndarray    # [N, MAXDEV] f32
+    seed_vg_req: np.ndarray      # [N, MAXVG] f32
+    seed_sdev_alloc: np.ndarray  # [N, MAXSD] f32
     # batch pods
     pod_group: np.ndarray        # [P] i32
     forced_node: np.ndarray      # [P] i32 (-1 = free)
@@ -833,10 +864,16 @@ def pad_batch_tables(bt: "BatchTables", multiple: int) -> "BatchTables":
         counter_dom=_pad_axis(bt.counter_dom, 1, target, D),
         carr_dom=_pad_axis(bt.carr_dom, 1, target, D),
         dev_total=_pad_axis(bt.dev_total, 0, target, 0.0),
+        vg_cap=_pad_axis(bt.vg_cap, 0, target, 0.0),
+        vg_nameid=_pad_axis(bt.vg_nameid, 0, target, 0),
+        sdev_cap=_pad_axis(bt.sdev_cap, 0, target, 0.0),
+        sdev_media=_pad_axis(bt.sdev_media, 0, target, 0),
         seed_requested=_pad_axis(bt.seed_requested, 0, target, 0.0),
         seed_nonzero=_pad_axis(bt.seed_nonzero, 0, target, 0.0),
         seed_port_used=_pad_axis(bt.seed_port_used, 0, target, False),
         seed_dev_used=_pad_axis(bt.seed_dev_used, 0, target, 0.0),
+        seed_vg_req=_pad_axis(bt.seed_vg_req, 0, target, 0.0),
+        seed_sdev_alloc=_pad_axis(bt.seed_sdev_alloc, 0, target, 0.0),
     )
 
 
@@ -899,6 +936,10 @@ def pad_encoder_axes(bt: "BatchTables") -> "BatchTables":
         grp_gpu_num=pad_axis(bt.grp_gpu_num, 0, Gp, 0.0),
         grp_gpu_pre=pad_axis(bt.grp_gpu_pre, 0, Gp, False),
         grp_gpu_take=pad_axis(bt.grp_gpu_take, 0, Gp, 0.0),
+        grp_lvm_size=pad_axis(pad_axis(bt.grp_lvm_size, 0, Gp, 0.0), 1, _bucket(bt.grp_lvm_size.shape[1]), 0.0),
+        grp_lvm_vg=pad_axis(pad_axis(bt.grp_lvm_vg, 0, Gp, 0), 1, _bucket(bt.grp_lvm_vg.shape[1]), 0),
+        grp_sdev_size=pad_axis(pad_axis(bt.grp_sdev_size, 0, Gp, 0.0), 1, _bucket(bt.grp_sdev_size.shape[1]), 0.0),
+        grp_sdev_media=pad_axis(pad_axis(bt.grp_sdev_media, 0, Gp, 0), 1, _bucket(bt.grp_sdev_media.shape[1]), 0),
         ss_t=pad_axis(bt.ss_t, 0, Gp, -1),
         ss_skip=pad_axis(bt.ss_skip, 0, Gp, False),
         grp_carries=pad_axis(pad_axis(bt.grp_carries, 0, Gp, 0.0), 1, Tcp, 0.0),
@@ -1056,6 +1097,27 @@ def build_batch_tables(
                 if 0 <= d < maxdev:  # out-of-range ids are skipped (reference warns)
                     grp_gpu_take[gi, d] += 1.0
 
+    # ---- open-local tables ------------------------------------------------------
+    local_host = enc.local_host
+    if local_host is not None and local_host.enabled:
+        maxvg = _bucket(max(local_host.max_vgs, 1))
+        maxsd = _bucket(max(local_host.max_devs, 1))
+        vg_cap, vg_nameid, seed_vg_req = local_host.vg_matrices(maxvg)
+        sdev_cap, sdev_media, seed_sdev_alloc = local_host.device_matrices(maxsd)
+        seed_sdev_alloc = seed_sdev_alloc.astype(np.float32)
+    else:
+        maxvg = maxsd = 1
+        vg_cap = seed_vg_req = np.zeros((N, 1), np.float32)
+        vg_nameid = np.zeros((N, 1), np.int32)
+        sdev_cap = seed_sdev_alloc = np.zeros((N, 1), np.float32)
+        sdev_media = np.zeros((N, 1), np.int32)
+    SL = max((len(g.lvm_sizes) for g in groups), default=0)
+    SD = max((len(g.sdev_sizes) for g in groups), default=0)
+    grp_lvm_size = _pad_slots([g.lvm_sizes for g in groups] or [[]], SL, 0.0, np.float32)
+    grp_lvm_vg = _pad_slots([g.lvm_vg_ids for g in groups] or [[]], SL, 0, np.int32)
+    grp_sdev_size = _pad_slots([g.sdev_sizes for g in groups] or [[]], SD, 0.0, np.float32)
+    grp_sdev_media = _pad_slots([g.sdev_media for g in groups] or [[]], SD, 0, np.int32)
+
     # ---- batch pod arrays -------------------------------------------------------
     P = len(batch)
     P_pad = max(pad_to or P, P, 1)
@@ -1122,6 +1184,16 @@ def build_batch_tables(
         grp_gpu_pre=grp_gpu_pre,
         grp_gpu_take=grp_gpu_take,
         dev_total=dev_total,
+        grp_lvm_size=grp_lvm_size,
+        grp_lvm_vg=grp_lvm_vg,
+        grp_sdev_size=grp_sdev_size,
+        grp_sdev_media=grp_sdev_media,
+        vg_cap=vg_cap,
+        vg_nameid=vg_nameid,
+        sdev_cap=sdev_cap,
+        sdev_media=sdev_media,
+        seed_vg_req=seed_vg_req,
+        seed_sdev_alloc=seed_sdev_alloc,
         seed_dev_used=seed_dev_used,
         seed_requested=seed_requested,
         seed_nonzero=seed_nonzero,

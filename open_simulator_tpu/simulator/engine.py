@@ -127,9 +127,12 @@ class Simulator:
         self.na = NodeArrays(nodes, self.axis)
         self.encoder = Encoder(self.na, self.axis, self.model)
         from ..plugins.gpushare import GpuShareHost
+        from ..plugins.openlocal import OpenLocalHost
 
         self.gpu_host = GpuShareHost(self.na.nodes)
         self.encoder.gpu_host = self.gpu_host
+        self.local_host = OpenLocalHost(self.na.nodes)
+        self.encoder.local_host = self.local_host
         self.placed: List[PlacedRecord] = []
         self.pods_on_node: List[List[dict]] = [[] for _ in nodes]
         self.homeless: List[dict] = []  # bound to a node name we don't know
@@ -152,6 +155,10 @@ class Simulator:
             # annotation + simon/node-gpu-share node annotation, adjust whole-GPU
             # allocatable (open-gpu-share.go:147-188).
             self.gpu_host.reserve(pod, node_i)
+            # Open-Local Bind: VG requested / device allocation writeback
+            # (open-local.go:215-250).
+            if self.local_host.enabled:
+                self.local_host.reserve(pod, node_i, self.model.storage_classes)
         elif self.gpu_host.enabled:
             # pre-bound pod with an existing gpu-index (live snapshot): account it
             self.gpu_host.seed_pod(pod, node_i)
@@ -287,6 +294,8 @@ class Simulator:
             counter=jnp.asarray(bt.seed_counter),
             carrier=jnp.asarray(bt.seed_carrier),
             dev_used=jnp.asarray(bt.seed_dev_used),
+            vg_req=jnp.asarray(bt.seed_vg_req),
+            sdev_alloc=jnp.asarray(bt.seed_sdev_alloc),
         )
         return tables, carry
 
@@ -302,6 +311,7 @@ class Simulator:
         ("pod_affinity", "node(s) didn't match pod affinity rules"),
         ("pod_anti", "node(s) didn't match pod anti-affinity rules"),
         ("gpu", None),  # expanded per-node below (gpu-share Filter says "Node:<name>")
+        ("storage", "node(s) didn't have enough local storage"),
     )
 
     def _explain_reasons(self, pod: dict, g: int, forced: int, tables, carry) -> Dict[str, int]:

@@ -67,6 +67,32 @@ def load_resources_from_directory(directory: str, strict: bool = True) -> Resour
     return bucket_objects(decode_yaml_content(read_yaml_files(directory)), strict=strict)
 
 
+def match_and_set_local_storage_annotation(nodes: List[dict], directory: str) -> None:
+    """MatchAndSetLocalStorageAnnotationOnNode (pkg/simulator/utils.go:385-401):
+    node-name-matched .json files in `directory` become the node's
+    simon/node-local-storage annotation."""
+    import json
+
+    from ..core import constants as C
+
+    storage = load_json_files(directory)
+    for node in nodes:
+        name = ((node.get("metadata") or {}).get("name")) or ""
+        info = storage.get(name)
+        if info is not None:
+            node.setdefault("metadata", {}).setdefault("annotations", {})[
+                C.AnnoNodeLocalStorage
+            ] = json.dumps(info)
+
+
+def load_cluster_from_directory(directory: str, strict: bool = True) -> ResourceTypes:
+    """CreateClusterResourceFromClusterConfig (simulator.go:604-619): YAML objects
+    plus node-name-matched local-storage specs applied as node annotations."""
+    rt = load_resources_from_directory(directory, strict=strict)
+    match_and_set_local_storage_annotation(rt.nodes, directory)
+    return rt
+
+
 def load_json_files(directory: str) -> dict:
     """name → parsed JSON for .json files in a dir (local-storage node specs,
     /root/reference/pkg/simulator/utils.go:385-401 matches node-name.json to nodes)."""

@@ -1,0 +1,226 @@
+"""Open-Local plugin: VG/device allocators, batched filter/score, bind writeback."""
+
+import json
+
+import pytest
+
+from open_simulator_tpu import simulate
+from open_simulator_tpu.core.types import AppResource, ResourceTypes
+from open_simulator_tpu.plugins.openlocal import (
+    OpenLocalVolume,
+    allocate_devices,
+    allocate_lvm,
+    resolve_pod_volumes,
+    score_binpack,
+)
+from open_simulator_tpu.utils.storage import VG, Device, NodeStorage
+
+from fixtures import make_node, make_pod, make_statefulset
+
+GI = 1 << 30
+
+
+def storage_node(name, vgs=None, devices=None, cpu="32", mem="64Gi"):
+    st = NodeStorage(
+        vgs=[VG(n, c) for n, c in (vgs or [])],
+        devices=[Device(d, c, m) for d, c, m in (devices or [])],
+    )
+    return make_node(name, cpu=cpu, memory=mem,
+                     annotations={"simon/node-local-storage": st.to_json()})
+
+
+def lvm_sc(name="open-local-lvm", vg_name=None):
+    sc = {"apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+          "metadata": {"name": name}, "provisioner": "local.csi.aliyun.com",
+          "parameters": {"volumeType": "LVM"}}
+    if vg_name:
+        sc["parameters"]["vgName"] = vg_name
+    return sc
+
+
+def device_sc(name, media):
+    return {"apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+            "metadata": {"name": name}, "provisioner": "local.csi.aliyun.com",
+            "parameters": {"volumeType": "Device", "mediaType": media}}
+
+
+def storage_pod(name, volumes, cpu="1", memory="1Gi"):
+    """volumes: [(size, kind, scName)]"""
+    pod = make_pod(name, cpu=cpu, memory=memory)
+    payload = {"volumes": [
+        {"size": str(s), "kind": k, "scName": sc} for s, k, sc in volumes
+    ]}
+    pod["metadata"]["annotations"] = {"simon/pod-local-storage": json.dumps(payload)}
+    return pod
+
+
+# ---------------------------------------------------------------- allocators --------
+
+
+def test_allocate_lvm_binpack_tightest():
+    vgs = [VG("a", 100), VG("b", 50)]
+    ok, units = allocate_lvm(vgs, [OpenLocalVolume(40, "LVM", "sc", "", "")])
+    assert ok and units == [(1, 40)]  # b has less free → tightest
+
+
+def test_allocate_lvm_named_vg():
+    vgs = [VG("a", 100), VG("b", 50)]
+    ok, units = allocate_lvm(vgs, [OpenLocalVolume(40, "LVM", "sc", "a", "")])
+    assert ok and units == [(0, 40)]
+    ok, _ = allocate_lvm(vgs, [OpenLocalVolume(40, "LVM", "sc", "missing", "")])
+    assert not ok
+
+
+def test_allocate_lvm_sequential_accounting():
+    vgs = [VG("a", 100)]
+    ok, units = allocate_lvm(vgs, [OpenLocalVolume(60, "LVM", "sc", "", ""),
+                                   OpenLocalVolume(60, "LVM", "sc", "", "")])
+    assert not ok  # second volume sees only 40 free
+
+
+def test_allocate_devices_media_and_size():
+    devs = [Device("/dev/a", 100, "hdd"), Device("/dev/b", 50, "ssd"),
+            Device("/dev/c", 200, "ssd")]
+    vols = [OpenLocalVolume(60, "SSD", "sc", "", "ssd")]
+    ok, units = allocate_devices(devs, vols)
+    assert ok and units == [(2, 60)]  # only the 200 ssd fits
+    ok, units = allocate_devices(devs, [OpenLocalVolume(40, "SSD", "sc", "", "ssd")])
+    assert ok and units == [(1, 40)]  # smallest fitting ssd
+    ok, _ = allocate_devices(devs, [OpenLocalVolume(300, "HDD", "sc", "", "hdd")])
+    assert not ok
+
+
+def test_score_binpack():
+    vgs = [VG("a", 100)]
+    devs = [Device("/dev/a", 100, "hdd")]
+    # lvm: 50/100 → 5; device: 80/100 → 8 → total 13
+    assert score_binpack(vgs, [(0, 50)], devs, [(0, 80)]) == 13
+    assert score_binpack(vgs, [], devs, []) == 0
+
+
+# ------------------------------------------------------------------- resolve --------
+
+
+def test_resolve_orders_and_media():
+    pod = storage_pod("p", [
+        (10, "HDD", "hdd-sc"), (5, "SSD", "ssd-sc"), (20, "LVM", "open-local-lvm"),
+        (7, "SSD", "ssd-sc"),
+    ])
+    scs = [lvm_sc(), device_sc("ssd-sc", "ssd"), device_sc("hdd-sc", "hdd")]
+    lvm, dev = resolve_pod_volumes(pod, scs)
+    assert [v.size for v in lvm] == [20]
+    assert [(v.media, v.size) for v in dev] == [("ssd", 5), ("ssd", 7), ("hdd", 10)]
+
+
+def test_resolve_drops_unknown_media():
+    pod = storage_pod("p", [(10, "SSD", "typo-sc")])
+    scs = [device_sc("typo-sc", "sdd")]  # the reference demo_1 typo
+    lvm, dev = resolve_pod_volumes(pod, scs)
+    assert not lvm and not dev
+
+
+# ----------------------------------------------------------------- simulation -------
+
+
+def _sim(nodes, pods, scs):
+    cluster = ResourceTypes(nodes=nodes, storage_classes=scs)
+    return simulate(cluster, [AppResource(name="app", resource=ResourceTypes(pods=pods))])
+
+
+def test_lvm_filter_and_writeback():
+    nodes = [storage_node("s0", vgs=[("pool", 10 * GI)]), make_node("plain")]
+    pods = [storage_pod(f"p{i}", [(4 * GI, "LVM", "open-local-lvm")]) for i in range(2)]
+    res = _sim(nodes, pods, [lvm_sc()])
+    assert not res.unscheduled_pods
+    by_name = {ns.node["metadata"]["name"]: ns for ns in res.node_status}
+    assert len(by_name["s0"].pods) == 2 and not by_name["plain"].pods
+    st = NodeStorage.from_json(
+        by_name["s0"].node["metadata"]["annotations"]["simon/node-local-storage"]
+    )
+    assert st.vgs[0].requested == 8 * GI
+
+
+def test_lvm_capacity_exhaustion():
+    nodes = [storage_node("s0", vgs=[("pool", 10 * GI)])]
+    pods = [storage_pod(f"p{i}", [(4 * GI, "LVM", "open-local-lvm")]) for i in range(3)]
+    res = _sim(nodes, pods, [lvm_sc()])
+    assert len(res.unscheduled_pods) == 1
+    assert "local storage" in res.unscheduled_pods[0].reason
+
+
+def test_device_exclusive_allocation():
+    nodes = [storage_node("s0", devices=[("/dev/a", 100 * GI, "hdd"),
+                                         ("/dev/b", 100 * GI, "hdd")])]
+    pods = [storage_pod(f"p{i}", [(10 * GI, "HDD", "hdd-sc")]) for i in range(3)]
+    res = _sim(nodes, pods, [device_sc("hdd-sc", "hdd")])
+    # 2 devices, exclusive → third pod unschedulable
+    assert len(res.unscheduled_pods) == 1
+    st = NodeStorage.from_json(
+        res.node_status[0].node["metadata"]["annotations"]["simon/node-local-storage"]
+    )
+    assert all(d.is_allocated for d in st.devices)
+
+
+def test_storage_pod_unschedulable_without_storage_nodes():
+    """Reference Filter: pod needs storage + node cache nil → Unschedulable
+    (open-local.go:60-70), even when NO node in the cluster has storage."""
+    nodes = [make_node("plain-1"), make_node("plain-2")]
+    pods = [storage_pod("p0", [(1 * GI, "LVM", "open-local-lvm")])]
+    res = _sim(nodes, pods, [lvm_sc()])
+    assert len(res.unscheduled_pods) == 1
+
+
+def test_kind_ignored_for_routing():
+    """Routing is by SC name, not Kind: kind LVM + device SC → device demand."""
+    nodes = [storage_node("s0", devices=[("/dev/a", 100 * GI, "ssd")])]
+    pod = storage_pod("p0", [(10 * GI, "LVM", "ssd-sc")])
+    res = _sim(nodes, [pod], [device_sc("ssd-sc", "ssd")])
+    assert not res.unscheduled_pods
+    st = NodeStorage.from_json(
+        res.node_status[0].node["metadata"]["annotations"]["simon/node-local-storage"]
+    )
+    assert st.devices[0].is_allocated
+
+
+def test_sts_volume_claims_via_annotation():
+    """StatefulSet volumeClaimTemplates flow through the pod annotation."""
+    nodes = [storage_node("s0", vgs=[("pool", 100 * GI)])]
+    sts = make_statefulset("db", replicas=2, cpu="1", memory="1Gi",
+                           volume_claim_templates=[
+                               {"metadata": {"name": "data"},
+                                "spec": {"storageClassName": "open-local-lvm",
+                                         "resources": {"requests": {"storage": "10Gi"}}}}
+                           ])
+    cluster = ResourceTypes(nodes=nodes, storage_classes=[lvm_sc()])
+    rt = ResourceTypes(stateful_sets=[sts])
+    res = simulate(cluster, [AppResource(name="db", resource=rt)])
+    assert not res.unscheduled_pods
+    st = NodeStorage.from_json(
+        res.node_status[0].node["metadata"]["annotations"]["simon/node-local-storage"]
+    )
+    assert st.vgs[0].requested == 20 * GI
+
+
+def test_reference_open_local_example():
+    """The reference's open_local app (4-replica STS wanting yoda VGs + hdd device)
+    against demo_1 nodes with yoda-pool VGs and /dev/vdd devices."""
+    import os
+
+    from open_simulator_tpu.utils.yamlio import load_cluster_from_directory, load_resources_from_directory
+
+    base = "/root/reference/example"
+    if not os.path.isdir(os.path.join(base, "application/open_local")):
+        pytest.skip("reference examples not mounted")
+    cluster = load_cluster_from_directory(os.path.join(base, "cluster/demo_1"))
+    app = load_resources_from_directory(os.path.join(base, "application/open_local"))
+    res = simulate(cluster, [AppResource(name="open_local", resource=app)])
+    placed = [p for ns in res.node_status for p in ns.pods
+              if "simon/pod-local-storage" in (p["metadata"].get("annotations") or {})]
+    # each placed storage pod must have bumped some VG on its node
+    assert placed
+    for ns in res.node_status:
+        if any(p in placed for p in ns.pods):
+            st = NodeStorage.from_json(
+                ns.node["metadata"]["annotations"]["simon/node-local-storage"]
+            )
+            assert any(vg.requested > 0 for vg in st.vgs)
