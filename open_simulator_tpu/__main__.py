@@ -1,0 +1,7 @@
+"""`python -m open_simulator_tpu` → the simon CLI."""
+
+import sys
+
+from .cli.main import main
+
+sys.exit(main())

@@ -1,0 +1,165 @@
+"""The `simon` CLI: apply / server / version / gen-doc.
+
+Mirrors the reference's cobra command tree (/root/reference/cmd/): same
+subcommands, flags (including shorthands), and the `LogLevel` env knob
+(cmd/simon/simon.go:46-66).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+from typing import List, Optional
+
+from .. import __version__
+from ..core import constants as C
+
+COMMIT_ID = ""  # stamped by packaging, like the reference's ldflags (Makefile:9-10)
+
+_LOG_LEVELS = {
+    "Panic": logging.CRITICAL,
+    "Fatal": logging.CRITICAL,
+    "Error": logging.ERROR,
+    "Warn": logging.WARNING,
+    "Info": logging.INFO,
+    "Debug": logging.DEBUG,
+    "Trace": logging.DEBUG,
+}
+
+
+def _init_logging() -> None:
+    level = _LOG_LEVELS.get(os.environ.get(C.EnvLogLevel, ""), logging.INFO)
+    logging.basicConfig(level=level, format="%(levelname)s %(message)s")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="simon",
+        description=(
+            "Simon is a simulator, which will simulate a cluster and simulate "
+            "workload scheduling."
+        ),
+    )
+    sub = parser.add_subparsers(dest="command", metavar="command")
+
+    p_apply = sub.add_parser(
+        "apply",
+        help="Make a reasonable cluster capacity planning based on application "
+             "resource requirements",
+    )
+    p_apply.add_argument(
+        "-f", "--simon-config", required=True,
+        help="path of the simon config file (simon/v1alpha1 Config)",
+    )
+    p_apply.add_argument(
+        "--default-scheduler-config", default="",
+        help="path to JSON or YAML file containing scheduler configuration.",
+    )
+    p_apply.add_argument("--output-file", default="", help="save report to output file.")
+    p_apply.add_argument(
+        "--use-greed", action="store_true", help="use greedy algorithm when queue pods"
+    )
+    p_apply.add_argument(
+        "-i", "--interactive", action="store_true", help="interactive mode"
+    )
+    p_apply.add_argument(
+        "--extended-resources", default="",
+        help="show extended resources when reporting, comma-separated "
+             "(e.g. open-local,gpu)",
+    )
+
+    p_server = sub.add_parser("server", help="Start a HTTP server that simulates "
+                                             "deploy/scale requests against a live cluster")
+    p_server.add_argument("--kubeconfig", default="", help="path of the kubeconfig file")
+    p_server.add_argument("--master", default="", help="URL of the kube-apiserver")
+    p_server.add_argument("--port", type=int, default=8080, help="listen port")
+
+    sub.add_parser("version", help="Print the version of simon")
+
+    p_doc = sub.add_parser("gen-doc", help="Generate markdown document for your project")
+    p_doc.add_argument(
+        "-d", "--output-directory", default="./docs/commandline",
+        help="assign a directory to store documents",
+    )
+    return parser
+
+
+def cmd_apply(args) -> int:
+    from ..apply.applier import Applier, Options
+
+    ext = [e.strip() for e in (args.extended_resources or "").split(",") if e.strip()]
+    try:
+        applier = Applier(Options(
+            simon_config=args.simon_config,
+            default_scheduler_config=args.default_scheduler_config,
+            use_greed=args.use_greed,
+            interactive=args.interactive,
+            extended_resources=ext,
+            output_file=args.output_file,
+        ))
+        applier.run()
+    except Exception as e:  # mirror `apply error: ...` + exit 1 (cmd/apply/apply.go:17-24)
+        print(f"apply error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_server(args) -> int:
+    from ..server.http import Server
+
+    try:
+        server = Server(kubeconfig=args.kubeconfig, master=args.master)
+        server.start(port=args.port)
+    except KeyboardInterrupt:
+        return 0
+    except Exception as e:
+        print(f"failed to start server: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_version(_args) -> int:
+    print(f"Version: {__version__}")
+    print(f"Commit: {COMMIT_ID}")
+    return 0
+
+
+def cmd_gen_doc(args) -> int:
+    """cobra doc.GenMarkdownTree equivalent: one markdown page per command."""
+    out = args.output_directory
+    if not os.path.isdir(out):
+        print(f"Invalid output directory({out})", file=sys.stderr)
+        return 1
+    parser = build_parser()
+    pages = {"simon": parser}
+    for action in parser._subparsers._group_actions:  # noqa: SLF001
+        for name, sp in action.choices.items():
+            pages[f"simon_{name.replace('-', '_')}"] = sp
+    for page, p in pages.items():
+        with open(os.path.join(out, f"{page}.md"), "w") as f:
+            title = page.replace("_", " ")
+            f.write(f"## {title}\n\n{p.description or p.format_usage()}\n\n")
+            f.write("```\n" + p.format_help() + "```\n")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    _init_logging()
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    handlers = {
+        "apply": cmd_apply,
+        "server": cmd_server,
+        "version": cmd_version,
+        "gen-doc": cmd_gen_doc,
+    }
+    if not args.command:
+        parser.print_help()
+        return 0
+    return handlers[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -169,26 +169,47 @@ def storage_alloc(tb: Tables, cry: Carry, g):
         lvm_add = lvm_add + take * size * (fit & active)[:, None]
         ok &= fit | ~active
 
+    # Device matching reproduces CheckExclusiveResourceMeetsPVCSize's single merge
+    # pass (common.go:290-350) including its quirks: per-media COUNT pre-check;
+    # a volume only fails the node when the scan reaches the LAST (largest) still-
+    # free device and it is too small; if the last device was consumed earlier the
+    # remaining volumes are silently dropped (reference bug kept for parity).
     dev_add = jnp.zeros((N, Dv), _F32)
     dev_acc = jnp.zeros(N, _F32)
     dev_units = jnp.float32(0.0)
+    free_start = {}
+    last_idx = {}
+    for m in (1, 2):
+        fs = (tb.sdev_media == m) & (cry.sdev_alloc < 0.5) & (tb.sdev_cap > 0)
+        free_start[m] = fs
+        caps = jnp.where(fs, tb.sdev_cap, -1.0)
+        maxcap = jnp.max(caps, axis=1, keepdims=True)
+        is_max = fs & (tb.sdev_cap == maxcap)
+        # "last" in the ascending (capacity, index) sort = highest index among maxima
+        last_idx[m] = jnp.argmax(is_max * (jnp.arange(Dv)[None, :] + 1), axis=1)
+        n_free = jnp.sum(fs.astype(_F32), axis=1)
+        n_vols = jnp.sum(
+            ((tb.grp_sdev_media[g] == m) & (tb.grp_sdev_size[g] > 0)).astype(_F32)
+        )
+        ok &= (n_free >= n_vols) | (n_vols == 0)
     for s in range(SD):
         size = tb.grp_sdev_size[g, s]
         media = tb.grp_sdev_media[g, s]
         active = size > 0
-        free_dev = (
-            (tb.sdev_media == media) & (cry.sdev_alloc + dev_add < 0.5)
-            & (tb.sdev_cap >= size) & (tb.sdev_cap > 0)
-        )
-        fit = jnp.any(free_dev, axis=1)
-        tgt = jnp.argmin(jnp.where(free_dev, tb.sdev_cap, jnp.inf), axis=1)
+        fs1 = jnp.where(media == 2, free_start[2], free_start[1])
+        li = jnp.where(media == 2, last_idx[2], last_idx[1])
+        free_now = fs1 & (dev_add < 0.5)
+        fit_mask = free_now & (tb.sdev_cap >= size)
+        fit = jnp.any(fit_mask, axis=1)
+        tgt = jnp.argmin(jnp.where(fit_mask, tb.sdev_cap, jnp.inf), axis=1)
         take = (jnp.arange(Dv)[None, :] == tgt[:, None]).astype(_F32)
         take = take * (fit & active)[:, None]
         dev_add = dev_add + take
-        ok &= fit | ~active
+        last_free = jnp.take_along_axis(free_now, li[:, None], axis=1)[:, 0]
+        ok &= ~(active & ~fit & last_free)
         chosen_cap = jnp.sum(take * tb.sdev_cap, axis=1)
         dev_acc += jnp.where(active & fit, size / jnp.maximum(chosen_cap, 1.0), 0.0)
-        dev_units += active.astype(_F32)
+        dev_units += jnp.where(active & fit, 1.0, 0.0)  # only assigned units score
 
     has_lvm = jnp.any(tb.grp_lvm_size[g] > 0)
     has_dev = jnp.any(tb.grp_sdev_size[g] > 0)

@@ -124,20 +124,45 @@ def allocate_devices(
     devices: List, volumes: List[OpenLocalVolume]
 ) -> Tuple[bool, List[Tuple[int, int]]]:
     """Match device volumes (pre-sorted ssd-asc then hdd-asc) to free devices of
-    the same media type, each to the smallest-capacity fitting device. Returns
-    (fits, [(device_idx, size)])."""
+    the same media type, reproducing ProcessDevicePVC +
+    CheckExclusiveResourceMeetsPVCSize (common.go:290-350,393-447) INCLUDING its
+    quirks:
+    - per-media count pre-check: free devices < requested volumes → fail;
+    - one merge pass over (devices asc-capacity, volumes asc-size): a volume fails
+      the node only when the scan reaches the LAST device and it is too small;
+    - when devices run out mid-scan (last device already consumed), the remaining
+      volumes are silently dropped and the node still fits — a reference bug we
+      keep for placement parity.
+    Returns (fits, [(device_idx, size)])."""
     taken = [d.is_allocated for d in devices]
     units: List[Tuple[int, int]] = []
-    for vol in volumes:
-        cands = [
-            i for i, d in enumerate(devices)
-            if not taken[i] and d.media_type == vol.media and d.capacity >= vol.size
-        ]
-        if not cands:
+    for media in ("ssd", "hdd"):  # ssd processed before hdd (ProcessDevicePVC)
+        vols = [v for v in volumes if v.media == media]
+        if not vols:
+            continue
+        order = sorted(
+            (i for i, d in enumerate(devices) if d.media_type == media and not taken[i]),
+            key=lambda i: (devices[i].capacity, i),
+        )
+        if len(order) < len(vols):
             return False, units
-        idx = min(cands, key=lambda i: (devices[i].capacity, i))
-        taken[idx] = True
-        units.append((idx, vol.size))
+        j = 0
+        for vol in vols:
+            assigned = False
+            while j < len(order):
+                idx = order[j]
+                if devices[idx].capacity < vol.size:
+                    if j == len(order) - 1:
+                        return False, units
+                    j += 1
+                    continue
+                taken[idx] = True
+                units.append((idx, vol.size))
+                j += 1
+                assigned = True
+                break
+            if not assigned:
+                break  # devices exhausted: rest silently dropped (reference bug)
     return True, units
 
 

@@ -1,0 +1,231 @@
+"""HTTP server mode: what-if simulation API over a live cluster.
+
+Mirrors /root/reference/pkg/server/server.go (gin REST façade):
+- `GET /test`, `GET /healthz`
+- `POST /api/deploy-apps` — snapshot the live cluster (Running pods, workloads,
+  services, SCs, PVCs, CMs, DaemonSets), append virtual NewNodes, add the request's
+  workloads as one app plus the cluster's Pending pods, re-simulate (:166-231).
+- `POST /api/scale-apps` — same, but pods owned by the scaled workloads are removed
+  from the snapshot first and the request's Deployments/StatefulSets re-expand
+  (:233-315); request DaemonSets replace their cluster versions in place.
+- per-endpoint TryLock → 503 "server is busy" (:95,167,234).
+
+Built on http.server (stdlib) instead of gin; the live snapshot uses the REST
+KubeClient (simulator/live.py) instead of informer listers — each request re-lists,
+which trades the informer cache for zero dependencies.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional, Tuple
+
+from ..core import constants as C
+from ..core.types import AppResource, ResourceTypes, SimulateResult
+from ..models.fakenode import new_fake_node
+from ..simulator.core import simulate
+from ..utils.objutil import labels_of, name_of, namespace_of, owner_references
+
+
+def owned_by_workload(refs: List[dict], kind: str, name: str) -> bool:
+    """OwnedByWorkload (utils.go:840-865): owner-ref kind+name match."""
+    return any(r.get("kind") == kind and r.get("name") == name for r in refs)
+
+
+class ClusterSnapshot:
+    """One consistent view of the live cluster (the reference's lister snapshot)."""
+
+    def __init__(self, resource: ResourceTypes, replica_sets: List[dict],
+                 stateful_sets: List[dict], pending_pods: List[dict]) -> None:
+        self.resource = resource
+        self.replica_sets = replica_sets
+        self.stateful_sets = stateful_sets
+        self.pending_pods = pending_pods
+
+
+def snapshot_from_client(client) -> ClusterSnapshot:
+    """getCurrentClusterResource + getPendingPods (:317-402): Running pods only in
+    the cluster resource, Pending pods separated, DaemonSet-owned skipped."""
+    from ..simulator.live import _split_pods
+
+    rt = ResourceTypes()
+    rt.nodes = client.list("/api/v1/nodes")
+    running, pending = _split_pods(client.list("/api/v1/pods", resourceVersion=0))
+    rt.pods = running
+    try:
+        rt.pod_disruption_budgets = client.list("/apis/policy/v1/poddisruptionbudgets")
+    except Exception:
+        rt.pod_disruption_budgets = client.list("/apis/policy/v1beta1/poddisruptionbudgets")
+    rt.services = client.list("/api/v1/services")
+    rt.storage_classes = client.list("/apis/storage.k8s.io/v1/storageclasses")
+    rt.persistent_volume_claims = client.list("/api/v1/persistentvolumeclaims")
+    rt.config_maps = client.list("/api/v1/configmaps")
+    rt.daemon_sets = client.list("/apis/apps/v1/daemonsets")
+    replica_sets = client.list("/apis/apps/v1/replicasets")
+    stateful_sets = client.list("/apis/apps/v1/statefulsets")
+    return ClusterSnapshot(rt, replica_sets, stateful_sets, pending)
+
+
+def simulate_response(result: SimulateResult) -> dict:
+    """getSimulateResponse (:446-470): namespaced names; only app-labeled pods."""
+    unscheduled = [
+        {"pod": f"{namespace_of(u.pod)}/{name_of(u.pod)}", "reason": u.reason}
+        for u in result.unscheduled_pods
+    ]
+    node_status = []
+    for ns in result.node_status:
+        pods = [
+            f"{namespace_of(p)}/{name_of(p)}"
+            for p in ns.pods
+            if C.LabelAppName in labels_of(p)
+        ]
+        if pods:
+            node_status.append({"node": name_of(ns.node), "pods": pods})
+    return {"unscheduledPods": unscheduled, "nodeStatus": node_status}
+
+
+class Server:
+    """The server façade. `snapshot_fn` is injectable for tests; by default it
+    re-lists from the cluster on every request."""
+
+    def __init__(
+        self,
+        kubeconfig: str = "",
+        master: str = "",
+        snapshot_fn: Optional[Callable[[], ClusterSnapshot]] = None,
+    ) -> None:
+        if snapshot_fn is None:
+            from ..simulator.live import create_kube_client
+
+            client = create_kube_client(kubeconfig, master)
+            snapshot_fn = lambda: snapshot_from_client(client)  # noqa: E731
+        self.snapshot_fn = snapshot_fn
+        self.deploy_lock = threading.Lock()
+        self.scale_lock = threading.Lock()
+
+    # ------------------------------------------------------------- handlers -------
+
+    def handle_deploy_apps(self, req: dict) -> Tuple[int, object]:
+        if not self.deploy_lock.acquire(blocking=False):
+            return 503, "The server is busy, please try again later"
+        try:
+            snap = self.snapshot_fn()
+            cluster = snap.resource
+            for new_node in req.get("newnodes") or []:
+                cluster.nodes.append(new_fake_node(new_node))
+            app = ResourceTypes(
+                pods=list(req.get("pods") or []),
+                deployments=list(req.get("deployments") or []),
+                stateful_sets=list(req.get("statefulsets") or []),
+                daemon_sets=list(req.get("daemonsets") or []),
+                jobs=list(req.get("Jobs") or req.get("jobs") or []),
+                config_maps=list(req.get("ConfigMaps") or req.get("configmaps") or []),
+            )
+            app.pods.extend(snap.pending_pods)
+            result = simulate(cluster, [AppResource(name="test", resource=app)])
+            return 200, simulate_response(result)
+        except Exception as e:
+            return 500, str(e)
+        finally:
+            self.deploy_lock.release()
+
+    def handle_scale_apps(self, req: dict) -> Tuple[int, object]:
+        if not self.scale_lock.acquire(blocking=False):
+            return 503, "The server is busy, please try again later"
+        try:
+            snap = self.snapshot_fn()
+            cluster = snap.resource
+            for new_node in req.get("newnodes") or []:
+                cluster.nodes.append(new_fake_node(new_node))
+            cluster.pods = self._remove_pods_of_app(cluster.pods, req, snap)
+            for req_ds in req.get("daemonsets") or []:
+                for j, ds in enumerate(cluster.daemon_sets):
+                    if (name_of(ds) == name_of(req_ds)
+                            and namespace_of(ds) == namespace_of(req_ds)):
+                        cluster.daemon_sets[j] = req_ds
+                        break
+            app = ResourceTypes(
+                deployments=list(req.get("deployments") or []),
+                stateful_sets=list(req.get("statefulsets") or []),
+            )
+            pending = self._remove_pods_of_app(snap.pending_pods, req, snap)
+            app.pods = pending
+            result = simulate(cluster, [AppResource(name="test", resource=app)])
+            return 200, simulate_response(result)
+        except Exception as e:
+            return 500, str(e)
+        finally:
+            self.scale_lock.release()
+
+    def _remove_pods_of_app(
+        self, pods: List[dict], req: dict, snap: ClusterSnapshot
+    ) -> List[dict]:
+        """removePodsOfApp (:404-444): strip pods owned by the scaled workloads
+        (Deployments via their ReplicaSets; StatefulSets directly)."""
+        selected: List[Tuple[str, str]] = []  # (kind, name)
+        for deploy in req.get("deployments") or []:
+            for rs in snap.replica_sets:
+                if owned_by_workload(owner_references(rs), C.Deployment, name_of(deploy)):
+                    selected.append((C.ReplicaSet, name_of(rs)))
+        for sts in req.get("statefulsets") or []:
+            selected.append((C.StatefulSet, name_of(sts)))
+        out = []
+        for pod in pods:
+            refs = owner_references(pod)
+            if not any(owned_by_workload(refs, k, n) for k, n in selected):
+                out.append(pod)
+        return out
+
+    # --------------------------------------------------------------- serving ------
+
+    def start(self, port: int = 8080, host: str = "") -> None:
+        httpd = self.build_httpd(port, host)
+        print(f"simon server listening on :{port}")
+        httpd.serve_forever()
+
+    def build_httpd(self, port: int = 8080, host: str = "") -> ThreadingHTTPServer:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+            def _send(self, code: int, body: object) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, {"message": "ok"})
+                elif self.path == "/test":
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.end_headers()
+                    self.wfile.write(b"test")
+                else:
+                    self._send(404, {"message": "not found"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length)
+                try:
+                    req = json.loads(raw or b"{}")
+                except json.JSONDecodeError as e:
+                    self._send(400, f"fail to unmarshal content: {e}")
+                    return
+                if self.path == "/api/deploy-apps":
+                    code, body = server.handle_deploy_apps(req)
+                elif self.path == "/api/scale-apps":
+                    code, body = server.handle_scale_apps(req)
+                else:
+                    self._send(404, {"message": "not found"})
+                    return
+                self._send(code, body)
+
+        return ThreadingHTTPServer((host, port), Handler)

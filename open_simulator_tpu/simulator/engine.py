@@ -121,6 +121,11 @@ class Simulator:
         disable_progress: bool = True,
         patch_pod_funcs: Optional[List[Callable]] = None,
     ) -> None:
+        # The simulator owns its node objects, like the reference's fakeclient
+        # (Create deep-copies): the plugins write annotations/allocatable back into
+        # nodes, and repeated simulations over one caller-owned cluster (the
+        # capacity planner's probes) must never see a previous run's mutations.
+        nodes = copy.deepcopy(nodes)
         self.axis = ResourceAxis()
         self.axis.discover(nodes, [])
         self.model = ClusterModel()

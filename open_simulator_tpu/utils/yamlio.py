@@ -8,12 +8,15 @@ object by kind, error on unknown kinds.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Iterable, List, Optional
 
 import yaml
 
+from ..core import constants as C
 from ..core.types import KIND_TO_FIELD, ResourceTypes
+from .objutil import name_of
 
 
 class UnknownKindError(ValueError):
@@ -71,14 +74,9 @@ def match_and_set_local_storage_annotation(nodes: List[dict], directory: str) ->
     """MatchAndSetLocalStorageAnnotationOnNode (pkg/simulator/utils.go:385-401):
     node-name-matched .json files in `directory` become the node's
     simon/node-local-storage annotation."""
-    import json
-
-    from ..core import constants as C
-
     storage = load_json_files(directory)
     for node in nodes:
-        name = ((node.get("metadata") or {}).get("name")) or ""
-        info = storage.get(name)
+        info = storage.get(name_of(node))
         if info is not None:
             node.setdefault("metadata", {}).setdefault("annotations", {})[
                 C.AnnoNodeLocalStorage

@@ -1,0 +1,133 @@
+"""CLI command tree + HTTP server mode."""
+
+import http.client
+import json
+import os
+import threading
+
+from open_simulator_tpu.cli.main import main as cli_main
+from open_simulator_tpu.core.types import ResourceTypes
+from open_simulator_tpu.server.http import ClusterSnapshot, Server
+
+from fixtures import make_deployment, make_node, make_pod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------- CLI ---------
+
+
+def test_cli_version(capsys):
+    assert cli_main(["version"]) == 0
+    out = capsys.readouterr().out
+    assert "Version:" in out and "Commit:" in out
+
+
+def test_cli_gen_doc(tmp_path):
+    assert cli_main(["gen-doc", "-d", str(tmp_path)]) == 0
+    files = {f.name for f in tmp_path.iterdir()}
+    assert {"simon.md", "simon_apply.md", "simon_server.md", "simon_version.md"} <= files
+    assert "--simon-config" in (tmp_path / "simon_apply.md").read_text()
+
+
+def test_cli_gen_doc_bad_dir(capsys):
+    assert cli_main(["gen-doc", "-d", "/nonexistent/dir"]) == 1
+
+
+def test_cli_apply_runs_example(tmp_path, monkeypatch):
+    monkeypatch.chdir(REPO)
+    out = tmp_path / "report.txt"
+    rc = cli_main([
+        "apply", "-f", "examples/simon-config.yaml", "--output-file", str(out),
+        "--use-greed",
+    ])
+    assert rc == 0
+    assert "Simulation success!" in out.read_text()
+
+
+def test_cli_apply_missing_config(capsys):
+    assert cli_main(["apply", "-f", "/nonexistent.yaml"]) == 1
+    assert "apply error" in capsys.readouterr().err
+
+
+# -------------------------------------------------------------------- server --------
+
+
+def _snapshot(nodes=None, pods=None, rs=None, pending=None):
+    rt = ResourceTypes(nodes=nodes or [], pods=pods or [])
+    return ClusterSnapshot(rt, rs or [], [], pending or [])
+
+
+def test_deploy_apps_handler():
+    nodes = [make_node("n1"), make_node("n2")]
+    server = Server(snapshot_fn=lambda: _snapshot(nodes=nodes))
+    deploy = make_deployment("web", replicas=3, cpu="1", memory="1Gi")
+    code, body = server.handle_deploy_apps({"deployments": [deploy]})
+    assert code == 200
+    assert body["unscheduledPods"] == []
+    placed = sum(len(ns["pods"]) for ns in body["nodeStatus"])
+    assert placed == 3
+
+
+def test_deploy_apps_newnodes_and_pending():
+    pending = [make_pod("stuck", cpu="1", memory="1Gi")]
+    server = Server(snapshot_fn=lambda: _snapshot(nodes=[], pending=pending))
+    new_node = make_node("fresh", cpu="8", memory="16Gi")
+    code, body = server.handle_deploy_apps({"newnodes": [new_node]})
+    assert code == 200
+    # the pending pod has no app label → filtered from nodeStatus, but scheduled
+    assert body["unscheduledPods"] == []
+
+
+def test_deploy_apps_busy_returns_503():
+    server = Server(snapshot_fn=lambda: _snapshot(nodes=[make_node("n1")]))
+    server.deploy_lock.acquire()
+    try:
+        code, body = server.handle_deploy_apps({})
+        assert code == 503 and "busy" in body
+    finally:
+        server.deploy_lock.release()
+
+
+def test_scale_apps_removes_owned_pods():
+    """Scaling a deployment replaces its existing pods with the new replica count."""
+    nodes = [make_node("n1")]
+    rs = {"apiVersion": "apps/v1", "kind": "ReplicaSet",
+          "metadata": {"name": "web-abc", "namespace": "default",
+                       "ownerReferences": [{"kind": "Deployment", "name": "web"}]}}
+    old_pods = []
+    for i in range(2):
+        p = make_pod(f"web-abc-{i}", cpu="1", memory="1Gi", node_name="n1")
+        p["metadata"]["ownerReferences"] = [{"kind": "ReplicaSet", "name": "web-abc"}]
+        old_pods.append(p)
+    server = Server(snapshot_fn=lambda: _snapshot(nodes=nodes, pods=old_pods, rs=[rs]))
+    scaled = make_deployment("web", replicas=5, cpu="1", memory="1Gi")
+    code, body = server.handle_scale_apps({"deployments": [scaled]})
+    assert code == 200
+    placed = sum(len(ns["pods"]) for ns in body["nodeStatus"])
+    assert placed == 5  # old 2 removed, 5 new placed
+
+
+def test_http_round_trip():
+    nodes = [make_node("n1")]
+    server = Server(snapshot_fn=lambda: _snapshot(nodes=nodes))
+    httpd = server.build_httpd(port=0, host="127.0.0.1")
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read())["message"] == "ok"
+
+        deploy = make_deployment("api", replicas=2, cpu="1", memory="1Gi")
+        conn.request("POST", "/api/deploy-apps", body=json.dumps({"deployments": [deploy]}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        body = json.loads(resp.read())
+        assert sum(len(ns["pods"]) for ns in body["nodeStatus"]) == 2
+    finally:
+        httpd.shutdown()

@@ -182,6 +182,43 @@ def test_kind_ignored_for_routing():
     assert st.devices[0].is_allocated
 
 
+def test_repeated_simulations_do_not_leak_storage():
+    """The capacity planner re-simulates the same caller-owned cluster; plugin
+    writebacks must stay inside each run's node copies."""
+    nodes = [storage_node("s0", vgs=[("pool", 10 * GI)])]
+    pods = [storage_pod(f"p{i}", [(4 * GI, "LVM", "open-local-lvm")]) for i in range(2)]
+    for _ in range(3):
+        res = _sim(nodes, pods, [lvm_sc()])
+        assert not res.unscheduled_pods
+    # the caller's node object is untouched
+    st = NodeStorage.from_json(nodes[0]["metadata"]["annotations"]["simon/node-local-storage"])
+    assert st.vgs[0].requested == 0
+
+
+def test_device_merge_pass_silent_drop():
+    """Reference quirk (CheckExclusiveResourceMeetsPVCSize): devices [20,40] and
+    volumes [30,35] → the 20 is skipped, 40 takes the 30, devices run out, and the
+    35 is silently dropped — the node still fits."""
+    nodes = [storage_node("s0", devices=[("/dev/a", 20 * GI, "hdd"),
+                                         ("/dev/b", 40 * GI, "hdd")])]
+    pod = storage_pod("p0", [(30 * GI, "HDD", "hdd-sc"), (35 * GI, "HDD", "hdd-sc")])
+    res = _sim(nodes, [pod], [device_sc("hdd-sc", "hdd")])
+    assert not res.unscheduled_pods
+    st = NodeStorage.from_json(
+        res.node_status[0].node["metadata"]["annotations"]["simon/node-local-storage"]
+    )
+    assert [d.is_allocated for d in st.devices] == [False, True]
+
+
+def test_device_count_precheck_fails():
+    """But three volumes against two free devices fail the count pre-check."""
+    nodes = [storage_node("s0", devices=[("/dev/a", 100 * GI, "hdd"),
+                                         ("/dev/b", 100 * GI, "hdd")])]
+    pod = storage_pod("p0", [(10 * GI, "HDD", "hdd-sc")] * 3)
+    res = _sim(nodes, [pod], [device_sc("hdd-sc", "hdd")])
+    assert len(res.unscheduled_pods) == 1
+
+
 def test_sts_volume_claims_via_annotation():
     """StatefulSet volumeClaimTemplates flow through the pod annotation."""
     nodes = [storage_node("s0", vgs=[("pool", 100 * GI)])]
