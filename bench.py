@@ -42,15 +42,19 @@ def main() -> None:
     bt = sim.encode_batch(pods)
     t_encode = time.perf_counter() - t0
 
+    from open_simulator_tpu.simulator.encode import plugin_flags
+
     tables, carry = sim._to_device(bt)
     pg = jnp.asarray(bt.pod_group)
     fn = jnp.asarray(bt.forced_node)
     vd = jnp.asarray(bt.valid)
+    enable_gpu, enable_storage = plugin_flags(bt)
 
     # Cold run: compile + execute (discarded). np.asarray forces a device→host
     # transfer as the sync point (block_until_ready alone can return early through
     # remote-device tunnels).
-    out = kernels.schedule_batch(tables, carry, pg, fn, vd, n_zones=bt.n_zones)
+    out = kernels.schedule_batch(tables, carry, pg, fn, vd, n_zones=bt.n_zones,
+                                 enable_gpu=enable_gpu, enable_storage=enable_storage)
     np.asarray(out[1])
 
     # Warm runs from the same initial carry.
@@ -58,7 +62,8 @@ def main() -> None:
     for _ in range(3):
         t1 = time.perf_counter()
         final, choices = kernels.schedule_batch(
-            tables, carry, pg, fn, vd, n_zones=bt.n_zones
+            tables, carry, pg, fn, vd, n_zones=bt.n_zones,
+            enable_gpu=enable_gpu, enable_storage=enable_storage,
         )
         choices = np.asarray(choices)
         times.append(time.perf_counter() - t1)

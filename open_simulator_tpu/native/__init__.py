@@ -1,0 +1,80 @@
+"""Native (C++) host runtime components, with transparent Python fallbacks.
+
+The compute path of this framework is XLA-compiled (ops/kernels.py); this package
+holds the native pieces of the HOST runtime around it. Currently:
+
+- `_hashobj.canon_hash(obj)` — 128-bit canonical hash of JSON-ish object trees,
+  used to key pod scheduling groups (simulator/encode.py). Compiled lazily from
+  `_hashobj.cpp` with the toolchain's C++ compiler on first use; results are
+  cached next to the source. Set SIMON_NO_NATIVE=1 to force the Python fallback.
+
+Build strategy: no pybind11 in this environment, so the extension uses the raw
+CPython C API and is compiled with a direct compiler invocation (no setuptools
+temp-dir dance), which keeps cold-start under a second.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import logging
+import os
+import subprocess
+import sysconfig
+from typing import Callable, Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "_hashobj.cpp")
+_SO = os.path.join(_DIR, "_hashobj" + (sysconfig.get_config_var("EXT_SUFFIX") or ".so"))
+
+_canon_hash: Optional[Callable] = None
+_tried = False
+
+
+def _build() -> bool:
+    cc = os.environ.get("CXX", "g++")
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        cc, "-O2", "-shared", "-fPIC", "-std=c++17",
+        f"-I{include}", _SRC, "-o", _SO,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logging.debug("native build failed to run: %s", e)
+        return False
+    if proc.returncode != 0:
+        logging.debug("native build failed:\n%s", proc.stderr)
+        return False
+    return True
+
+
+def _load() -> Optional[Callable]:
+    spec = importlib.util.spec_from_file_location("open_simulator_tpu.native._hashobj", _SO)
+    if spec is None or spec.loader is None:
+        return None
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.canon_hash
+
+
+def canon_hash_fn() -> Optional[Callable]:
+    """The native hash function, building it on first call; None when unavailable
+    (missing compiler, SIMON_NO_NATIVE=1, ...)."""
+    global _canon_hash, _tried
+    if _tried:
+        return _canon_hash
+    _tried = True
+    if os.environ.get("SIMON_NO_NATIVE"):
+        return None
+    try:
+        # <= so equal mtimes (e.g. both stamped by a checkout) rebuild: loading a
+        # stale binary would silently change signature semantics
+        stale = (not os.path.exists(_SO)
+                 or os.path.getmtime(_SO) <= os.path.getmtime(_SRC))
+        if stale and not _build():
+            return None
+        _canon_hash = _load()
+    except Exception as e:  # any failure → Python fallback
+        logging.debug("native hash unavailable: %s", e)
+        _canon_hash = None
+    return _canon_hash

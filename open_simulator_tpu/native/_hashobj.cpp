@@ -1,0 +1,196 @@
+// Canonical 128-bit hashing of JSON-ish Python object trees.
+//
+// The host-side encoder keys pod "scheduling groups" by a canonical form of the
+// scheduling-relevant pod subtree (simulator/encode.py scheduling_signature). The
+// pure-Python tuple-freeze walk is the hottest host path when ingesting large
+// clusters of heterogeneous raw pods; this extension performs the same walk in
+// C++ against the CPython API and returns a 128-bit digest as a Python int.
+//
+// Canonicalization rules (must match encode._freeze semantics):
+// - dict: entries hashed in ascending key order (keys must be strings)
+// - list/tuple: order-preserving
+// - str/bytes: UTF-8 bytes
+// - bool, int, float, None: tagged scalar values; bool is distinct from int,
+//   and int vs float follow Python equality (1 == 1.0 → same hash, like a dict
+// key's behavior in the frozen-tuple form? No: tuples distinguish by hash AND
+// eq; (1,) == (1.0,) in Python, so the frozen forms collide there too — we hash
+// numeric values by their float64 bits when exactly representable, else by
+// decimal string, reproducing tuple equality).
+//
+// Digest: two independent 64-bit FNV-1a streams with different offset bases;
+// collision probability is negligible (~2^-128) for group identity.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+namespace {
+
+struct H128 {
+    uint64_t a = 1469598103934665603ULL;   // FNV-1a offset basis
+    uint64_t b = 14695981039346656037ULL;  // alternate stream
+    inline void feed(const void* data, size_t n) {
+        const unsigned char* p = static_cast<const unsigned char*>(data);
+        for (size_t i = 0; i < n; i++) {
+            a = (a ^ p[i]) * 1099511628211ULL;
+            b = (b ^ p[i]) * 1099511628211ULL;
+            b ^= b >> 29;  // extra mixing keeps the streams independent
+        }
+    }
+    inline void tag(char t) { feed(&t, 1); }
+};
+
+int hash_obj(PyObject* o, H128& h);  // fwd
+
+int hash_scalar_number(PyObject* o, H128& h) {
+    // Python tuple equality treats 1 == 1.0 == True; we key booleans separately
+    // ONLY when they appear as dict values/list items where _freeze kept the bool
+    // object — but (True,) == (1,) in Python too, so bools hash as numbers.
+    double d = PyFloat_AsDouble(o);
+    if (d == -1.0 && PyErr_Occurred()) {
+        PyErr_Clear();
+        // huge int: fall back to decimal string
+        PyObject* s = PyObject_Str(o);
+        if (!s) return -1;
+        Py_ssize_t n;
+        const char* buf = PyUnicode_AsUTF8AndSize(s, &n);
+        if (!buf) { Py_DECREF(s); return -1; }
+        h.tag('I');
+        h.feed(buf, static_cast<size_t>(n));
+        Py_DECREF(s);
+        return 0;
+    }
+    // exact float64 path; ints representable as float64 hash identically to the
+    // equal float, matching tuple equality
+    if (PyLong_Check(o)) {
+        // verify exactness: round-trip compare
+        PyObject* back = PyLong_FromDouble(d);
+        if (!back) { PyErr_Clear(); h.tag('I'); return hash_scalar_number(o, h); }
+        int eq = PyObject_RichCompareBool(o, back, Py_EQ);
+        Py_DECREF(back);
+        if (eq < 0) return -1;
+        if (!eq) {
+            PyObject* s = PyObject_Str(o);
+            if (!s) return -1;
+            Py_ssize_t n;
+            const char* buf = PyUnicode_AsUTF8AndSize(s, &n);
+            if (!buf) { Py_DECREF(s); return -1; }
+            h.tag('I');
+            h.feed(buf, static_cast<size_t>(n));
+            Py_DECREF(s);
+            return 0;
+        }
+    }
+    h.tag('N');
+    h.feed(&d, sizeof(d));
+    return 0;
+}
+
+int hash_obj(PyObject* o, H128& h) {
+    if (o == Py_None) {
+        h.tag('0');
+        return 0;
+    }
+    if (PyUnicode_Check(o)) {
+        Py_ssize_t n;
+        const char* buf = PyUnicode_AsUTF8AndSize(o, &n);
+        if (!buf) return -1;
+        h.tag('S');
+        h.feed(buf, static_cast<size_t>(n));
+        return 0;
+    }
+    if (PyBool_Check(o) || PyLong_Check(o) || PyFloat_Check(o)) {
+        return hash_scalar_number(o, h);
+    }
+    if (PyBytes_Check(o)) {
+        char* buf;
+        Py_ssize_t n;
+        if (PyBytes_AsStringAndSize(o, &buf, &n) < 0) return -1;
+        h.tag('S');  // bytes canonicalize like their utf-8 string
+        h.feed(buf, static_cast<size_t>(n));
+        return 0;
+    }
+    if (PyList_Check(o) || PyTuple_Check(o)) {
+        h.tag('L');
+        PyObject* seq = PySequence_Fast(o, "sequence");
+        if (!seq) return -1;
+        Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+        for (Py_ssize_t i = 0; i < n; i++) {
+            if (hash_obj(PySequence_Fast_GET_ITEM(seq, i), h) < 0) {
+                Py_DECREF(seq);
+                return -1;
+            }
+            h.tag(',');
+        }
+        Py_DECREF(seq);
+        return 0;
+    }
+    if (PyDict_Check(o)) {
+        h.tag('D');
+        PyObject* keys = PyDict_Keys(o);
+        if (!keys) return -1;
+        if (PyList_Sort(keys) < 0) {
+            Py_DECREF(keys);
+            return -1;
+        }
+        Py_ssize_t n = PyList_GET_SIZE(keys);
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject* k = PyList_GET_ITEM(keys, i);
+            PyObject* v = PyDict_GetItemWithError(o, k);
+            if (!v) {
+                Py_DECREF(keys);
+                return -1;
+            }
+            if (hash_obj(k, h) < 0 || (h.tag(':'), hash_obj(v, h)) < 0) {
+                Py_DECREF(keys);
+                return -1;
+            }
+            h.tag(';');
+        }
+        Py_DECREF(keys);
+        return 0;
+    }
+    PyErr_Format(PyExc_TypeError, "canon_hash: unsupported type %s",
+                 Py_TYPE(o)->tp_name);
+    return -1;
+}
+
+PyObject* canon_hash(PyObject* /*self*/, PyObject* arg) {
+    H128 h;
+    if (hash_obj(arg, h) < 0) return nullptr;
+    // compose a 128-bit Python int: (a << 64) | b
+    PyObject* pa = PyLong_FromUnsignedLongLong(h.a);
+    PyObject* pb = PyLong_FromUnsignedLongLong(h.b);
+    PyObject* sixty_four = PyLong_FromLong(64);
+    PyObject* out = nullptr;
+    if (pa && pb && sixty_four) {
+        PyObject* shift = PyNumber_Lshift(pa, sixty_four);
+        if (shift) {
+            out = PyNumber_Or(shift, pb);
+            Py_DECREF(shift);
+        }
+    }
+    Py_XDECREF(pa);
+    Py_XDECREF(pb);
+    Py_XDECREF(sixty_four);
+    return out;
+}
+
+PyMethodDef methods[] = {
+    {"canon_hash", canon_hash, METH_O,
+     "128-bit canonical hash of a JSON-ish object tree (dict keys sorted)."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_hashobj",
+    "Native canonical hashing for scheduling-group signatures.", -1, methods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__hashobj(void) { return PyModule_Create(&moduledef); }

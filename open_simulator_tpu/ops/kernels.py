@@ -236,8 +236,15 @@ def storage_alloc(tb: Tables, cry: Carry, g):
     }
 
 
-def feasibility(tb: Tables, cry: Carry, g, forced, valid) -> Tuple[jax.Array, dict]:
-    """[N] feasibility mask for one pod, plus named per-stage masks for diagnostics."""
+def feasibility(
+    tb: Tables, cry: Carry, g, forced, valid,
+    enable_gpu: bool = True, enable_storage: bool = True,
+) -> Tuple[jax.Array, dict]:
+    """[N] feasibility mask for one pod, plus named per-stage masks for diagnostics.
+
+    `enable_gpu`/`enable_storage` are STATIC: when a batch contains no gpu/storage
+    demands the whole plugin subgraph is excluded at trace time (the inert tensor
+    math would otherwise cost ~35% of each scan step)."""
     N = tb.alloc.shape[0]
     D = cry.counter.shape[1] - 1
 
@@ -297,24 +304,30 @@ def feasibility(tb: Tables, cry: Carry, g, forced, valid) -> Tuple[jax.Array, di
     # the per-GPU request AND the devices must fit all requested units. A device can
     # host multiple units (two-pointer greedy packs units onto one GPU), so the
     # feasibility condition is sum(floor(idle/mem)) >= num.
-    gmem = tb.grp_gpu_mem[g]
-    gnum = tb.grp_gpu_num[g]
-    has_gpu = gmem > 0
-    safe_mem = jnp.maximum(gmem, 1.0)
-    gidle = tb.dev_total - cry.dev_used                                    # [N, MAXDEV]
-    gunits = jnp.where(tb.dev_total > 0, jnp.floor(gidle / safe_mem), 0.0)
-    gunits = jnp.maximum(gunits, 0.0)
-    node_gpu_total = jnp.sum(tb.dev_total, axis=1)
-    gpu_fit = (node_gpu_total >= gmem) & (jnp.sum(gunits, axis=1) >= gnum) & (gnum > 0)
-    # pre-assigned gpu-index: AllocateGpuId returns the id without checking device
-    # fit (gpunodeinfo.go:247-253); only the node-total check and device existence
-    # apply.
-    gpu_pre_fit = (node_gpu_total >= gmem) & (gnum > 0) & jnp.any(tb.dev_total > 0, axis=1)
-    gpu_fit = jnp.where(tb.grp_gpu_pre[g], gpu_pre_fit, gpu_fit)
-    gpu_ok = jnp.where(has_gpu, gpu_fit, jnp.ones_like(gpu_fit))
+    if enable_gpu:
+        gmem = tb.grp_gpu_mem[g]
+        gnum = tb.grp_gpu_num[g]
+        has_gpu = gmem > 0
+        safe_mem = jnp.maximum(gmem, 1.0)
+        gidle = tb.dev_total - cry.dev_used                                # [N, MAXDEV]
+        gunits = jnp.where(tb.dev_total > 0, jnp.floor(gidle / safe_mem), 0.0)
+        gunits = jnp.maximum(gunits, 0.0)
+        node_gpu_total = jnp.sum(tb.dev_total, axis=1)
+        gpu_fit = (node_gpu_total >= gmem) & (jnp.sum(gunits, axis=1) >= gnum) & (gnum > 0)
+        # pre-assigned gpu-index: AllocateGpuId returns the id without checking
+        # device fit (gpunodeinfo.go:247-253); only the node-total check and
+        # device existence apply.
+        gpu_pre_fit = (node_gpu_total >= gmem) & (gnum > 0) & jnp.any(tb.dev_total > 0, axis=1)
+        gpu_fit = jnp.where(tb.grp_gpu_pre[g], gpu_pre_fit, gpu_fit)
+        gpu_ok = jnp.where(has_gpu, gpu_fit, jnp.ones_like(gpu_fit))
+    else:
+        gpu_ok = jnp.ones(N, bool)
 
     # Open-Local Filter (open-local.go:51-92)
-    storage_ok = storage_alloc(tb, cry, g)["ok"]
+    if enable_storage:
+        storage_ok = storage_alloc(tb, cry, g)["ok"]
+    else:
+        storage_ok = jnp.ones(N, bool)
 
     feasible = (smask & fit & ~conflict & aff_ok & ~blocked_in & ~blocked_ex
                 & dns_ok & gpu_ok & storage_ok)
@@ -339,7 +352,9 @@ def feasibility(tb: Tables, cry: Carry, g, forced, valid) -> Tuple[jax.Array, di
     return feasible, stages
 
 
-def scores(tb: Tables, cry: Carry, g, feasible, n_zones: int) -> jax.Array:
+def scores(
+    tb: Tables, cry: Carry, g, feasible, n_zones: int, enable_storage: bool = True
+) -> jax.Array:
     """Weighted sum of all normalized plugin scores over the feasible set ([N] f32)."""
     F = feasible
     alloc_cm = tb.alloc[:, (CPU_I, MEM_I)]
@@ -437,15 +452,18 @@ def scores(tb: Tables, cry: Carry, g, feasible, n_zones: int) -> jax.Array:
     # Open-Local Score (open-local.go:94-172): Binpack LVM + device ints, then the
     # plugin's own min-max NormalizeScore. Pods without volumes raw-score 0 on
     # every node → constant → normalizes to 0 (inert).
-    st = storage_alloc(tb, cry, g)
-    st_raw = st["raw"]
-    st_hi = jnp.maximum(jnp.max(jnp.where(F, st_raw, -jnp.inf)), 0.0)
-    st_lo_raw = jnp.min(jnp.where(F, st_raw, jnp.inf))
-    st_lo = jnp.where(jnp.isfinite(st_lo_raw), st_lo_raw, 0.0)
-    st_rng = st_hi - st_lo
-    openlocal = jnp.where(
-        st["has_storage"] & (st_rng > 0), _flr((st_raw - st_lo) * 100.0 / st_rng), 0.0
-    )
+    if enable_storage:
+        st = storage_alloc(tb, cry, g)
+        st_raw = st["raw"]
+        st_hi = jnp.maximum(jnp.max(jnp.where(F, st_raw, -jnp.inf)), 0.0)
+        st_lo_raw = jnp.min(jnp.where(F, st_raw, jnp.inf))
+        st_lo = jnp.where(jnp.isfinite(st_lo_raw), st_lo_raw, 0.0)
+        st_rng = st_hi - st_lo
+        openlocal = jnp.where(
+            st["has_storage"] & (st_rng > 0), _flr((st_raw - st_lo) * 100.0 / st_rng), 0.0
+        )
+    else:
+        openlocal = 0.0
 
     total = (
         W_LEAST * least
@@ -463,7 +481,10 @@ def scores(tb: Tables, cry: Carry, g, feasible, n_zones: int) -> jax.Array:
     return total
 
 
-def commit(tb: Tables, cry: Carry, g, choice, do) -> Carry:
+def commit(
+    tb: Tables, cry: Carry, g, choice, do,
+    enable_gpu: bool = True, enable_storage: bool = True,
+) -> Carry:
     """Apply one placement to the carry (the Reserve+Bind of the cycle)."""
     T = cry.counter.shape[0]
     Tc = cry.carrier.shape[0]
@@ -487,57 +508,66 @@ def commit(tb: Tables, cry: Carry, g, choice, do) -> Carry:
     # GPU device allocation (AllocateGpuId, gpunodeinfo.go:232-290): tightest-fit
     # for a single GPU; in-order greedy (multiple units may pack onto one device)
     # for multi-GPU. Mirrored exactly by the host ledger in plugins/gpushare.py.
-    gmem = tb.grp_gpu_mem[g]
-    gnum = tb.grp_gpu_num[g]
-    safe_mem = jnp.maximum(gmem, 1.0)
-    dev_total_c = tb.dev_total[c]                                   # [MAXDEV]
-    idle_c = dev_total_c - cry.dev_used[c]
-    units_c = jnp.maximum(jnp.where(dev_total_c > 0, jnp.floor(idle_c / safe_mem), 0.0), 0.0)
-    # multi-GPU: first `gnum` units in device order
-    cum = jnp.cumsum(units_c)
-    take_multi = jnp.clip(gnum - (cum - units_c), 0.0, units_c)
-    # single GPU: lowest-index tightest fit
-    fit_dev = (idle_c >= gmem) & (dev_total_c > 0)
-    cand = jnp.argmin(jnp.where(fit_dev, idle_c, jnp.inf))
-    take_one = (jnp.arange(idle_c.shape[0]) == cand).astype(_F32)
-    take = jnp.where(gnum == 1, take_one, take_multi)
-    # pre-assigned ids charge exactly the annotated devices (host ledger add_pod)
-    take = jnp.where(tb.grp_gpu_pre[g], tb.grp_gpu_take[g], take)
-    gdo = dof * (gmem > 0)
-    dev_used = cry.dev_used.at[c].add(take * gmem * gdo)
+    if enable_gpu:
+        gmem = tb.grp_gpu_mem[g]
+        gnum = tb.grp_gpu_num[g]
+        safe_mem = jnp.maximum(gmem, 1.0)
+        dev_total_c = tb.dev_total[c]                               # [MAXDEV]
+        idle_c = dev_total_c - cry.dev_used[c]
+        units_c = jnp.maximum(jnp.where(dev_total_c > 0, jnp.floor(idle_c / safe_mem), 0.0), 0.0)
+        # multi-GPU: first `gnum` units in device order
+        cum = jnp.cumsum(units_c)
+        take_multi = jnp.clip(gnum - (cum - units_c), 0.0, units_c)
+        # single GPU: lowest-index tightest fit
+        fit_dev = (idle_c >= gmem) & (dev_total_c > 0)
+        cand = jnp.argmin(jnp.where(fit_dev, idle_c, jnp.inf))
+        take_one = (jnp.arange(idle_c.shape[0]) == cand).astype(_F32)
+        take = jnp.where(gnum == 1, take_one, take_multi)
+        # pre-assigned ids charge exactly the annotated devices (host add_pod)
+        take = jnp.where(tb.grp_gpu_pre[g], tb.grp_gpu_take[g], take)
+        gdo = dof * (gmem > 0)
+        dev_used = cry.dev_used.at[c].add(take * gmem * gdo)
+    else:
+        dev_used = cry.dev_used
 
     # Open-Local Bind: bump VG requested, mark devices allocated (open-local.go:215-250)
-    st = storage_alloc(tb, cry, g)
-    sdo = dof * st["has_storage"].astype(_F32)
-    vg_req = cry.vg_req.at[c].add(st["lvm_add"][c] * sdo)
-    sdev_alloc = cry.sdev_alloc.at[c].add(st["dev_add"][c] * sdo)
+    if enable_storage:
+        st = storage_alloc(tb, cry, g)
+        sdo = dof * st["has_storage"].astype(_F32)
+        vg_req = cry.vg_req.at[c].add(st["lvm_add"][c] * sdo)
+        sdev_alloc = cry.sdev_alloc.at[c].add(st["dev_add"][c] * sdo)
+    else:
+        vg_req, sdev_alloc = cry.vg_req, cry.sdev_alloc
 
     return Carry(requested, nonzero, port_used, counter, carrier, dev_used,
                  vg_req, sdev_alloc)
 
 
-def _step(tb: Tables, cry: Carry, xs, n_zones: int):
+def _step(tb: Tables, cry: Carry, xs, n_zones: int, enable_gpu: bool, enable_storage: bool):
     g, forced, valid = xs
-    feasible, _ = feasibility(tb, cry, g, forced, valid)
+    feasible, _ = feasibility(tb, cry, g, forced, valid, enable_gpu, enable_storage)
     any_f = jnp.any(feasible)
-    sc = scores(tb, cry, g, feasible, n_zones)
+    sc = scores(tb, cry, g, feasible, n_zones, enable_storage)
     masked = jnp.where(feasible, sc, -jnp.inf)
     choice = jnp.argmax(masked).astype(jnp.int32)  # first max → lowest node index
     choice = jnp.where(any_f, choice, jnp.int32(-1))
-    new_cry = commit(tb, cry, g, choice, any_f)
+    new_cry = commit(tb, cry, g, choice, any_f, enable_gpu, enable_storage)
     return new_cry, choice
 
 
 # Module-level jit so repeated diagnostic calls hit the compile cache.
-feasibility_jit = jax.jit(feasibility)
+feasibility_jit = jax.jit(feasibility, static_argnames=("enable_gpu", "enable_storage"))
 
 
-@partial(jax.jit, static_argnames=("n_zones",))
-def schedule_batch(tb: Tables, cry: Carry, pod_group, forced_node, valid, n_zones: int):
+@partial(jax.jit, static_argnames=("n_zones", "enable_gpu", "enable_storage"))
+def schedule_batch(
+    tb: Tables, cry: Carry, pod_group, forced_node, valid, n_zones: int,
+    enable_gpu: bool = True, enable_storage: bool = True,
+):
     """Scan the whole batch; returns (final carry, placements[P] int32, -1=unschedulable)."""
 
     def body(c, xs):
-        return _step(tb, c, xs, n_zones)
+        return _step(tb, c, xs, n_zones, enable_gpu, enable_storage)
 
     final, choices = jax.lax.scan(body, cry, (pod_group, forced_node, valid))
     return final, choices

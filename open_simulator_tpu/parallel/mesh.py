@@ -29,7 +29,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import kernels
-from ..simulator.encode import BatchTables, pad_batch_tables as _pad_batch_tables
+from ..simulator.encode import BatchTables, pad_batch_tables as _pad_batch_tables, plugin_flags
 
 NODE_AXIS = "nodes"
 SCENARIO_AXIS = "scenarios"
@@ -138,6 +138,7 @@ def schedule_batch_on_mesh(bt: BatchTables, mesh: Mesh):
     phantom padding is infeasible by construction, so indices never exceed the real N.
     """
     tables, carry, bt = to_device_sharded(bt, mesh)
+    enable_gpu, enable_storage = plugin_flags(bt)
     with mesh:
         final, choices = kernels.schedule_batch(
             tables, carry,
@@ -145,6 +146,8 @@ def schedule_batch_on_mesh(bt: BatchTables, mesh: Mesh):
             jax.numpy.asarray(bt.forced_node),
             jax.numpy.asarray(bt.valid),
             n_zones=bt.n_zones,
+            enable_gpu=enable_gpu,
+            enable_storage=enable_storage,
         )
     return final, choices
 
@@ -195,6 +198,7 @@ def schedule_scenarios_on_mesh(bt: BatchTables, mesh: Mesh, seed_requested_s: np
         vg_req=jax.device_put(rep(bt.seed_vg_req), sh(P(SCENARIO_AXIS, NODE_AXIS, None))),
         sdev_alloc=jax.device_put(rep(bt.seed_sdev_alloc), sh(P(SCENARIO_AXIS, NODE_AXIS, None))),
     )
+    enable_gpu, enable_storage = plugin_flags(bt)
     vmapped = jax.vmap(
         lambda c: kernels.schedule_batch(
             tables, c,
@@ -202,6 +206,8 @@ def schedule_scenarios_on_mesh(bt: BatchTables, mesh: Mesh, seed_requested_s: np
             jax.numpy.asarray(bt.forced_node),
             jax.numpy.asarray(bt.valid),
             n_zones=bt.n_zones,
+            enable_gpu=enable_gpu,
+            enable_storage=enable_storage,
         )
     )
     with mesh:

@@ -350,10 +350,55 @@ def _freeze(o):
     return o
 
 
+SIG_MEMO_KEY = "__sig_memo__"  # stamped by workload expansion; popped by the engine
+
+_native_hash = "unresolved"
+
+
 def scheduling_signature(pod: dict):
     """Pods with equal signatures are interchangeable to every predicate and score.
-    Returns an opaque hashable key."""
+    Returns an opaque hashable key.
+
+    Fast paths, in order:
+    1. workload memo — replicas of one template share a precomputed signature;
+    2. native canon_hash (C++, open_simulator_tpu/native) over the RAW
+       scheduling-relevant subtree. Raw hashing may split groups the computed
+       form would merge (e.g. "1000m" vs "1" cpu), which only duplicates
+       identical groups — never merges distinct ones;
+    3. the pure-Python computed tuple.
+    """
+    memo = pod.get(SIG_MEMO_KEY)
+    if memo is not None:
+        return memo
+
+    global _native_hash
+    if _native_hash == "unresolved":
+        from ..native import canon_hash_fn
+
+        _native_hash = canon_hash_fn()
     spec = pod.get("spec") or {}
+    if _native_hash is not None:
+        md = pod.get("metadata") or {}
+        anns = md.get("annotations") or {}
+        try:
+            return _native_hash((
+                namespace_of(pod),
+                md.get("labels"),
+                spec.get("nodeSelector"),
+                spec.get("affinity"),
+                spec.get("tolerations"),
+                spec.get("topologySpreadConstraints"),
+                spec.get("nodeName"),
+                spec.get("hostNetwork"),  # turns containerPorts into host ports
+                spec.get("containers"),
+                spec.get("initContainers"),
+                spec.get("overhead"),
+                sorted({r.get("kind", "") for r in md.get("ownerReferences") or []}),
+                [anns.get(k) for k in
+                 (C.AnnoGpuMem, C.AnnoGpuCount, C.AnnoGpuIndex, C.AnnoPodLocalStorage)],
+            ))
+        except TypeError:
+            pass  # exotic object in the tree → computed tuple below
     owner_kinds = sorted({r.get("kind", "") for r in (pod.get("metadata") or {}).get("ownerReferences") or []})
     images = sorted(c.get("image", "") for c in spec.get("containers") or [])
     return (
@@ -811,6 +856,15 @@ class BatchTables:
             self.seed_counter.shape[1] - 1, self.seed_port_used.shape[1] - 1,
             self.pod_group.shape[0],
         )
+
+
+def plugin_flags(bt: "BatchTables") -> Tuple[bool, bool]:
+    """(enable_gpu, enable_storage): static kernel flags — True when the batch has
+    any gpu / local-storage demand, so inert plugin subgraphs compile away."""
+    return (
+        bool(bt.grp_gpu_mem.any()),
+        bool(bt.grp_lvm_size.any() or bt.grp_sdev_size.any()),
+    )
 
 
 def _bucket(n: int) -> int:

@@ -43,6 +43,8 @@ from ..utils.objutil import (
     selector_from_set,
 )
 from .encode import (
+    SIG_MEMO_KEY,
+    plugin_flags,
     BatchTables,
     Encoder,
     NodeArrays,
@@ -178,6 +180,7 @@ class Simulator:
             port_ids=self.encoder.port_ids(pod_host_ports(pod)),
             carrier_ids=[self.encoder.carrier_id(cs) for cs in carried_specs_of_pod(pod)],
         )
+        pod.pop(SIG_MEMO_KEY, None)  # internal marker; keep result objects clean
         self.placed.append(rec)
         self.pods_on_node[node_i].append(pod)
 
@@ -220,6 +223,7 @@ class Simulator:
                 # Parity: the reference's fakeclient accepts pods bound to unknown
                 # nodes and getClusterNodeStatus (simulator.go:277-301) silently drops
                 # them from every report; we keep them findable on self.homeless.
+                pod.pop(SIG_MEMO_KEY, None)
                 self.homeless.append(pod)
             else:
                 self._commit_pod(pod, ni, scheduled=False)
@@ -259,6 +263,8 @@ class Simulator:
 
         bt = self.encode_batch(to_schedule)
         tables, carry = self._to_device(bt)
+        enable_gpu, enable_storage = plugin_flags(bt)
+        self._last_flags = (enable_gpu, enable_storage)
         final_carry, choices = kernels.schedule_batch(
             tables,
             carry,
@@ -266,6 +272,8 @@ class Simulator:
             _jax().asarray(bt.forced_node),
             _jax().asarray(bt.valid),
             n_zones=bt.n_zones,
+            enable_gpu=enable_gpu,
+            enable_storage=enable_storage,
         )
         choices = np.asarray(choices)
         self._last_tables, self._last_carry = bt, final_carry
@@ -284,6 +292,7 @@ class Simulator:
                     reasons = reason_cache[key] = self._explain_reasons(
                         pod, key[0], key[1], tables, final_carry
                     )
+                pod.pop(SIG_MEMO_KEY, None)
                 failed.append(UnscheduledPod(pod, self._format_reason(pod, reasons, self.na.N)))
         return failed
 
@@ -325,8 +334,10 @@ class Simulator:
         first-failing-plugin per node)."""
         jnp = _jax()
 
+        enable_gpu, enable_storage = getattr(self, "_last_flags", (True, True))
         feasible, stages = kernels.feasibility_jit(
-            tables, carry, jnp.int32(g), jnp.int32(forced), jnp.asarray(True)
+            tables, carry, jnp.int32(g), jnp.int32(forced), jnp.asarray(True),
+            enable_gpu=enable_gpu, enable_storage=enable_storage,
         )
         N = self.na.N  # stages arrays may carry phantom node padding; slice it off
         stages = {k: np.asarray(v)[:N] for k, v in stages.items()}

@@ -1,0 +1,82 @@
+"""Native canon_hash extension + signature memoization."""
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu.native import canon_hash_fn
+
+from fixtures import make_deployment, make_node
+from open_simulator_tpu import simulate
+from open_simulator_tpu.core.types import AppResource, ResourceTypes
+from open_simulator_tpu.models.workloads import pods_from_deployment
+from open_simulator_tpu.simulator.encode import SIG_MEMO_KEY, scheduling_signature
+
+
+@pytest.fixture(scope="module")
+def canon_hash():
+    fn = canon_hash_fn()
+    if fn is None:
+        pytest.skip("native extension unavailable (no compiler?)")
+    return fn
+
+
+def test_native_builds_and_hashes(canon_hash):
+    h = canon_hash({"a": 1, "b": [1, 2, {"c": "x"}]})
+    assert isinstance(h, int) and h > 0
+
+
+def test_dict_key_order_canonical(canon_hash):
+    assert canon_hash({"a": 1, "b": 2}) == canon_hash({"b": 2, "a": 1})
+
+
+def test_distinct_values_distinct_hashes(canon_hash):
+    samples = [
+        {"a": 1}, {"a": 2}, {"a": "1"}, {"a": [1]}, {"a": {"b": 1}},
+        {"a": None}, {"a": 1.5}, {"b": 1}, [1, 2], [2, 1], "x", 7, None, True, False,
+    ]
+    hashes = [canon_hash(s) for s in samples]
+    # bool True == 1 in Python tuple equality → allowed to collide with 7? no: 7 != True
+    assert len(set(hashes)) == len(samples)
+
+
+def test_numeric_equality_matches_python_tuples(canon_hash):
+    # (1,) == (1.0,) == (True,) in Python → the frozen-tuple form collides; the
+    # native hash must too, or equal groups would split forever
+    assert canon_hash(1) == canon_hash(1.0) == canon_hash(True)
+    assert canon_hash(0) == canon_hash(0.0) == canon_hash(False)
+    big = 2**70
+    assert canon_hash(big) == canon_hash(big)
+    assert canon_hash(big) != canon_hash(big + 1)
+
+
+def test_nested_list_vs_flat(canon_hash):
+    assert canon_hash([1, [2, 3]]) != canon_hash([1, 2, 3])
+    assert canon_hash([]) != canon_hash({})
+
+
+def test_unsupported_type_raises(canon_hash):
+    with pytest.raises(TypeError):
+        canon_hash(object())
+
+
+# ------------------------------------------------------------------ memoization -----
+
+
+def test_workload_pods_share_memo():
+    deploy = make_deployment("web", replicas=5, cpu="1", memory="1Gi")
+    pods = pods_from_deployment(deploy)
+    sigs = {scheduling_signature(p) for p in pods}
+    assert len(sigs) == 1
+    assert all(SIG_MEMO_KEY in p for p in pods)
+
+
+def test_memo_stripped_from_results():
+    nodes = [make_node("n1")]
+    deploy = make_deployment("web", replicas=3, cpu="1", memory="1Gi")
+    res = simulate(ResourceTypes(nodes=nodes),
+                   [AppResource(name="a", resource=ResourceTypes(deployments=[deploy]))])
+    for ns in res.node_status:
+        for p in ns.pods:
+            assert SIG_MEMO_KEY not in p
+    for up in res.unscheduled_pods:
+        assert SIG_MEMO_KEY not in up.pod
