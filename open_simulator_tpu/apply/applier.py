@@ -54,10 +54,6 @@ class Applier:
         self.cfg: SimonConfig = parse_simon_config(opts.simon_config)
         validate_config(self.cfg, opts.default_scheduler_config)
         self.out: TextIO = sys.stdout
-        self._close_out = False
-        if opts.output_file:
-            self.out = open(opts.output_file, "w")
-            self._close_out = True
 
     # ------------------------------------------------------------------ inputs ----
 
@@ -97,12 +93,17 @@ class Applier:
     # ------------------------------------------------------------------- run ------
 
     def run(self) -> Optional[SimulateResult]:
-        try:
-            return self._run()
-        finally:
-            if self._close_out:
-                self.out.close()
-                self._close_out = False
+        # The output file is opened (and closed) per run so a reused Applier never
+        # writes to a closed stream; without --output-file, self.out stays stdout.
+        if self.opts.output_file:
+            prev = self.out
+            with open(self.opts.output_file, "w") as f:
+                self.out = f
+                try:
+                    return self._run()
+                finally:
+                    self.out = prev
+        return self._run()
 
     def _run(self) -> Optional[SimulateResult]:
         cluster = self._load_cluster()

@@ -214,13 +214,53 @@ def _lex_expr(code: str, name: str) -> List[Tuple[str, str]]:
     return toks
 
 
+class _Scope:
+    """Go text/template variable scoping (text/template/exec.go's variable stack):
+    `:=` declares in the innermost block; `=` assigns to the nearest declaration;
+    leaving a block (if/with/range body, template invocation) discards the
+    declarations made inside it."""
+
+    __slots__ = ("map", "parent")
+
+    def __init__(self, parent: Optional["_Scope"] = None) -> None:
+        self.map: Dict[str, Any] = {}
+        self.parent = parent
+
+    def get(self, name: str) -> Any:
+        s = self
+        while s is not None:
+            if name in s.map:
+                return s.map[name]
+            s = s.parent
+        return None
+
+    def declare(self, name: str, val: Any) -> None:
+        self.map[name] = val
+
+    def assign(self, name: str, val: Any) -> None:
+        s = self
+        while s is not None:
+            if name in s.map:
+                s.map[name] = val
+                return
+            s = s.parent
+        self.map[name] = val  # lenient: undeclared `=` declares in place
+
+
 class _Ctx:
     def __init__(self, root: Any, defines: Dict[str, List[Node]], funcs, name: str) -> None:
         self.root = root
         self.defines = defines
         self.funcs = funcs
         self.name = name
-        self.vars: Dict[str, Any] = {}
+        self.vars = _Scope()
+
+    def child(self) -> "_Ctx":
+        sub = _Ctx.__new__(_Ctx)
+        sub.root, sub.defines, sub.funcs, sub.name = (
+            self.root, self.defines, self.funcs, self.name)
+        sub.vars = _Scope(self.vars)
+        return sub
 
 
 def _resolve_path(dot: Any, root: Any, path: str):
@@ -258,10 +298,13 @@ class _Evaluator:
 
     def eval(self, code: str) -> Any:
         toks = _lex_expr(code, self.ctx.name)
-        # variable assignment: $x := expr
+        # variable assignment: $x := expr (declare) / $x = expr (assign outward)
         if len(toks) >= 2 and toks[0][0] == "var" and toks[1][0] == "assign":
             val = self._eval_pipeline(toks[2:])
-            self.ctx.vars[toks[0][1]] = val
+            if toks[1][1] == ":=":
+                self.ctx.vars.declare(toks[0][1], val)
+            else:
+                self.ctx.vars.assign(toks[0][1], val)
             return ""
         return self._eval_pipeline(toks)
 
@@ -517,7 +560,7 @@ def _builtin_funcs() -> Dict[str, Callable]:
         "kindIs": f(lambda kind, v: _kind_of(v) == kind),
         "typeOf": f(lambda v: _kind_of(v)),
         "regexMatch": f(lambda pat, s: bool(re.search(pat, _fmt(s)))),
-        "regexReplaceAll": f(lambda pat, s, repl: re.sub(pat, repl.replace("$", "\\"), _fmt(s))),
+        "regexReplaceAll": f(lambda pat, s, repl: re.sub(pat, _go_repl(repl), _fmt(s))),
     }
     return funcs
 
@@ -576,18 +619,69 @@ def _semver_compare(constraint: str, version: str) -> bool:
     return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b}[op]
 
 
+def _go_repl(repl: str):
+    """Replacement callable implementing Go/RE2 Expand semantics: `$1`/`$name`
+    (longest word-char run), `${name}`, `$$` → literal `$`; references to
+    nonexistent groups expand to the empty string (regexp/regexp.go Expand)."""
+
+    def expand(m: "re.Match[str]") -> str:
+        out: List[str] = []
+        i, n = 0, len(repl)
+        while i < n:
+            c = repl[i]
+            if c != "$":
+                out.append(c)
+                i += 1
+                continue
+            if i + 1 < n and repl[i + 1] == "$":
+                out.append("$")
+                i += 2
+                continue
+            j = i + 1
+            braced = j < n and repl[j] == "{"
+            if braced:
+                j += 1
+            k = j
+            while k < n and (repl[k].isalnum() or repl[k] == "_"):
+                k += 1
+            name = repl[j:k]
+            if braced:
+                if k < n and repl[k] == "}":
+                    k += 1
+                else:  # unclosed ${ — Go emits a literal `$` and keeps the rest
+                    out.append("$")
+                    i += 1
+                    continue
+            if not name:
+                out.append("$")
+                i = j
+                continue
+            try:
+                grp = m.group(int(name) if name.isdigit() else name)
+            except (IndexError, re.error):
+                grp = None
+            out.append(grp or "")
+            i = k
+        return "".join(out)
+
+    return expand
+
+
 def _include(ev: "_Evaluator", name: str, dot=None) -> str:
+    """Template invocation gets a fresh variable stack (text/template exec.go
+    walkTemplate: new scope with only $ = the passed argument)."""
     body = ev.ctx.defines.get(name)
     if body is None:
         raise TemplateError(f"{ev.ctx.name}: include of undefined template {name!r}")
-    return _render_nodes(body, ev.ctx, dot if dot is not None else ev.dot)
+    dot = dot if dot is not None else ev.dot
+    sub = _Ctx(dot, ev.ctx.defines, ev.ctx.funcs, ev.ctx.name)
+    return _render_nodes(body, sub, dot)
 
 
 def _tpl(ev: "_Evaluator", src: str, dot=None) -> str:
     dot = dot if dot is not None else ev.dot
     nodes, defs = _parse(_tokenize(src, "tpl"), "tpl")
-    sub = _Ctx(ev.ctx.root, {**ev.ctx.defines, **defs}, ev.ctx.funcs, ev.ctx.name + ":tpl")
-    sub.vars = ev.ctx.vars
+    sub = _Ctx(dot, {**ev.ctx.defines, **defs}, ev.ctx.funcs, ev.ctx.name + ":tpl")
     return _render_nodes(nodes, sub, dot)
 
 
@@ -601,15 +695,17 @@ def _render_nodes(nodes: List[Node], ctx: _Ctx, dot: Any) -> str:
             out.append(_fmt(ev.eval(node.code)))
         elif isinstance(node, If):
             for cond, body in node.branches:
-                if cond is None or _truthy(_Evaluator(ctx, dot).eval(cond)):
-                    out.append(_render_nodes(body, ctx, dot))
+                child = ctx.child()
+                if cond is None or _truthy(_eval_guard(cond, child, dot)):
+                    out.append(_render_nodes(body, child, dot))
                     break
         elif isinstance(node, With):
-            v = _Evaluator(ctx, dot).eval(node.code)
+            child = ctx.child()
+            v = _eval_guard(node.code, child, dot)
             if _truthy(v):
-                out.append(_render_nodes(node.body, ctx, v))
+                out.append(_render_nodes(node.body, child, v))
             else:
-                out.append(_render_nodes(node.else_body, ctx, dot))
+                out.append(_render_nodes(node.else_body, ctx.child(), dot))
         elif isinstance(node, Range):
             out.append(_render_range(node, ctx, dot))
         elif isinstance(node, Define):
@@ -617,6 +713,21 @@ def _render_nodes(nodes: List[Node], ctx: _Ctx, dot: Any) -> str:
         else:  # pragma: no cover
             raise TemplateError(f"{ctx.name}: unknown node {node!r}")
     return "".join(out)
+
+
+_GUARD_RE = re.compile(r"^\s*(\$[A-Za-z0-9_]+)\s*:=\s*(.*)$", re.S)
+
+
+def _eval_guard(code: str, ctx: _Ctx, dot: Any) -> Any:
+    """Evaluate an if/with pipeline, supporting the `$x := pipeline` declaration
+    form (text/template: the value is the pipeline's; the variable is scoped to
+    the guarded block, which is why callers pass a child ctx)."""
+    m = _GUARD_RE.match(code)
+    if m:
+        val = _Evaluator(ctx, dot).eval(m.group(2))
+        ctx.vars.declare(m.group(1), val)
+        return val
+    return _Evaluator(ctx, dot).eval(code)
 
 
 def _render_range(node: Range, ctx: _Ctx, dot: Any) -> str:
@@ -628,18 +739,20 @@ def _render_range(node: Range, ctx: _Ctx, dot: Any) -> str:
         code = m.group(2)
     coll = _Evaluator(ctx, dot).eval(code)
     if not _truthy(coll):
-        return _render_nodes(node.else_body, ctx, dot)
+        return _render_nodes(node.else_body, ctx.child(), dot)
     out: List[str] = []
     if isinstance(coll, dict):
         items = list(coll.items())
     else:
         items = list(enumerate(coll))
     for k, v in items:
+        body_ctx = ctx.child()  # loop vars + body declarations die at each `end`
         if len(var_names) == 2:
-            ctx.vars[var_names[0]], ctx.vars[var_names[1]] = k, v
+            body_ctx.vars.declare(var_names[0], k)
+            body_ctx.vars.declare(var_names[1], v)
         elif len(var_names) == 1:
-            ctx.vars[var_names[0]] = v
-        out.append(_render_nodes(node.body, ctx, v))
+            body_ctx.vars.declare(var_names[0], v)
+        out.append(_render_nodes(node.body, body_ctx, v))
     return "".join(out)
 
 

@@ -99,11 +99,13 @@ def cmd_apply(args) -> int:
             extended_resources=ext,
             output_file=args.output_file,
         ))
-        applier.run()
+        result = applier.run()
     except Exception as e:  # mirror `apply error: ...` + exit 1 (cmd/apply/apply.go:17-24)
         print(f"apply error: {e}", file=sys.stderr)
         return 1
-    return 0
+    # None = planning failed / user exited without a schedulable outcome; scripts
+    # need a nonzero exit to distinguish it from success.
+    return 0 if result is not None else 1
 
 
 def cmd_server(args) -> int:

@@ -379,6 +379,24 @@ def generate_valid_pods_from_app(app_name: str, rt, nodes: List[dict]) -> List[d
     pods = expand_workloads_excluding_daemonsets(rt)
     for ds in rt.daemon_sets:
         pods.extend(pods_from_daemonset(ds, nodes))
+    # The app-name label lands AFTER expansion stamped the signature memos, and
+    # labels are part of the scheduling signature — refresh each workload's memo
+    # (one recompute per distinct old memo, still O(1) per replica) so identical
+    # templates from different apps never share a scheduling group. DaemonSet
+    # memos keep the documented invariant of being the UNPINNED template's
+    # signature (pods_from_daemonset), so the per-node pin is stripped first.
+    from ..simulator.encode import SIG_MEMO_KEY, scheduling_signature, strip_daemon_pin
+
+    remapped: dict = {}
     for pod in pods:
         set_label(pod, C.LabelAppName, app_name)
+        old = pod.pop(SIG_MEMO_KEY, None)
+        if old is not None:
+            new = remapped.get(old)
+            if new is None:
+                stripped, target = strip_daemon_pin(pod)
+                new = remapped[old] = scheduling_signature(
+                    stripped if target is not None else pod
+                )
+            pod[SIG_MEMO_KEY] = new
     return pods

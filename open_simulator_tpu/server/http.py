@@ -112,7 +112,9 @@ class Server:
             return 503, "The server is busy, please try again later"
         try:
             snap = self.snapshot_fn()
-            cluster = snap.resource
+            # copy: an injectable snapshot_fn may return shared lists, and the
+            # handler appends fake nodes — never mutate the snapshot in place.
+            cluster = snap.resource.copy()
             for new_node in req.get("newnodes") or []:
                 cluster.nodes.append(new_fake_node(new_node))
             app = ResourceTypes(
@@ -136,7 +138,7 @@ class Server:
             return 503, "The server is busy, please try again later"
         try:
             snap = self.snapshot_fn()
-            cluster = snap.resource
+            cluster = snap.resource.copy()  # see handle_deploy_apps
             for new_node in req.get("newnodes") or []:
                 cluster.nodes.append(new_fake_node(new_node))
             cluster.pods = self._remove_pods_of_app(cluster.pods, req, snap)

@@ -423,29 +423,29 @@ def scheduling_signature(pod: dict):
     )
 
 
-def extract_forced_node(pod: dict, na: NodeArrays) -> Tuple[dict, int]:
+def strip_daemon_pin(pod: dict) -> Tuple[dict, Optional[str]]:
     """Detect the DaemonSet pin pattern — every required term carries matchFields
-    metadata.name In [x] for one node x — and return (pod-sans-pin, node index). The
-    stripped pod keeps its matchExpressions so the group's static mask still applies
-    (models/workloads.py set_daemon_pod_node_affinity keeps both)."""
+    metadata.name In [x] for one node x — and return (pod-sans-pin, node name) or
+    (pod, None). The stripped pod keeps its matchExpressions so the group's static
+    mask still applies (models/workloads.py set_daemon_pod_node_affinity keeps both)."""
     spec = pod.get("spec") or {}
     required = ((spec.get("affinity") or {}).get("nodeAffinity") or {}).get(
         "requiredDuringSchedulingIgnoredDuringExecution"
     )
     if not required:
-        return pod, -1
+        return pod, None
     terms = required.get("nodeSelectorTerms") or []
     target = None
     for t in terms:
         mf = t.get("matchFields") or []
         if len(mf) != 1 or mf[0].get("key") != "metadata.name" or mf[0].get("operator") != "In":
-            return pod, -1
+            return pod, None
         vals = mf[0].get("values") or []
         if len(vals) != 1 or (target is not None and vals[0] != target):
-            return pod, -1
+            return pod, None
         target = vals[0]
-    if target is None or target not in na.index:
-        return pod, -1
+    if target is None:
+        return pod, None
     import copy
 
     stripped = copy.deepcopy(pod)
@@ -462,6 +462,15 @@ def extract_forced_node(pod: dict, na: NodeArrays) -> Tuple[dict, int]:
     else:
         stripped["spec"]["affinity"]["nodeAffinity"].pop(
             "requiredDuringSchedulingIgnoredDuringExecution")
+    return stripped, target
+
+
+def extract_forced_node(pod: dict, na: NodeArrays) -> Tuple[dict, int]:
+    """strip_daemon_pin resolved against the cluster: (pod-sans-pin, node index),
+    or (pod, -1) when there is no pin or the target node is unknown."""
+    stripped, target = strip_daemon_pin(pod)
+    if target is None or target not in na.index:
+        return pod, -1
     return stripped, na.index[target]
 
 

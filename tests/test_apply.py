@@ -96,6 +96,10 @@ def test_applier_auto_capacity_planning(tmp_path):
     report = out.read_text()
     assert "Node Info" in report and "App Info" in report
     assert "demo-node-1" in report
+    # a reused Applier must reopen the output file, not write to a closed one
+    result2 = applier.run()
+    assert result2 is not None and not result2.unscheduled_pods
+    assert "Node Info" in out.read_text()
 
 
 def test_applier_adds_nodes_when_needed(tmp_path, monkeypatch):

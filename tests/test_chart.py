@@ -76,6 +76,55 @@ def test_unknown_function_raises():
         render_template("{{ .Values.name | definitelynotafunc }}", V)
 
 
+def test_variable_block_scoping():
+    # range loop vars and := declarations die at `end` (Go text/template scoping)
+    t = ('{{ $x := "outer" }}'
+         '{{ range $i, $p := .Values.ports }}{{ $x := "inner" }}{{ $x }}{{ end }}'
+         '|{{ $x }}')
+    assert render_template(t, V) == "innerinner|outer"
+    # `=` assignment inside a block writes through to the outer declaration
+    t2 = ('{{ $x := "a" }}{{ if .Values.enabled }}{{ $x = "b" }}{{ end }}{{ $x }}')
+    assert render_template(t2, V) == "b"
+    # sibling with-blocks reusing a name don't leak into each other
+    t3 = ('{{ with .Values.labels }}{{ $v := .team }}{{ $v }}{{ end }}'
+          '{{ with .Values.labels }}{{ $v }}{{ end }}')
+    assert render_template(t3, V) == "infra"
+
+
+def test_include_gets_fresh_variable_scope():
+    # variables set at the call site are invisible inside the invoked template,
+    # and $ inside the template is its dot argument
+    t = ('{{ define "t" }}{{ $v }}:{{ $.team }}{{ end }}'
+         '{{ $v := "caller" }}{{ include "t" .Values.labels }}')
+    assert render_template(t, V) == ":infra"
+
+
+def test_regex_replace_all_capture_groups():
+    t = '{{ regexReplaceAll "(a)(b)" "ab-ab" "${2}${1}" }}'
+    assert render_template(t, V) == "ba-ba"
+    # Go reads `$1x` as group name "1x" (longest run) → empty when absent
+    t2 = '{{ regexReplaceAll "a(b)" "zab" "$1x" }}'
+    assert render_template(t2, V) == "z"
+    t2b = '{{ regexReplaceAll "a(b)" "zab" "${1}x" }}'
+    assert render_template(t2b, V) == "zbx"
+    t3 = '{{ regexReplaceAll "b" "abc" "$$" }}'
+    assert render_template(t3, V) == "a$c"
+    # unclosed ${ keeps the literal text, as Go's regexp.Expand does
+    t4 = '{{ regexReplaceAll "a" "Xa" "${foo" }}'
+    assert render_template(t4, V) == "X${foo"
+
+
+def test_with_if_variable_guard():
+    # `with $x := pipeline` declares the var, sets dot to the value (Go semantics)
+    t = '{{ with $x := .Values.labels }}Y{{ $x.team }}:{{ .tier }}{{ end }}'
+    assert render_template(t, V) == "Yinfra:backend"
+    t2 = '{{ if $n := .Values.replicas }}n={{ $n }}{{ end }}'
+    assert render_template(t2, V) == "n=3"
+    # falsy guard takes the else branch; dot unchanged there
+    t3 = '{{ with $x := .Values.absent }}Y{{ else }}N{{ end }}'
+    assert render_template(t3, V) == "N"
+
+
 # ----------------------------------------------------------------- chart dirs -------
 
 

@@ -69,6 +69,18 @@ def test_deploy_apps_handler():
     assert placed == 3
 
 
+def test_deploy_apps_does_not_mutate_shared_snapshot():
+    # an injectable snapshot_fn may hand back shared lists; fake nodes must not
+    # accumulate across requests
+    snap = _snapshot(nodes=[make_node("n1")])
+    server = Server(snapshot_fn=lambda: snap)
+    newnode = make_node("template")
+    for _ in range(3):
+        code, _body = server.handle_deploy_apps({"newnodes": [newnode]})
+        assert code == 200
+    assert len(snap.resource.nodes) == 1
+
+
 def test_deploy_apps_newnodes_and_pending():
     pending = [make_pod("stuck", cpu="1", memory="1Gi")]
     server = Server(snapshot_fn=lambda: _snapshot(nodes=[], pending=pending))
