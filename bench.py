@@ -1,17 +1,23 @@
-"""Headline benchmark: batched scheduling throughput.
+"""Benchmarks: batched scheduling throughput across the BASELINE.md configs.
 
-Workload (BASELINE.md config #2): 1,000-node synthetic cluster, 10,000 nginx-shaped
-pods with cpu/mem requests — the NodeResourcesFit-dominated shape. The metric is
-end-to-end pods scheduled per second with a warm compile cache: host-side batch
-encoding + one compiled `lax.scan` over all 10k pods on the accelerator, preserving
-the reference's strictly serial placement semantics
-(/root/reference/pkg/simulator/simulator.go:309-348 schedules one pod per channel
-handshake; here one scan step per pod).
+Headline (stdout, ONE JSON line): the north-star shape — 100,000 pods onto
+10,000 nodes, end-to-end through the engine (host encode + wave/serial device
+scheduling + commit bookkeeping), warm compile cache. Baseline for
+`vs_baseline` is BASELINE.json's target: 100k pods in <2s ⇒ 50,000 pods/s.
 
-Baseline for `vs_baseline` is the BASELINE.json north star: 100k pods onto 10k nodes
-in <2s ⇒ 50,000 pods/s. vs_baseline = value / 50_000.
+The remaining configs print as JSON lines on stderr and are also written to
+BENCH_DETAIL.json:
+  - throughput_10k_1k:   config 2, 10k nginx pods / 1k nodes (round-1 headline)
+  - gpushare_1k:         config 3, GPU-memory bin-packing on 1k GPU nodes
+  - hard_predicates_50k_5k: config 4, 50k pods / 5k nodes with taints +
+    anti-affinity + zone topology spread (mixed wave/serial segments)
+  - capacity_plan_100k:  config 5, add-node auto-search until 100k pods fit
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+All runs preserve the reference's serial placement semantics
+(/root/reference/pkg/simulator/simulator.go:309-348 schedules one pod per
+channel handshake; here wave segments provably reproduce consecutive serial
+steps — see ops/kernels.py schedule_wave — and everything else is one
+lax.scan step per pod).
 """
 
 from __future__ import annotations
@@ -20,73 +26,157 @@ import json
 import sys
 import time
 
-N_NODES = 1_000
-N_PODS = 10_000
 BASELINE_PODS_PER_SEC = 50_000.0
 
 
-def main() -> None:
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from open_simulator_tpu.ops import kernels
+def _schedule_run(nodes, pods):
+    """One timed end-to-end engine run. Returns (seconds, scheduled, total)."""
     from open_simulator_tpu.simulator.engine import Simulator
+
+    sim = Simulator(nodes)
+    t0 = time.perf_counter()
+    failed = sim.schedule_pods(pods)
+    dt = time.perf_counter() - t0
+    total = sum(len(p) for p in sim.pods_on_node)
+    return dt, total, total + len(failed)
+
+
+def bench_throughput(n_nodes, n_pods, hard=False, repeats=2):
     from open_simulator_tpu.utils.synth import synth_cluster
 
-    nodes, pods = synth_cluster(N_NODES, N_PODS)
+    best = None
+    for _ in range(repeats + 1):  # first run pays the compile; keep best warm run
+        nodes, pods = synth_cluster(n_nodes, n_pods, hard_predicates=hard)
+        dt, placed, total = _schedule_run(nodes, pods)
+        if best is None or dt < best[0]:
+            best = (dt, placed, total)
+    dt, placed, total = best
+    return placed / dt, placed, total, dt
 
-    # Host encode (counted): pods -> device tables.
-    t0 = time.perf_counter()
-    sim = Simulator(nodes)
-    bt = sim.encode_batch(pods)
-    t_encode = time.perf_counter() - t0
 
-    from open_simulator_tpu.simulator.encode import plugin_flags
+def bench_gpushare(n_nodes=1_000, n_pods=5_000, repeats=2):
+    """Config 3: pods requesting shared GPU memory via alibabacloud.com annotations
+    (open-gpu-share.go Filter/Reserve semantics; ledger in the scan carry)."""
+    from open_simulator_tpu.utils.synth import synth_node, synth_pod
 
-    tables, carry = sim._to_device(bt)
-    pg = jnp.asarray(bt.pod_group)
-    fn = jnp.asarray(bt.forced_node)
-    vd = jnp.asarray(bt.valid)
-    enable_gpu, enable_storage = plugin_flags(bt)
+    best = None
+    for _ in range(repeats + 1):
+        nodes = []
+        for i in range(n_nodes):
+            n = synth_node(i)
+            for sect in ("capacity", "allocatable"):  # plugin reads capacity
+                n["status"][sect]["alibabacloud.com/gpu-count"] = "8"
+                n["status"][sect]["alibabacloud.com/gpu-mem"] = str(8 * 16 << 30)
+            nodes.append(n)
+        pods = []
+        for i in range(n_pods):
+            p = synth_pod(i)
+            p["metadata"].setdefault("annotations", {})[
+                "alibabacloud.com/gpu-mem"] = str(4 << 30)
+            p["metadata"]["annotations"]["alibabacloud.com/gpu-count"] = "1"
+            pods.append(p)
+        dt, placed, total = _schedule_run(nodes, pods)
+        if best is None or dt < best[0]:
+            best = (dt, placed, total)
+    dt, placed, total = best
+    return placed / dt, placed, total, dt
 
-    # Cold run: compile + execute (discarded). np.asarray forces a device→host
-    # transfer as the sync point (block_until_ready alone can return early through
-    # remote-device tunnels).
-    out = kernels.schedule_batch(tables, carry, pg, fn, vd, n_zones=bt.n_zones,
-                                 enable_gpu=enable_gpu, enable_storage=enable_storage)
-    np.asarray(out[1])
 
-    # Warm runs from the same initial carry.
-    times = []
-    for _ in range(3):
-        t1 = time.perf_counter()
-        final, choices = kernels.schedule_batch(
-            tables, carry, pg, fn, vd, n_zones=bt.n_zones,
-            enable_gpu=enable_gpu, enable_storage=enable_storage,
-        )
-        choices = np.asarray(choices)
-        times.append(time.perf_counter() - t1)
-    t_exec = min(times)
-    scheduled = int((choices[np.asarray(bt.valid)] >= 0).sum())
-    if scheduled != N_PODS:
-        print(
-            f"WARNING: only {scheduled}/{N_PODS} pods schedulable", file=sys.stderr
-        )
+def bench_capacity_plan(n_pods=100_000, repeats=1):
+    """Config 5: add-node auto search — from a 64-node base, double the simon
+    node count until all pods fit within a 60% MaxCPU envelope, timing the whole
+    search (each probe is one full simulation, as in apply.go:203-259)."""
+    import os
 
-    wall = t_encode + t_exec
-    value = scheduled / wall
-    print(json.dumps({
-        "metric": f"pods_scheduled_per_sec_{N_PODS//1000}k_pods_{N_NODES}_nodes",
-        "value": round(value, 1),
+    from open_simulator_tpu.apply.applier import satisfy_resource_setting
+    from open_simulator_tpu.models.fakenode import new_fake_nodes
+    from open_simulator_tpu.simulator.engine import Simulator
+    from open_simulator_tpu.utils.synth import synth_node, synth_pod
+
+    os.environ["MaxCPU"] = "60"
+    try:
+        base_nodes = [synth_node(i) for i in range(64)]
+        template = synth_node(0)
+        best = None
+        for _ in range(repeats + 1):
+            t0 = time.perf_counter()
+            n, result_nodes = 64, None
+            while n <= 4_096:
+                trial = base_nodes + new_fake_nodes(template, n)
+                sim = Simulator(trial)
+                pods = [synth_pod(i) for i in range(n_pods)]
+                failed = sim.schedule_pods(pods)
+                ok, _ = satisfy_resource_setting(sim.get_cluster_node_status())
+                if not failed and ok:
+                    result_nodes = n
+                    break
+                n *= 2
+            dt = time.perf_counter() - t0
+            if best is None or dt < best[0]:
+                best = (dt, result_nodes)
+        dt, added = best
+        return n_pods / dt, added, dt
+    finally:
+        os.environ.pop("MaxCPU", None)
+
+
+def main() -> None:
+    results = []
+
+    # ---- headline: north star ------------------------------------------------
+    rate, placed, total, dt = bench_throughput(10_000, 100_000)
+    headline = {
+        "metric": "pods_scheduled_per_sec_100k_pods_10k_nodes",
+        "value": round(rate, 1),
         "unit": "pods/s",
-        "vs_baseline": round(value / BASELINE_PODS_PER_SEC, 4),
-    }))
-    print(
-        f"encode {t_encode*1e3:.1f} ms, device scan {t_exec*1e3:.1f} ms, "
-        f"scheduled {scheduled}/{N_PODS} on {N_NODES} nodes",
-        file=sys.stderr,
-    )
+        "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4),
+    }
+    results.append(dict(headline, wall_s=round(dt, 3), scheduled=placed, total=total))
+    print(json.dumps(headline), flush=True)
+
+    # ---- config 2: 10k/1k ----------------------------------------------------
+    rate, placed, total, dt = bench_throughput(1_000, 10_000)
+    results.append({
+        "metric": "pods_scheduled_per_sec_10k_pods_1000_nodes",
+        "value": round(rate, 1), "unit": "pods/s",
+        "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4),
+        "wall_s": round(dt, 3), "scheduled": placed, "total": total,
+    })
+
+    # ---- config 3: gpushare --------------------------------------------------
+    rate, placed, total, dt = bench_gpushare()
+    results.append({
+        "metric": "gpushare_pods_per_sec_5k_pods_1k_nodes",
+        "value": round(rate, 1), "unit": "pods/s",
+        "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4),
+        "wall_s": round(dt, 3), "scheduled": placed, "total": total,
+    })
+
+    # ---- config 4: hard predicates ------------------------------------------
+    rate, placed, total, dt = bench_throughput(5_000, 50_000, hard=True)
+    results.append({
+        "metric": "hard_predicate_pods_per_sec_50k_pods_5k_nodes",
+        "value": round(rate, 1), "unit": "pods/s",
+        "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4),
+        "wall_s": round(dt, 3), "scheduled": placed, "total": total,
+    })
+
+    # ---- config 5: capacity planning ----------------------------------------
+    rate, added, dt = bench_capacity_plan()
+    results.append({
+        "metric": "capacity_plan_pods_per_sec_100k_pods",
+        # a search that exhausted its node budget has no meaningful throughput
+        "value": round(rate, 1) if added is not None else 0.0,
+        "unit": "pods/s",
+        "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4) if added is not None else 0.0,
+        "wall_s": round(dt, 3), "nodes_added": added,
+        "search_exhausted": added is None,
+    })
+
+    for r in results[1:]:
+        print(json.dumps(r), file=sys.stderr, flush=True)
+    with open("BENCH_DETAIL.json", "w") as f:
+        json.dump(results, f, indent=1)
 
 
 if __name__ == "__main__":

@@ -69,6 +69,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="show extended resources when reporting, comma-separated "
              "(e.g. open-local,gpu)",
     )
+    p_apply.add_argument(
+        "--placement-dump", default="",
+        help="write a JSON placement dump for the parity tool",
+    )
+
+    p_parity = sub.add_parser(
+        "parity", help="Compute the placement match-rate between two dumps "
+                       "written by `apply --placement-dump`")
+    p_parity.add_argument("dump_a")
+    p_parity.add_argument("dump_b")
+    p_parity.add_argument("--threshold", type=float, default=0.99,
+                          help="exit nonzero below this rate")
+    p_parity.add_argument("-v", "--verbose", action="store_true",
+                          help="list disagreeing placements")
 
     p_server = sub.add_parser("server", help="Start a HTTP server that simulates "
                                              "deploy/scale requests against a live cluster")
@@ -100,6 +114,10 @@ def cmd_apply(args) -> int:
             output_file=args.output_file,
         ))
         result = applier.run()
+        if result is not None and args.placement_dump:
+            from ..parity import placement_dump, save_dump
+
+            save_dump(placement_dump(result), args.placement_dump)
     except Exception as e:  # mirror `apply error: ...` + exit 1 (cmd/apply/apply.go:17-24)
         print(f"apply error: {e}", file=sys.stderr)
         return 1
@@ -151,11 +169,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     _init_logging()
     parser = build_parser()
     args = parser.parse_args(argv)
+    from ..parity import cmd_parity
+
     handlers = {
         "apply": cmd_apply,
         "server": cmd_server,
         "version": cmd_version,
         "gen-doc": cmd_gen_doc,
+        "parity": cmd_parity,
     }
     if not args.command:
         parser.print_help()

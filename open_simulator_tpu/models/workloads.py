@@ -337,18 +337,6 @@ def make_valid_node(node: dict, node_name: str) -> dict:
     return node
 
 
-def new_fake_nodes(template: dict, count: int) -> List[dict]:
-    """Clone the newNode spec `count` times as `simon-<suffix5>` with the new-node label
-    (NewFakeNodes/NewFakeNode, utils.go:885-915)."""
-    nodes = []
-    for _ in range(count):
-        node_name = f"{C.NewNodeNamePrefix}-{_suffix()[:5]}"
-        node = make_valid_node(template, node_name)
-        set_label(node, C.LabelNewNode, "true")
-        nodes.append(node)
-    return nodes
-
-
 # ---------------------------------------------------------- app/cluster expand --------
 
 

@@ -559,6 +559,250 @@ def _step(tb: Tables, cry: Carry, xs, n_zones: int, enable_gpu: bool, enable_sto
 feasibility_jit = jax.jit(feasibility, static_argnames=("enable_gpu", "enable_storage"))
 
 
+# ------------------------------------------------------------------ wave kernel -------
+#
+# A run of identical pods (one scheduling group) whose only self-interaction is
+# capacity — no host ports, no gpu/storage state, no spread terms, no
+# selector-spread, and no affinity/anti-affinity term matching the group itself
+# (hostname-topology self-anti-affinity allowed: it is exactly a per-node
+# capacity-1 clamp) — can be committed in *waves* while reproducing the serial
+# one-pod-per-step process bit-for-bit. The engine proves eligibility on the host
+# (Simulator._wave_eligibility); this kernel proves each wave equals that many
+# serial argmax picks:
+#
+#   * With per-node placement counts j fixed, node n's score is
+#     static(n) + least/balanced(usage_n + j_n·req) + norm(F) where every
+#     normalization term (Simon/NodeAffinity/TaintToleration/InterPodAffinity
+#     min-max) depends only on the feasible SET F — not on j directly. So the
+#     score of the (k+1)-th copy on node n is a closed form in k: a score TABLE
+#     s[n, k], k < B, computable without placing anything.
+#   * Serial scheduling of this group is greedy selection over per-node "heads":
+#     repeatedly take max_n s[n, j_n] under the deterministic tie-break (lowest
+#     node index — _step's first-max argmax). When each node's score column is
+#     non-increasing in k, the greedy's first m picks are EXACTLY the m largest
+#     table entries under the key (score desc, node index asc), each node
+#     consuming a prefix of its column — i.e. one stable sort of the flattened
+#     table schedules up to N·B pods at once. Non-monotone columns (possible:
+#     BalancedAllocation can rise as usage evens out) are masked past the first
+#     violation and simply defer to the next iteration.
+#   * Normalizers stay valid only while the feasible set F is unchanged, and F
+#     changes exactly when a node exhausts its capacity. A node's capacity-
+#     exhausting entry may therefore be taken only as the LAST pick of a wave —
+#     unless removing all exhausted nodes provably leaves every normalizer value
+#     unchanged (min/max over a shrinking set is monotone, so end-equality
+#     implies invariance throughout), in which case the wave runs to m.
+#
+# Each while-loop iteration costs one [N,B] elementwise table + an O(NB log NB)
+# sort — and typically places min(m, N·B) pods, collapsing the 1-pod-per-scan-
+# step bottleneck that capped round 1 at ~15k pods/s (simulator.go:309-348 is
+# the serial loop being replaced at scale).
+
+WAVE_BLOCK = 64  # B: score-table depth = max copies per node per wave iteration
+
+
+def _wave_statics(tb: Tables, cry: Carry, g):
+    """Per-segment constants: ip_raw (counters can't change during the wave) and
+    the static score vectors, exactly as scores() computes them."""
+    cnt_at = jnp.take_along_axis(cry.counter, tb.counter_dom, axis=1)
+    carr_at = jnp.take_along_axis(cry.carrier, tb.carr_dom, axis=1)
+    pref_ids = tb.pref_t[g]
+    pvalid = pref_ids >= 0
+    pidx = jnp.maximum(pref_ids, 0)
+    w = tb.pref_w[g]
+    ip_raw = jnp.sum(jnp.where(pvalid[:, None], w[:, None] * cnt_at[pidx], 0.0), axis=0)
+    carr_w = (tb.carr_hard_w + tb.carr_pref_w) * tb.carr_sel_match_g[:, g]
+    ip_raw = ip_raw + jnp.sum(carr_w[:, None] * carr_at, axis=0)
+    return {
+        "ip_raw": ip_raw,
+        "simon_s": _flr(100.0 * tb.simon_raw[g]),
+        "na_raw": tb.nodeaff_raw[g],
+        "t_raw": tb.taint_raw[g],
+        "static": W_AVOID * tb.avoid_raw[g] + W_IMAGE * tb.image_raw[g],
+    }
+
+
+def _wave_norms(st: dict, F):
+    """The feasible-set-dependent normalizer values (must match scores())."""
+    simon_hi = jnp.max(jnp.where(F, st["simon_s"], -jnp.inf))
+    simon_lo = jnp.min(jnp.where(F, st["simon_s"], jnp.inf))
+    na_max = jnp.maximum(jnp.max(jnp.where(F, st["na_raw"], -jnp.inf)), 0.0)
+    t_max = jnp.maximum(jnp.max(jnp.where(F, st["t_raw"], -jnp.inf)), 0.0)
+    ip_max = jnp.maximum(jnp.max(jnp.where(F, st["ip_raw"], -jnp.inf)), 0.0)
+    ip_min = jnp.minimum(jnp.min(jnp.where(F, st["ip_raw"], jnp.inf)), 0.0)
+    return (simon_hi, simon_lo, na_max, t_max, ip_max, ip_min)
+
+
+def _wave_score_table(tb: Tables, cry: Carry, st: dict, norms, g, j):
+    """[N, B] score table: entry (n, k) = score of placing the (j_n+k+1)-th copy
+    of group g on node n given current usage. Formulas mirror scores() term by
+    term; the constant-on-F plugins (SelectorSpread=100, PodTopologySpread=100,
+    OpenLocal=0) are dropped — a uniform shift never changes the ordering the
+    wave consumes."""
+    simon_hi, simon_lo, na_max, t_max, ip_max, ip_min = norms
+    B = WAVE_BLOCK + 1  # one extra column: the exact first-hidden-entry bound
+    copies = j.astype(_F32)[:, None, None] + jnp.arange(1, B + 1, dtype=_F32)[None, :, None]
+    alloc_cm = tb.alloc[:, (CPU_I, MEM_I)]                            # [N, 2]
+    used = cry.nonzero[:, None, :] + tb.grp_nonzero[g][None, None, :] * copies  # [N,B,2]
+
+    def least_one(u, a):
+        return jnp.where((a > 0) & (u <= a), _flr((a - u) * 100.0 / a), 0.0)
+
+    a_c = alloc_cm[:, None, 0]
+    a_m = alloc_cm[:, None, 1]
+    least = _flr((least_one(used[:, :, 0], a_c) + least_one(used[:, :, 1], a_m)) / 2.0)
+    cf = jnp.where(a_c > 0, used[:, :, 0] / a_c, 1.0)
+    mf = jnp.where(a_m > 0, used[:, :, 1] / a_m, 1.0)
+    balanced = jnp.where((cf >= 1.0) | (mf >= 1.0), 0.0, _flr((1.0 - jnp.abs(cf - mf)) * 100.0))
+
+    rng = simon_hi - simon_lo
+    simon = jnp.where((rng > 0) & jnp.isfinite(rng),
+                      _flr((st["simon_s"] - simon_lo) * 100.0 / rng), 0.0)
+    nodeaff = jnp.where(na_max > 0, _flr(st["na_raw"] * 100.0 / na_max), 0.0)
+    taint = jnp.where(t_max > 0, 100.0 - _flr(st["t_raw"] * 100.0 / t_max), 100.0)
+    ip_rng = ip_max - ip_min
+    interpod = jnp.where(ip_rng > 0, _flr(100.0 * (st["ip_raw"] - ip_min) / ip_rng), 0.0)
+    static_n = ((W_SIMON + W_GPUSHARE) * simon + W_NODEAFF * nodeaff
+                + W_TAINT * taint + W_INTERPOD * interpod + st["static"])
+    return W_LEAST * least + W_BALANCED * balanced + static_n[:, None]
+
+
+def _wave_capacity(tb: Tables, cry: Carry, g, cap1):
+    """[N] i32: how many MORE copies of group g each node can take, from the
+    closed-form NodeResourcesFit bound (same eps slack as feasibility())."""
+    req = tb.grp_requests[g]
+    eps = tb.alloc * 1e-6
+    room = tb.alloc + eps - cry.requested
+    per_res = jnp.where(req[None, :] > 0, jnp.floor(room / jnp.maximum(req[None, :], 1e-30)), jnp.inf)
+    cap = jnp.clip(jnp.min(per_res, axis=1), 0.0, 2_147_483_000.0).astype(jnp.int32)
+    return jnp.where(cap1, jnp.minimum(cap, 1), cap)
+
+
+@jax.jit
+def schedule_wave(tb: Tables, cry: Carry, g, m, cap1):
+    """Place up to m pods of wave-eligible group g, exactly reproducing m serial
+    _step placements. Returns (new carry, per-node counts [N] i32, placed i32).
+
+    cap1: the group carries hostname-topology required anti-affinity matching
+    itself, so every node takes at most one pod of this segment (the tensor
+    equivalent of satisfyPodAntiAffinity's self-blocking direction)."""
+    N = tb.alloc.shape[0]
+    B = WAVE_BLOCK
+    iota_n = jnp.arange(N, dtype=jnp.int32)
+    base_feas, _ = feasibility(
+        tb, cry, g, jnp.int32(-1), jnp.asarray(True), enable_gpu=False, enable_storage=False
+    )
+    st = _wave_statics(tb, cry, g)
+    capacity = jnp.where(base_feas, _wave_capacity(tb, cry, g, cap1), 0)
+
+    def body(state):
+        j, placed, _ = state
+        avail = capacity - j                                   # copies left per node
+        F = base_feas & (avail > 0)
+        norms = _wave_norms(st, F)
+        table_ext = _wave_score_table(tb, cry, st, norms, g, j)  # [N, B+1]
+        table = table_ext[:, :B]
+        ks = jnp.arange(B, dtype=jnp.int32)[None, :]
+        # usable entries: within remaining capacity, and monotone prefix only
+        in_cap = ks < avail[:, None]
+        mono = jnp.cumprod(
+            jnp.concatenate(
+                [jnp.ones((N, 1), jnp.int32),
+                 (table[:, 1:] <= table[:, :-1]).astype(jnp.int32)], axis=1),
+            axis=1) > 0
+        usable = in_cap & mono & F[:, None]
+
+        # Hidden-continuation guard: serial would keep consuming a node's column
+        # past what this wave exposes (beyond depth B, or past a monotonicity
+        # break). Each node's FIRST hidden entry is exactly table_ext[n, k_hid]
+        # where k_hid = min(first break, B); it exists iff k_hid < avail. An
+        # entry may be taken this wave only if its key (score desc, index asc)
+        # strictly beats every OTHER node's hidden bound — otherwise serial
+        # might interleave that hidden entry first. Own-node hidden entries are
+        # no constraint: a node's column is consumed strictly in order.
+        first_bad = jnp.min(jnp.where(mono, B, ks), axis=1)    # [N]: B = no break
+        k_hid = jnp.minimum(first_bad, B)
+        has_hidden = (k_hid < avail) & F
+        bound = jnp.where(
+            has_hidden,
+            jnp.take_along_axis(table_ext, k_hid[:, None], axis=1)[:, 0],
+            -jnp.inf,
+        )
+        # top-2 hidden bounds under (score desc, index asc) so each node can
+        # compare against the max over the OTHERS
+        b1 = jnp.max(bound)
+        i1 = jnp.argmax(bound)  # first max = lowest index among score ties
+        bound2 = bound.at[i1].set(-jnp.inf)
+        b2 = jnp.max(bound2)
+        i2 = jnp.argmax(bound2)
+        cut_s = jnp.where(iota_n == i1, b2, b1)                # [N]
+        cut_i = jnp.where(iota_n == i1, i2, i1).astype(jnp.int32)
+        beats = (table > cut_s[:, None]) | (
+            (table == cut_s[:, None]) & (iota_n[:, None] < cut_i[:, None])
+        )
+        usable &= beats
+
+        flat_s = jnp.where(usable, table, -jnp.inf).reshape(-1)
+        flat_idx = jnp.broadcast_to(iota_n[:, None], (N, B)).reshape(-1)
+        exhaust = (ks == (avail[:, None] - 1)) & usable        # entry that empties n
+        flat_ex = exhaust.reshape(-1)
+
+        neg_s_srt, idx_srt, ex_srt = jax.lax.sort(
+            (-flat_s, flat_idx, flat_ex.astype(jnp.int32)), num_keys=2, is_stable=True
+        )
+        pos = jnp.arange(N * B, dtype=jnp.int32)
+        n_finite = jnp.sum(jnp.isfinite(flat_s).astype(jnp.int32))
+        m_rem = (m - placed).astype(jnp.int32)
+        m_cand = jnp.minimum(m_rem, n_finite)
+
+        # exhausted nodes within the candidate range; fine to keep them mid-wave
+        # only when every normalizer value provably survives their removal
+        counts0 = jnp.zeros(N, jnp.int32).at[idx_srt].add((pos < m_cand).astype(jnp.int32))
+        leaves = counts0 >= jnp.maximum(avail, 1)
+        F_end = F & ~leaves
+        norms_end = _wave_norms(st, F_end)
+        same = jnp.array(True)
+        for a, b in zip(norms, norms_end):
+            same &= a == b  # ±inf compare equal to themselves; no NaN can arise
+        p_ex = jnp.min(jnp.where((ex_srt > 0) & (pos < m_cand), pos, N * B))
+        m_take = jnp.where(same, m_cand, jnp.minimum(m_cand, p_ex + 1))
+
+        counts = jnp.zeros(N, jnp.int32).at[idx_srt].add((pos < m_take).astype(jnp.int32))
+
+        # Guaranteed progress: the hidden-continuation guard can mask every
+        # entry (e.g. a rising column whose bound dominates the whole table).
+        # Serial's next pick is always the best HEAD (each node's k=0 entry),
+        # so placing exactly that one pod is unconditionally serial-correct.
+        heads = jnp.where(F, table[:, 0], -jnp.inf)
+        any_head = jnp.any(F)
+        head_pick = jnp.zeros(N, jnp.int32).at[jnp.argmax(heads)].set(1)
+        use_head = (m_take == 0) & any_head & (m_rem > 0)
+        counts = jnp.where(use_head, head_pick, counts)
+        m_take = jnp.where(use_head, jnp.int32(1), m_take)
+        return (j + counts, placed + m_take, m_take)
+
+    def cond(state):
+        _, placed, last_w = state
+        return (last_w > 0) & (placed < m)
+
+    j0 = jnp.zeros(N, jnp.int32)
+    j, placed, _ = jax.lax.while_loop(cond, body, (j0, jnp.int32(0), jnp.int32(1)))
+
+    # aggregate commit (the sum of `placed` serial commit() calls)
+    jf = j.astype(_F32)
+    T = cry.counter.shape[0]
+    Tc = cry.carrier.shape[0]
+    D = cry.counter.shape[1] - 1
+    requested = cry.requested + tb.grp_requests[g][None, :] * jf[:, None]
+    nonzero = cry.nonzero + tb.grp_nonzero[g][None, :] * jf[:, None]
+    cinc = tb.counter_sel_match_g[:, g, None].astype(_F32) * (tb.counter_dom < D) * jf[None, :]
+    counter = cry.counter.at[jnp.arange(T)[:, None], tb.counter_dom].add(cinc)
+    rinc = tb.grp_carries[g][:, None] * (tb.carr_dom < D) * jf[None, :]
+    carrier = cry.carrier.at[jnp.arange(Tc)[:, None], tb.carr_dom].add(rinc)
+    new_cry = Carry(requested, nonzero, cry.port_used, counter, carrier,
+                    cry.dev_used, cry.vg_req, cry.sdev_alloc)
+    return new_cry, j, placed
+
+
 @partial(jax.jit, static_argnames=("n_zones", "enable_gpu", "enable_storage"))
 def schedule_batch(
     tb: Tables, cry: Carry, pod_group, forced_node, valid, n_zones: int,

@@ -52,13 +52,17 @@ from .encode import (
     bucket_capped,
     build_batch_tables,
     carried_specs_of_pod,
-    extract_forced_node,
     pad_batch_tables,
     pad_encoder_axes,
     scheduling_signature,
+    strip_daemon_pin,
 )
 
 _jnp = None  # lazy jax import so host-only paths (ingestion, reports) stay jax-free
+
+# Minimum run length of identical pods worth dispatching as a wave segment;
+# shorter runs ride the serial scan (one compiled dispatch covers many runs).
+WAVE_MIN = 8
 
 
 def _jax():
@@ -148,6 +152,14 @@ class Simulator:
         self.patch_pod_funcs = patch_pod_funcs or []
         self._last_tables: Optional[BatchTables] = None
         self._last_carry = None
+        # Wave scheduling (ops/kernels.py schedule_wave): runs of identical pods
+        # whose only self-interaction is capacity commit in bulk. Settable to
+        # False to force the pure serial scan (used by the parity tests).
+        self.use_waves = True
+        self._wave_elig_cache: Dict[int, Tuple[bool, bool]] = {}
+        # signature → (req_vec, nonzero, port_ids, carrier_ids): identical pods
+        # share all PlacedRecord vectors, so commit bookkeeping is O(1) per pod
+        self._rec_cache: Dict[object, tuple] = {}
 
     # ------------------------------------------------------------- state ----------
 
@@ -161,7 +173,8 @@ class Simulator:
             # Open-Gpu-Share Reserve: assign device ids, write the gpu-index pod
             # annotation + simon/node-gpu-share node annotation, adjust whole-GPU
             # allocatable (open-gpu-share.go:147-188).
-            self.gpu_host.reserve(pod, node_i)
+            if self.gpu_host.enabled:
+                self.gpu_host.reserve(pod, node_i)
             # Open-Local Bind: VG requested / device allocation writeback
             # (open-local.go:215-250).
             if self.local_host.enabled:
@@ -169,16 +182,24 @@ class Simulator:
         elif self.gpu_host.enabled:
             # pre-bound pod with an existing gpu-index (live snapshot): account it
             self.gpu_host.seed_pod(pod, node_i)
+        vecs = self._rec_cache.get(sig)
+        if vecs is None:
+            vecs = self._rec_cache[sig] = (
+                self.axis.pod_vector(pod).astype(np.float32),
+                pod_nonzero_cpu_mem(pod).astype(np.float32),
+                self.encoder.port_ids(pod_host_ports(pod)),
+                [self.encoder.carrier_id(cs) for cs in carried_specs_of_pod(pod)],
+            )
         rec = PlacedRecord(
             pod=pod,
             node_i=node_i,
             sig=sig,
             labels=labels_of(pod),
             namespace=namespace_of(pod),
-            req_vec=self.axis.pod_vector(pod).astype(np.float32),
-            nonzero=pod_nonzero_cpu_mem(pod).astype(np.float32),
-            port_ids=self.encoder.port_ids(pod_host_ports(pod)),
-            carrier_ids=[self.encoder.carrier_id(cs) for cs in carried_specs_of_pod(pod)],
+            req_vec=vecs[0],
+            nonzero=vecs[1],
+            port_ids=vecs[2],
+            carrier_ids=vecs[3],
         )
         pod.pop(SIG_MEMO_KEY, None)  # internal marker; keep result objects clean
         self.placed.append(rec)
@@ -235,8 +256,23 @@ class Simulator:
         the bench/graft harnesses and the parallel (mesh-sharded) path."""
         batch: List[Tuple[int, int]] = []
         for pod in to_schedule:
-            stripped, forced = extract_forced_node(pod, self.na)
-            batch.append((self.encoder.group_of(stripped), forced))
+            stripped, target = strip_daemon_pin(pod)
+            if target is None:
+                forced, enc_pod = -1, pod
+                if SIG_MEMO_KEY not in pod:
+                    # memoize so _commit_pod (and repeated encodes) never
+                    # recompute; pinned pods keep per-pod signatures below
+                    pod[SIG_MEMO_KEY] = scheduling_signature(pod)
+            elif target in self.na.index:
+                forced, enc_pod = self.na.index[target], stripped
+            else:
+                # pin to a node this simulator doesn't know: the memo (stamped
+                # from the UNPINNED template) must not merge this pod into the
+                # unconstrained group — its required matchFields affinity is
+                # unsatisfiable and the pinned signature keeps it that way
+                forced, enc_pod = -1, pod
+                pod.pop(SIG_MEMO_KEY, None)
+            batch.append((self.encoder.group_of(enc_pod), forced))
         # Pad the scan length to bound compile-cache churn: powers of two up to 2048,
         # then multiples of 2048 (a 10k batch scans 10240 steps, not 16384).
         pad = bucket_capped(len(batch), 2048)
@@ -249,6 +285,77 @@ class Simulator:
         # N+1, N+2... nodes (apply.go:203-259) — bucketed N keeps the XLA compile
         # cache warm across probes. Phantom nodes are infeasible by construction.
         return pad_batch_tables(bt, bucket_capped(self.na.N, 1024))
+
+    def _wave_eligibility(self, gi: int) -> Tuple[bool, bool]:
+        """(eligible, cap1) for group gi — see ops/kernels.py schedule_wave. A
+        group is wave-eligible when its placements cannot change any predicate or
+        score input that it reads itself: no host ports, no gpu/storage state, no
+        topology-spread terms, no SelectorSpread counter (the default spread
+        selector always matches the pod itself), and no affinity term whose
+        selector matches the group's own pods — except hostname-topology required
+        anti-affinity, which is exactly a per-node capacity-1 clamp (cap1)."""
+        got = self._wave_elig_cache.get(gi)
+        if got is not None:
+            return got
+        enc = self.encoder
+        g = enc.group_list[gi]
+        from .encode import HOSTNAME
+
+        tmpl = g.template
+        cap1 = False
+        ok = not (g.ports or g.gpu_mem > 0 or g.lvm_sizes or g.sdev_sizes
+                  or g.spread_dns or g.spread_sa or g.ss_counter >= 0)
+        if ok:
+            for cid in list(g.req_aff) + [c for c, _ in g.pref]:
+                if enc.counter_list[cid].matches_pod(tmpl):
+                    ok = False
+                    break
+        if ok:
+            for cid in g.req_anti:
+                cs = enc.counter_list[cid]
+                if cs.matches_pod(tmpl):
+                    if cs.topo_key != HOSTNAME:
+                        ok = False
+                        break
+                    cap1 = True
+        if ok:
+            for cs in g.carried:
+                if cs.matches_pod(tmpl):
+                    if cs.use == "anti" and cs.topo_key == HOSTNAME:
+                        cap1 = True
+                    else:
+                        ok = False
+                        break
+        got = (ok, cap1)
+        self._wave_elig_cache[gi] = got
+        return got
+
+    def _segments(self, bt: BatchTables, P: int) -> List[tuple]:
+        """Split the batch into maximal runs of one (group, forced) pair; eligible
+        runs of >= WAVE_MIN become ('wave', start, len, g, cap1) segments, the
+        rest coalesce into ('serial', start, len) chunks."""
+        pg = np.asarray(bt.pod_group[:P])
+        fn = np.asarray(bt.forced_node[:P])
+        # vectorized run boundaries: one np.diff pass instead of a per-pod loop
+        change = np.flatnonzero((np.diff(pg) != 0) | (np.diff(fn) != 0)) + 1
+        starts = np.concatenate([[0], change])
+        ends = np.concatenate([change, [P]])
+        segs: List[tuple] = []
+        ser_start: Optional[int] = None
+        for i, j in zip(starts.tolist(), ends.tolist()):
+            g, f = int(pg[i]), int(fn[i])
+            run = j - i
+            elig, cap1 = self._wave_eligibility(g) if f < 0 else (False, False)
+            if elig and run >= WAVE_MIN:
+                if ser_start is not None:
+                    segs.append(("serial", ser_start, i - ser_start))
+                    ser_start = None
+                segs.append(("wave", i, run, g, cap1))
+            elif ser_start is None:
+                ser_start = i
+        if ser_start is not None:
+            segs.append(("serial", ser_start, P - ser_start))
+        return segs
 
     def _schedule_run(self, to_schedule: List[dict]) -> List[UnscheduledPod]:
         failed: List[UnscheduledPod] = []
@@ -265,17 +372,38 @@ class Simulator:
         tables, carry = self._to_device(bt)
         enable_gpu, enable_storage = plugin_flags(bt)
         self._last_flags = (enable_gpu, enable_storage)
-        final_carry, choices = kernels.schedule_batch(
-            tables,
-            carry,
-            _jax().asarray(bt.pod_group),
-            _jax().asarray(bt.forced_node),
-            _jax().asarray(bt.valid),
-            n_zones=bt.n_zones,
-            enable_gpu=enable_gpu,
-            enable_storage=enable_storage,
-        )
-        choices = np.asarray(choices)
+        jnp = _jax()
+        P = len(to_schedule)
+        choices = np.full(P, -1, np.int64)
+        segs = self._segments(bt, P) if self.use_waves else [("serial", 0, P)]
+        for seg in segs:
+            if seg[0] == "serial":
+                _, start, length = seg
+                pad = bucket_capped(length, 2048)
+                pg = np.zeros(pad, np.int32)
+                pg[:length] = bt.pod_group[start:start + length]
+                fn = np.full(pad, -1, np.int32)
+                fn[:length] = bt.forced_node[start:start + length]
+                vd = np.zeros(pad, bool)
+                vd[:length] = True
+                carry, ch = kernels.schedule_batch(
+                    tables, carry, jnp.asarray(pg), jnp.asarray(fn), jnp.asarray(vd),
+                    n_zones=bt.n_zones, enable_gpu=enable_gpu,
+                    enable_storage=enable_storage,
+                )
+                choices[start:start + length] = np.asarray(ch)[:length]
+            else:
+                _, start, length, g, cap1 = seg
+                carry, counts, placed = kernels.schedule_wave(
+                    tables, carry, jnp.int32(g), jnp.int32(length), jnp.asarray(cap1)
+                )
+                counts = np.asarray(counts)
+                placed = int(placed)
+                # pods of one group are interchangeable: assign in node order;
+                # the (length - placed) unschedulable pods stay -1 at the tail
+                assign = np.repeat(np.arange(counts.shape[0]), counts)
+                choices[start:start + placed] = assign[:placed]
+        final_carry = carry
         self._last_tables, self._last_carry = bt, final_carry
 
         reason_cache: Dict[Tuple[int, int], Dict[str, int]] = {}

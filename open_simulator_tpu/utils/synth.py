@@ -96,14 +96,39 @@ def synth_cluster(
     hard_predicates: bool = False,
 ) -> Tuple[List[dict], List[dict]]:
     """(nodes, pods). With hard_predicates, adds zones, a tainted slice of nodes,
-    tolerating pods, and zone topology-spread — BASELINE.md's stress shape."""
-    if hard_predicates:
-        nodes = [synth_node(i, n_zones=8, taint_every=10) for i in range(n_nodes)]
-        pods = [
-            synth_pod(i, tolerate=(i % 3 == 0), spread_zone=True)
-            for i in range(n_pods)
-        ]
-    else:
+    and block-structured workloads (contiguous replica runs, the shape real apps
+    produce) cycling plain / tolerating / self-anti-affinity / zone-spread pods —
+    BASELINE.md's stress shape."""
+    if not hard_predicates:
         nodes = [synth_node(i) for i in range(n_nodes)]
         pods = [synth_pod(i) for i in range(n_pods)]
+        return nodes, pods
+
+    nodes = [synth_node(i, n_zones=8, taint_every=10) for i in range(n_nodes)]
+    pods: List[dict] = []
+    block = max(1, n_pods // 50)
+    k = 0
+    while len(pods) < n_pods:
+        n = min(block, n_pods - len(pods))
+        kind = k % 5
+        app = f"synth-{k}"
+        for i in range(n):
+            idx = len(pods)
+            if kind == 1:
+                pods.append(synth_pod(idx, labels={"app": app}, tolerate=True))
+            elif kind == 3:
+                # self anti-affinity: at most one replica per node
+                cap = min(n, max(1, n_nodes // 2))
+                if i < cap:
+                    pods.append(
+                        synth_pod(idx, labels={"app": app}, anti_affinity_on=app)
+                    )
+                else:
+                    pods.append(synth_pod(idx, labels={"app": app}))
+            elif kind == 4:
+                # zone topology spread (serial path: spread state is stateful)
+                pods.append(synth_pod(idx, spread_zone=True))
+            else:
+                pods.append(synth_pod(idx, labels={"app": app}))
+        k += 1
     return nodes, pods

@@ -20,16 +20,17 @@ from open_simulator_tpu.models.fakenode import new_fake_nodes
 from fixtures import make_node, make_pod
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CONFIG = os.path.join(REPO, "examples", "simon-config.yaml")
+CONFIG = os.path.join(REPO, "examples", "simon-smoke-config.yaml")
+DEMO1_CONFIG = os.path.join(REPO, "examples", "simon-config.yaml")
 
 
 def test_parse_simon_config():
     cfg = parse_simon_config(CONFIG)
     assert cfg.api_version == "simon/v1alpha1"
     assert cfg.kind == "Config"
-    assert cfg.spec.cluster.custom_cluster == "examples/cluster/demo"
+    assert cfg.spec.cluster.custom_cluster == "examples/smoke/cluster"
     assert [a.name for a in cfg.spec.app_list] == ["simple"]
-    assert cfg.spec.new_node == "examples/newnode"
+    assert cfg.spec.new_node == "examples/smoke/newnode"
 
 
 def test_validate_config_xor(tmp_path):

@@ -38,7 +38,7 @@ def test_cli_apply_runs_example(tmp_path, monkeypatch):
     monkeypatch.chdir(REPO)
     out = tmp_path / "report.txt"
     rc = cli_main([
-        "apply", "-f", "examples/simon-config.yaml", "--output-file", str(out),
+        "apply", "-f", "examples/simon-smoke-config.yaml", "--output-file", str(out),
         "--use-greed",
     ])
     assert rc == 0

@@ -229,11 +229,14 @@ class TestWorkloadExpansion:
             validate_pod({"metadata": {"name": "ok"}, "spec": {"containers": []}})
 
     def test_fake_nodes(self):
-        nodes = W.new_fake_nodes(make_node("template"), 3)
+        from open_simulator_tpu.models.fakenode import new_fake_nodes
+
+        nodes = new_fake_nodes(make_node("template"), 3)
         assert len(nodes) == 3
         for n in nodes:
             assert n["metadata"]["name"].startswith("simon-")
-            assert O.labels_of(n)[C.LabelNewNode] == "true"
+            # marker label value is "" like NewFakeNode (utils.go:903-915)
+            assert C.LabelNewNode in O.labels_of(n)
             assert O.labels_of(n)[C.LabelHostname] == n["metadata"]["name"]
         assert len({n["metadata"]["name"] for n in nodes}) == 3
 

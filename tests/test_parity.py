@@ -1,0 +1,113 @@
+"""Placement parity: the demo_1 scenario against committed goldens.
+
+The reference's demo_1 example is its primary end-to-end scenario (cluster +
+simple/complicate/open_local/more_pods apps + newnode; see
+/root/reference/example/simon-config.yaml). The in-repo examples/ tree is a
+distilled, scheduling-equivalent replica (tools/make_examples.py) verified to
+produce identical placements to the mounted originals. This suite locks the
+scenario's full placement census as a golden file and exercises the parity
+tool that BASELINE.md's >=99% match-rate metric is measured with.
+"""
+
+import copy
+import json
+import os
+
+import pytest
+
+from open_simulator_tpu.core.types import AppResource
+from open_simulator_tpu.models.fakenode import new_fake_nodes
+from open_simulator_tpu.parity import load_dump, match_rate, placement_dump, save_dump
+from open_simulator_tpu.simulator.core import simulate
+from open_simulator_tpu.utils.yamlio import (
+    load_cluster_from_directory,
+    load_resources_from_directory,
+    match_and_set_local_storage_annotation,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden", "demo1_placements.json")
+
+APPS = [("simple", "simple"), ("complicated", "complicate"),
+        ("open_local", "open_local"), ("more_pods", "more_pods")]
+
+
+def demo1_inputs():
+    cluster = load_cluster_from_directory(os.path.join(REPO, "examples/cluster/demo_1"))
+    nn_dir = os.path.join(REPO, "examples/newnode/demo_1")
+    nn = load_resources_from_directory(nn_dir)
+    match_and_set_local_storage_annotation(nn.nodes, nn_dir)
+    # 18 new nodes = the minimal count the capacity planner lands on for this
+    # scenario (asserted by the applier path); seeded names keep runs comparable
+    cluster.nodes += new_fake_nodes(nn.nodes[0], 18, seed=42)
+    apps = [
+        AppResource(name=name, resource=load_resources_from_directory(
+            os.path.join(REPO, "examples/application", path)))
+        for name, path in APPS
+    ]
+    return cluster, apps
+
+
+@pytest.fixture(scope="module")
+def demo1_dump():
+    cluster, apps = demo1_inputs()
+    return placement_dump(simulate(cluster, apps))
+
+
+def test_demo1_matches_golden(demo1_dump):
+    golden = load_dump(GOLDEN)
+    rate, detail = match_rate(demo1_dump, golden)
+    assert rate == 1.0, f"disagreements: {dict(list(detail.items())[:10])}"
+    assert demo1_dump["new_nodes"] == golden["new_nodes"] == 18
+    assert demo1_dump["new_node_profiles"] == golden["new_node_profiles"]
+    assert demo1_dump["unscheduled"] == {}
+
+
+def test_demo1_pod_totals(demo1_dump):
+    assert sum(demo1_dump["placements"].values()) == 322
+
+
+def test_demo1_wave_vs_serial_parity():
+    # the wave scheduler and the pure serial scan must produce the same census
+    # on the full demo scenario end-to-end
+    from open_simulator_tpu.simulator import engine as eng
+
+    cluster, apps = demo1_inputs()
+    serial_dump = {}
+    orig_init = eng.Simulator.__init__
+
+    def patched(self, *a, **kw):
+        orig_init(self, *a, **kw)
+        self.use_waves = False
+
+    eng.Simulator.__init__ = patched
+    try:
+        serial = placement_dump(simulate(cluster, apps))
+    finally:
+        eng.Simulator.__init__ = orig_init
+    cluster, apps = demo1_inputs()
+    wave = placement_dump(simulate(cluster, apps))
+    rate, detail = match_rate(wave, serial)
+    assert rate == 1.0, f"disagreements: {dict(list(detail.items())[:10])}"
+
+
+def test_match_rate_detects_disagreement():
+    a = {"placements": {"ns/Deployment/web|n1": 3, "ns/Deployment/web|n2": 1}}
+    b = {"placements": {"ns/Deployment/web|n1": 2, "ns/Deployment/web|n2": 2}}
+    rate, detail = match_rate(a, b)
+    assert rate == pytest.approx(3 / 4)
+    assert set(detail) == {"ns/Deployment/web|n1", "ns/Deployment/web|n2"}
+
+
+def test_parity_cli(tmp_path):
+    from open_simulator_tpu.cli.main import main as cli_main
+
+    golden = load_dump(GOLDEN)
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    save_dump(golden, str(a))
+    worse = copy.deepcopy(golden)
+    k = next(iter(worse["placements"]))
+    worse["placements"][k] += 50
+    save_dump(worse, str(b))
+    assert cli_main(["parity", str(a), str(a)]) == 0
+    assert cli_main(["parity", str(a), str(b), "--threshold", "0.999"]) == 1

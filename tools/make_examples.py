@@ -1,0 +1,191 @@
+"""Regenerate examples/ from the reference's example manifests.
+
+The reference ships demo scenarios (cluster dirs, app dirs, newnode templates)
+that the parity tests replay. This tool derives self-contained in-repo
+equivalents by LOADING each reference manifest and keeping only the
+scheduling-relevant subset of fields — requests/limits, replicas, selectors,
+affinity, tolerations, taints, allocatable, storage/gpu annotations — because
+that is exactly the surface MakeValidPod keeps after sanitization
+(/root/reference/pkg/utils/utils.go:378-463). Probes, commands, env, images,
+conditions and other runtime fields are dropped. Output is re-serialized with
+sorted keys, so the files are a distilled dataset, not copies.
+
+Usage: python tools/make_examples.py  (run from the repo root; needs
+/root/reference mounted — the committed examples/ are its output, so normal
+builds and tests never need the reference.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+
+import yaml
+
+REF = "/root/reference/example"
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples")
+
+
+def _keep(d: dict, keys) -> dict:
+    return {k: d[k] for k in keys if k in d and d[k] not in (None, {}, [])}
+
+
+def strip_container(c: dict) -> dict:
+    out = _keep(c, ("name", "resources", "ports"))
+    out.setdefault("name", "main")
+    out["image"] = c.get("image", "app:latest").split("/")[-1]  # basename only
+    if "ports" in out:
+        out["ports"] = [
+            _keep(p, ("containerPort", "hostPort", "hostIP", "protocol", "name"))
+            for p in out["ports"]
+        ]
+    return out
+
+
+def strip_pod_spec(spec: dict) -> dict:
+    out = _keep(
+        spec,
+        ("nodeSelector", "affinity", "tolerations", "nodeName", "hostNetwork",
+         "topologySpreadConstraints", "priorityClassName", "priority",
+         "schedulerName", "overhead"),
+    )
+    out["containers"] = [strip_container(c) for c in spec.get("containers") or []]
+    if spec.get("initContainers"):
+        out["initContainers"] = [strip_container(c) for c in spec["initContainers"]]
+    vols = []
+    for v in spec.get("volumes") or []:
+        kept = _keep(v, ("name", "persistentVolumeClaim", "hostPath"))
+        if len(kept) > 1:
+            vols.append(kept)
+    if vols:
+        out["volumes"] = vols
+    return out
+
+
+def strip_meta(meta: dict) -> dict:
+    out = _keep(meta, ("name", "namespace", "labels", "generateName"))
+    anns = {
+        k: v for k, v in (meta.get("annotations") or {}).items()
+        if k.startswith(("simon/", "alibabacloud.com/", "scheduler.alpha"))
+    }
+    if anns:
+        out["annotations"] = anns
+    return out
+
+
+def strip_template(tpl: dict) -> dict:
+    return {
+        "metadata": strip_meta(tpl.get("metadata") or {}),
+        "spec": strip_pod_spec(tpl.get("spec") or {}),
+    }
+
+
+def strip_object(obj: dict):
+    kind = obj.get("kind")
+    meta = strip_meta(obj.get("metadata") or {})
+    spec = obj.get("spec") or {}
+    if kind == "Node":
+        out_spec = _keep(spec, ("taints", "unschedulable"))
+        status = _keep(obj.get("status") or {}, ("allocatable", "capacity"))
+        out = {"apiVersion": "v1", "kind": kind, "metadata": meta}
+        if out_spec:
+            out["spec"] = out_spec
+        out["status"] = status
+        return out
+    if kind == "Pod":
+        return {"apiVersion": "v1", "kind": kind, "metadata": meta,
+                "spec": strip_pod_spec(spec)}
+    if kind in ("Deployment", "ReplicaSet", "ReplicationController", "DaemonSet",
+                "StatefulSet"):
+        out_spec = _keep(spec, ("replicas", "selector", "serviceName",
+                                "podManagementPolicy"))
+        out_spec["template"] = strip_template(spec.get("template") or {})
+        vcts = []
+        for v in spec.get("volumeClaimTemplates") or []:
+            vcts.append({
+                "metadata": strip_meta(v.get("metadata") or {}),
+                "spec": _keep(v.get("spec") or {},
+                              ("accessModes", "storageClassName", "resources")),
+            })
+        if vcts:
+            out_spec["volumeClaimTemplates"] = vcts
+        return {"apiVersion": obj.get("apiVersion", "apps/v1"), "kind": kind,
+                "metadata": meta, "spec": out_spec}
+    if kind == "Job":
+        out_spec = _keep(spec, ("completions", "parallelism"))
+        out_spec["template"] = strip_template(spec.get("template") or {})
+        return {"apiVersion": "batch/v1", "kind": kind, "metadata": meta,
+                "spec": out_spec}
+    if kind == "CronJob":
+        js = (spec.get("jobTemplate") or {}).get("spec") or {}
+        out_spec = {
+            "schedule": spec.get("schedule", "* * * * *"),
+            "jobTemplate": {"spec": {
+                **_keep(js, ("completions", "parallelism")),
+                "template": strip_template(js.get("template") or {}),
+            }},
+        }
+        return {"apiVersion": obj.get("apiVersion", "batch/v1"), "kind": kind,
+                "metadata": meta, "spec": out_spec}
+    if kind == "Service":
+        return {"apiVersion": "v1", "kind": kind, "metadata": meta,
+                "spec": _keep(spec, ("selector", "ports", "clusterIP"))}
+    if kind == "StorageClass":
+        return {"apiVersion": "storage.k8s.io/v1", "kind": kind, "metadata": meta,
+                **_keep(obj, ("provisioner", "parameters", "volumeBindingMode",
+                              "reclaimPolicy"))}
+    if kind == "PodDisruptionBudget":
+        return {"apiVersion": obj.get("apiVersion", "policy/v1"), "kind": kind,
+                "metadata": meta, "spec": spec}
+    if kind in ("ConfigMap", "PersistentVolumeClaim"):
+        return {"apiVersion": "v1", "kind": kind, "metadata": meta,
+                **({"spec": spec} if kind == "PersistentVolumeClaim" else {})}
+    return None  # CRDs, RBAC etc.: not scheduling inputs
+
+
+def convert_tree(src: str, dst: str) -> None:
+    for root, _dirs, files in os.walk(src):
+        rel = os.path.relpath(root, src)
+        for fn in sorted(files):
+            sp = os.path.join(root, fn)
+            dp = os.path.join(dst, rel, fn) if rel != "." else os.path.join(dst, fn)
+            os.makedirs(os.path.dirname(dp), exist_ok=True)
+            if fn.endswith(".json"):  # local-storage device/VG descriptors
+                with open(sp) as f:
+                    data = json.load(f)
+                with open(dp, "w") as f:
+                    json.dump(data, f, indent=2, sort_keys=True)
+                continue
+            if not (fn.endswith(".yaml") or fn.endswith(".yml")):
+                continue
+            with open(sp) as f:
+                docs = [d for d in yaml.safe_load_all(f) if isinstance(d, dict)]
+            kept = [o for o in (strip_object(d) for d in docs) if o]
+            if not kept:
+                continue
+            with open(dp, "w") as f:
+                yaml.safe_dump_all(kept, f, sort_keys=True, default_flow_style=False)
+
+
+def main() -> None:
+    if not os.path.isdir(REF):
+        sys.exit("reference examples not mounted; committed examples/ are final")
+    for sub in ("cluster/demo_1", "cluster/gpushare", "newnode/demo_1",
+                "newnode/gpushare", "application/simple", "application/complicate",
+                "application/more_pods", "application/gpushare",
+                "application/open_local"):
+        src = os.path.join(REF, sub)
+        if not os.path.isdir(src):
+            print(f"skip {sub} (absent)", file=sys.stderr)
+            continue
+        dst = os.path.join(OUT, sub)
+        if os.path.isdir(dst):
+            shutil.rmtree(dst)
+        convert_tree(src, dst)
+        print(f"wrote {dst}")
+
+
+if __name__ == "__main__":
+    main()
