@@ -132,6 +132,24 @@ def _flr(x):
     return jnp.floor(x)
 
 
+def least_balanced(used_c, used_m, a_c, a_m):
+    """NodeResourcesLeastAllocated (least_allocated.go:93-115, integer divisions
+    floored) + NodeResourcesBalancedAllocation (balanced_allocation.go:96-120)
+    for broadcast-compatible cpu/mem usage and allocatable arrays. The single
+    source of these formulas for scores(), the wave score table, and the fused
+    group-serial scan — their serial-equality proofs require floor-for-floor
+    identical math."""
+    def least_one(u, a):
+        return jnp.where((a > 0) & (u <= a), _flr((a - u) * 100.0 / a), 0.0)
+
+    least = _flr((least_one(used_c, a_c) + least_one(used_m, a_m)) / 2.0)
+    cf = jnp.where(a_c > 0, used_c / a_c, 1.0)
+    mf = jnp.where(a_m > 0, used_m / a_m, 1.0)
+    balanced = jnp.where((cf >= 1.0) | (mf >= 1.0), 0.0,
+                         _flr((1.0 - jnp.abs(cf - mf)) * 100.0))
+    return least, balanced
+
+
 def storage_alloc(tb: Tables, cry: Carry, g):
     """Simulate Open-Local allocation of group g's volumes on EVERY node at once.
 
@@ -239,12 +257,16 @@ def storage_alloc(tb: Tables, cry: Carry, g):
 def feasibility(
     tb: Tables, cry: Carry, g, forced, valid,
     enable_gpu: bool = True, enable_storage: bool = True,
+    include_dns: bool = True,
 ) -> Tuple[jax.Array, dict]:
     """[N] feasibility mask for one pod, plus named per-stage masks for diagnostics.
 
     `enable_gpu`/`enable_storage` are STATIC: when a batch contains no gpu/storage
     demands the whole plugin subgraph is excluded at trace time (the inert tensor
-    math would otherwise cost ~35% of each scan step)."""
+    math would otherwise cost ~35% of each scan step). `include_dns=False` (also
+    static) drops the PodTopologySpread DoNotSchedule filter — used by the live-
+    spread wave path, which re-evaluates that filter against its own running
+    counters each wave iteration (schedule_wave dns_live)."""
     N = tb.alloc.shape[0]
     D = cry.counter.shape[1] - 1
 
@@ -289,16 +311,19 @@ def feasibility(
     blocked_ex = jnp.any((carr_at > 0) & relevant[:, None], axis=0)
 
     # PodTopologySpread DoNotSchedule (filtering.go Filter)
-    dns_ids = tb.dns_t[g]
-    dvalid = dns_ids >= 0
-    dids = jnp.maximum(dns_ids, 0)
-    edom = tb.dns_edom[g]                                                  # [Sd, D+1]
-    cdom = cry.counter[dids]
-    min_cnt = jnp.min(jnp.where(edom, cdom, jnp.inf), axis=1)
-    min_cnt = jnp.where(jnp.isfinite(min_cnt), min_cnt, 0.0)
-    skew = cnt_at[dids] + tb.dns_self[g][:, None] - min_cnt[:, None]
-    dns_ok_each = key_present[dids] & (skew <= tb.dns_maxskew[g][:, None])
-    dns_ok = jnp.all(dns_ok_each | ~dvalid[:, None], axis=0)
+    if include_dns:
+        dns_ids = tb.dns_t[g]
+        dvalid = dns_ids >= 0
+        dids = jnp.maximum(dns_ids, 0)
+        edom = tb.dns_edom[g]                                              # [Sd, D+1]
+        cdom = cry.counter[dids]
+        min_cnt = jnp.min(jnp.where(edom, cdom, jnp.inf), axis=1)
+        min_cnt = jnp.where(jnp.isfinite(min_cnt), min_cnt, 0.0)
+        skew = cnt_at[dids] + tb.dns_self[g][:, None] - min_cnt[:, None]
+        dns_ok_each = key_present[dids] & (skew <= tb.dns_maxskew[g][:, None])
+        dns_ok = jnp.all(dns_ok_each | ~dvalid[:, None], axis=0)
+    else:
+        dns_ok = jnp.ones(N, bool)
 
     # Open-Gpu-Share Filter (open-gpu-share.go:51-81): node total memory must cover
     # the per-GPU request AND the devices must fit all requested units. A device can
@@ -359,17 +384,7 @@ def scores(
     F = feasible
     alloc_cm = tb.alloc[:, (CPU_I, MEM_I)]
     used = cry.nonzero + tb.grp_nonzero[g][None, :]
-
-    # NodeResourcesLeastAllocated (least_allocated.go:93-115), integer divisions floored
-    def least_one(u, a):
-        return jnp.where((a > 0) & (u <= a), _flr((a - u) * 100.0 / a), 0.0)
-
-    least = _flr((least_one(used[:, 0], alloc_cm[:, 0]) + least_one(used[:, 1], alloc_cm[:, 1])) / 2.0)
-
-    # NodeResourcesBalancedAllocation (balanced_allocation.go:96-120)
-    cf = jnp.where(alloc_cm[:, 0] > 0, used[:, 0] / alloc_cm[:, 0], 1.0)
-    mf = jnp.where(alloc_cm[:, 1] > 0, used[:, 1] / alloc_cm[:, 1], 1.0)
-    balanced = jnp.where((cf >= 1.0) | (mf >= 1.0), 0.0, _flr((1.0 - jnp.abs(cf - mf)) * 100.0))
+    least, balanced = least_balanced(used[:, 0], used[:, 1], alloc_cm[:, 0], alloc_cm[:, 1])
 
     # Simon max-share + min-max normalize (plugin/simon.go:45-101)
     simon_s = _flr(100.0 * tb.simon_raw[g])
@@ -556,7 +571,9 @@ def _step(tb: Tables, cry: Carry, xs, n_zones: int, enable_gpu: bool, enable_sto
 
 
 # Module-level jit so repeated diagnostic calls hit the compile cache.
-feasibility_jit = jax.jit(feasibility, static_argnames=("enable_gpu", "enable_storage"))
+feasibility_jit = jax.jit(
+    feasibility, static_argnames=("enable_gpu", "enable_storage", "include_dns")
+)
 
 
 # ------------------------------------------------------------------ wave kernel -------
@@ -643,16 +660,8 @@ def _wave_score_table(tb: Tables, cry: Carry, st: dict, norms, g, j):
     copies = j.astype(_F32)[:, None, None] + jnp.arange(1, B + 1, dtype=_F32)[None, :, None]
     alloc_cm = tb.alloc[:, (CPU_I, MEM_I)]                            # [N, 2]
     used = cry.nonzero[:, None, :] + tb.grp_nonzero[g][None, None, :] * copies  # [N,B,2]
-
-    def least_one(u, a):
-        return jnp.where((a > 0) & (u <= a), _flr((a - u) * 100.0 / a), 0.0)
-
-    a_c = alloc_cm[:, None, 0]
-    a_m = alloc_cm[:, None, 1]
-    least = _flr((least_one(used[:, :, 0], a_c) + least_one(used[:, :, 1], a_m)) / 2.0)
-    cf = jnp.where(a_c > 0, used[:, :, 0] / a_c, 1.0)
-    mf = jnp.where(a_m > 0, used[:, :, 1] / a_m, 1.0)
-    balanced = jnp.where((cf >= 1.0) | (mf >= 1.0), 0.0, _flr((1.0 - jnp.abs(cf - mf)) * 100.0))
+    least, balanced = least_balanced(
+        used[:, :, 0], used[:, :, 1], alloc_cm[:, None, 0], alloc_cm[:, None, 1])
 
     rng = simon_hi - simon_lo
     simon = jnp.where((rng > 0) & jnp.isfinite(rng),
@@ -677,22 +686,91 @@ def _wave_capacity(tb: Tables, cry: Carry, g, cap1):
     return jnp.where(cap1, jnp.minimum(cap, 1), cap)
 
 
-@jax.jit
-def schedule_wave(tb: Tables, cry: Carry, g, m, cap1):
+def _wave_gpu_params(tb: Tables, g):
+    gmem = tb.grp_gpu_mem[g]
+    gnum = jnp.maximum(tb.grp_gpu_num[g], 1.0)
+    safe_mem = jnp.maximum(gmem, 1.0)
+    return gmem, gnum, safe_mem
+
+
+def _gpu_capacity(tb: Tables, cry: Carry, g, capacity):
+    """Clamp per-node copy capacity by GPU units. Depletion is exactly
+    unit-countable: every copy consumes `num` device-units and floor(idle/mem)
+    per device is invariant under any single-unit take, so capacity is the
+    closed form floor(total_units / num)."""
+    gmem, gnum, safe_mem = _wave_gpu_params(tb, g)
+    gidle0 = tb.dev_total - cry.dev_used
+    gunits0 = jnp.maximum(
+        jnp.where(tb.dev_total > 0, jnp.floor(gidle0 / safe_mem), 0.0), 0.0)
+    gpu_cap = jnp.floor(jnp.sum(gunits0, axis=1) / gnum).astype(jnp.int32)
+    return jnp.where(gmem > 0, jnp.minimum(capacity, gpu_cap), capacity)
+
+
+def _aggregate_commit(tb: Tables, cry: Carry, g, j, gpu_live: bool) -> Carry:
+    """The sum of `sum(j)` serial commit() calls for group g (j = per-node
+    placement counts). With gpu_live, replays commit()'s per-copy device
+    allocation (tightest-fit / in-order greedy, gpunodeinfo.go:232-290) one
+    copy per step for every node in parallel, so the carry's per-device ledger
+    matches the serial path bit for bit (j is small: bounded by GPU units)."""
+    jf = j.astype(_F32)
+    T = cry.counter.shape[0]
+    Tc = cry.carrier.shape[0]
+    D = cry.counter.shape[1] - 1
+    requested = cry.requested + tb.grp_requests[g][None, :] * jf[:, None]
+    nonzero = cry.nonzero + tb.grp_nonzero[g][None, :] * jf[:, None]
+    cinc = tb.counter_sel_match_g[:, g, None].astype(_F32) * (tb.counter_dom < D) * jf[None, :]
+    counter = cry.counter.at[jnp.arange(T)[:, None], tb.counter_dom].add(cinc)
+    rinc = tb.grp_carries[g][:, None] * (tb.carr_dom < D) * jf[None, :]
+    carrier = cry.carrier.at[jnp.arange(Tc)[:, None], tb.carr_dom].add(rinc)
+    dev_used = cry.dev_used
+    if gpu_live:
+        gmem, gnum, safe_mem = _wave_gpu_params(tb, g)
+
+        def gpu_step(state):
+            used, rem = state
+            idle = tb.dev_total - used
+            units = jnp.maximum(
+                jnp.where(tb.dev_total > 0, jnp.floor(idle / safe_mem), 0.0), 0.0)
+            cum = jnp.cumsum(units, axis=1)
+            take_multi = jnp.clip(gnum - (cum - units), 0.0, units)
+            fit_dev = (idle >= gmem) & (tb.dev_total > 0)
+            cand = jnp.argmin(jnp.where(fit_dev, idle, jnp.inf), axis=1)
+            take_one = (jnp.arange(tb.dev_total.shape[1])[None, :] == cand[:, None]).astype(_F32)
+            take = jnp.where(tb.grp_gpu_num[g] == 1, take_one, take_multi)
+            do = (rem > 0).astype(_F32)
+            return used + take * gmem * do[:, None], rem - (rem > 0).astype(rem.dtype)
+
+        dev_used, _ = jax.lax.while_loop(
+            lambda s: jnp.any(s[1] > 0), gpu_step,
+            (dev_used, jnp.where(gmem > 0, j, 0)))
+    return Carry(requested, nonzero, cry.port_used, counter, carrier,
+                 dev_used, cry.vg_req, cry.sdev_alloc)
+
+
+@partial(jax.jit, static_argnames=("gpu_live",))
+def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False):
     """Place up to m pods of wave-eligible group g, exactly reproducing m serial
     _step placements. Returns (new carry, per-node counts [N] i32, placed i32).
 
     cap1: the group carries hostname-topology required anti-affinity matching
     itself, so every node takes at most one pod of this segment (the tensor
-    equivalent of satisfyPodAntiAffinity's self-blocking direction)."""
+    equivalent of satisfyPodAntiAffinity's self-blocking direction).
+
+    gpu_live (static): the group requests shared GPU memory (no pre-assigned
+    gpu-index). Score inputs stay static (the Open-Gpu-Share score is Simon's
+    formula); capacity and the device-ledger commit are exact — see
+    _gpu_capacity and _aggregate_commit."""
     N = tb.alloc.shape[0]
     B = WAVE_BLOCK
     iota_n = jnp.arange(N, dtype=jnp.int32)
     base_feas, _ = feasibility(
-        tb, cry, g, jnp.int32(-1), jnp.asarray(True), enable_gpu=False, enable_storage=False
+        tb, cry, g, jnp.int32(-1), jnp.asarray(True),
+        enable_gpu=gpu_live, enable_storage=False,
     )
     st = _wave_statics(tb, cry, g)
     capacity = jnp.where(base_feas, _wave_capacity(tb, cry, g, cap1), 0)
+    if gpu_live:
+        capacity = _gpu_capacity(tb, cry, g, capacity)
 
     def body(state):
         j, placed, _ = state
@@ -786,21 +864,88 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1):
 
     j0 = jnp.zeros(N, jnp.int32)
     j, placed, _ = jax.lax.while_loop(cond, body, (j0, jnp.int32(0), jnp.int32(1)))
+    return _aggregate_commit(tb, cry, g, j, gpu_live), j, placed
 
-    # aggregate commit (the sum of `placed` serial commit() calls)
-    jf = j.astype(_F32)
-    T = cry.counter.shape[0]
-    Tc = cry.carrier.shape[0]
+
+@jax.jit
+def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1):
+    """Serial scheduling of one group with self-interacting DoNotSchedule
+    topology-spread constraints, as a FUSED scan: exactly the reference's
+    one-pod-per-cycle process (same per-step feasible set and scores as
+    _step/scores()), but each step is specialized to what can actually change
+    within a single-group run — per-node copy counts and the group's own spread
+    counters. Everything else (taints, affinity counters, carriers, normalizer
+    *inputs*, static score vectors) is provably constant and hoisted out, so a
+    step costs a few [N]-wide ops + an [Sd, D+1] reduce instead of the general
+    scan step's [T, N] gathers and [T, D+1] scatters (the reason spread-heavy
+    workloads crawled at ~400 pods/s before this kernel).
+
+    `valid` is a [P] bool mask (padded scan length); returns
+    (new carry, per-node counts [N] i32, placed i32).
+
+    Dropped-constant notes (argmax-invariant, same as _wave_score_table):
+    SelectorSpread (ss_skip => 0 for spread pods), PodTopologySpread score
+    (no ScheduleAnyway terms by eligibility => 100 on F), OpenLocal (0)."""
+    N = tb.alloc.shape[0]
     D = cry.counter.shape[1] - 1
-    requested = cry.requested + tb.grp_requests[g][None, :] * jf[:, None]
-    nonzero = cry.nonzero + tb.grp_nonzero[g][None, :] * jf[:, None]
-    cinc = tb.counter_sel_match_g[:, g, None].astype(_F32) * (tb.counter_dom < D) * jf[None, :]
-    counter = cry.counter.at[jnp.arange(T)[:, None], tb.counter_dom].add(cinc)
-    rinc = tb.grp_carries[g][:, None] * (tb.carr_dom < D) * jf[None, :]
-    carrier = cry.carrier.at[jnp.arange(Tc)[:, None], tb.carr_dom].add(rinc)
-    new_cry = Carry(requested, nonzero, cry.port_used, counter, carrier,
-                    cry.dev_used, cry.vg_req, cry.sdev_alloc)
-    return new_cry, j, placed
+    base_feas, _ = feasibility(
+        tb, cry, g, jnp.int32(-1), jnp.asarray(True),
+        enable_gpu=False, enable_storage=False, include_dns=False,
+    )
+    st = _wave_statics(tb, cry, g)
+    capacity = jnp.where(base_feas, _wave_capacity(tb, cry, g, cap1), 0)
+
+    dids_raw = tb.dns_t[g]                                 # [Sd]
+    dvalid = dids_raw >= 0
+    dids = jnp.maximum(dids_raw, 0)
+    dom_rows = tb.counter_dom[dids]                        # [Sd, N]
+    key_present = dom_rows < D
+    edom = tb.dns_edom[g]                                  # [Sd, D+1]
+    dself = tb.dns_self[g][:, None]
+    dskew = tb.dns_maxskew[g][:, None]
+    dmatch = (tb.counter_sel_match_g[dids, g] & dvalid).astype(_F32)  # [Sd]
+    cnt0 = cry.counter[dids]                               # [Sd, D+1]
+    Sd = dids.shape[0]
+    alloc_cm = tb.alloc[:, (CPU_I, MEM_I)]                 # [N, 2]
+    gnz = tb.grp_nonzero[g]
+
+    def step(state, ok):
+        j, cnt = state
+        # live DoNotSchedule filter, mirroring feasibility() term for term
+        cnt_at = jnp.take_along_axis(cnt, dom_rows, axis=1)           # [Sd, N]
+        min_c = jnp.min(jnp.where(edom, cnt, jnp.inf), axis=1)
+        min_c = jnp.where(jnp.isfinite(min_c), min_c, 0.0)
+        dns_ok_each = key_present & (cnt_at + dself - min_c[:, None] <= dskew)
+        dns_ok = jnp.all(dns_ok_each | ~dvalid[:, None], axis=0)
+        F = base_feas & (capacity - j > 0) & dns_ok
+        any_f = jnp.any(F) & ok
+        # scores: least/balanced move with j; the rest normalize over F. The
+        # candidate pod itself counts toward its own usage (scores() adds
+        # grp_nonzero once), hence j + 1.
+        used = cry.nonzero + gnz[None, :] * (j + 1).astype(_F32)[:, None]  # [N, 2]
+        least, balanced = least_balanced(
+            used[:, 0], used[:, 1], alloc_cm[:, 0], alloc_cm[:, 1])
+        simon_hi, simon_lo, na_max, t_max, ip_max, ip_min = _wave_norms(st, F)
+        rng = simon_hi - simon_lo
+        simon = jnp.where((rng > 0) & jnp.isfinite(rng),
+                          _flr((st["simon_s"] - simon_lo) * 100.0 / rng), 0.0)
+        nodeaff = jnp.where(na_max > 0, _flr(st["na_raw"] * 100.0 / na_max), 0.0)
+        taint = jnp.where(t_max > 0, 100.0 - _flr(st["t_raw"] * 100.0 / t_max), 100.0)
+        ip_rng = ip_max - ip_min
+        interpod = jnp.where(ip_rng > 0,
+                             _flr(100.0 * (st["ip_raw"] - ip_min) / ip_rng), 0.0)
+        score = (W_LEAST * least + W_BALANCED * balanced
+                 + (W_SIMON + W_GPUSHARE) * simon + W_NODEAFF * nodeaff
+                 + W_TAINT * taint + W_INTERPOD * interpod + st["static"])
+        choice = jnp.argmax(jnp.where(F, score, -jnp.inf)).astype(jnp.int32)
+        do = any_f.astype(jnp.int32)
+        j = j.at[choice].add(do)
+        cnt = cnt.at[jnp.arange(Sd), dom_rows[:, choice]].add(dmatch * do)
+        return (j, cnt), do
+
+    (j, _), dos = jax.lax.scan(step, (jnp.zeros(N, jnp.int32), cnt0), valid)
+    placed = jnp.sum(dos)
+    return _aggregate_commit(tb, cry, g, j, False), j, placed
 
 
 @partial(jax.jit, static_argnames=("n_zones", "enable_gpu", "enable_storage"))

@@ -210,6 +210,10 @@ class GpuShareHost:
         ]
         self.max_devs = max((s.gpu_count for s in self.states if s), default=0)
         self._assume_seq = 0
+        # nodes whose annotation/allocatable writeback is pending: the ledger
+        # updates per pod, but the JSON rewrite happens once per node per
+        # schedule_pods call (engine flushes) instead of once per commit
+        self._dirty: set = set()
 
     @property
     def enabled(self) -> bool:
@@ -251,7 +255,7 @@ class GpuShareHost:
         self._assume_seq += 1
         anns[C.AnnoGpuAssumeTime] = str(self._assume_seq)
         state.add_pod(pod)
-        self._refresh_node(state)
+        self._dirty.add(node_i)
         return True
 
     def _refresh_node(self, state: GpuNodeState) -> None:
@@ -271,7 +275,15 @@ class GpuShareHost:
             return
         if pod_gpu_index(pod) and pod_gpu_mem(pod) > 0:
             state.add_pod(pod)
-            self._refresh_node(state)
+            self._dirty.add(node_i)
+
+    def flush(self) -> None:
+        """Write the pending node annotations + whole-GPU allocatable (the
+        writeback half of Reserve, open-gpu-share.go:147-188) for every node
+        touched since the last flush."""
+        for node_i in self._dirty:
+            self._refresh_node(self.states[node_i])
+        self._dirty.clear()
 
     def seed_from_pods(self, pods_on_node: List[List[dict]]) -> None:
         """Account already-bound pods carrying gpu-index annotations."""

@@ -156,7 +156,7 @@ class Simulator:
         # whose only self-interaction is capacity commit in bulk. Settable to
         # False to force the pure serial scan (used by the parity tests).
         self.use_waves = True
-        self._wave_elig_cache: Dict[int, Tuple[bool, bool]] = {}
+        self._wave_elig_cache: Dict[int, Tuple[bool, bool, bool, bool]] = {}
         # signature → (req_vec, nonzero, port_ids, carrier_ids): identical pods
         # share all PlacedRecord vectors, so commit bookkeeping is O(1) per pod
         self._rec_cache: Dict[object, tuple] = {}
@@ -249,6 +249,8 @@ class Simulator:
             else:
                 self._commit_pod(pod, ni, scheduled=False)
         failed.extend(self._schedule_run(run))
+        if self.gpu_host.enabled:
+            self.gpu_host.flush()
         return failed
 
     def encode_batch(self, to_schedule: List[dict]) -> BatchTables:
@@ -286,14 +288,21 @@ class Simulator:
         # cache warm across probes. Phantom nodes are infeasible by construction.
         return pad_batch_tables(bt, bucket_capped(self.na.N, 1024))
 
-    def _wave_eligibility(self, gi: int) -> Tuple[bool, bool]:
-        """(eligible, cap1) for group gi — see ops/kernels.py schedule_wave. A
-        group is wave-eligible when its placements cannot change any predicate or
-        score input that it reads itself: no host ports, no gpu/storage state, no
-        topology-spread terms, no SelectorSpread counter (the default spread
-        selector always matches the pod itself), and no affinity term whose
-        selector matches the group's own pods — except hostname-topology required
-        anti-affinity, which is exactly a per-node capacity-1 clamp (cap1)."""
+    def _wave_eligibility(self, gi: int) -> Tuple[bool, bool, bool, bool]:
+        """(eligible, cap1, spread_live, gpu_live) for group gi — see
+        ops/kernels.py schedule_wave / schedule_group_serial. A group is
+        batch-eligible when its placements cannot change any predicate or score
+        input that it reads itself: no host ports, no storage state, no
+        ScheduleAnyway spread terms (they feed the score), no SelectorSpread
+        counter (the default spread selector always matches the pod itself),
+        and no affinity term whose selector matches the group's own pods —
+        except hostname-topology required anti-affinity, which is exactly a
+        per-node capacity-1 clamp (cap1). Two self-interactions have dedicated
+        kernels: shared-GPU requests (gpu_live → unit-countable wave) and
+        self-matching DoNotSchedule spread terms (spread_live → fused
+        group-serial scan); a group with both stays on the general serial path.
+        Non-self-matching DoNotSchedule terms are static during the run and
+        ride the plain wave."""
         got = self._wave_elig_cache.get(gi)
         if got is not None:
             return got
@@ -303,8 +312,14 @@ class Simulator:
 
         tmpl = g.template
         cap1 = False
-        ok = not (g.ports or g.gpu_mem > 0 or g.lvm_sizes or g.sdev_sizes
-                  or g.spread_dns or g.spread_sa or g.ss_counter >= 0)
+        spread_live = any(selfm for _, _, selfm in g.spread_dns)
+        # shared-GPU groups are unit-countable (kernels.schedule_wave gpu_live)
+        # unless they carry a pre-assigned gpu-index (host-driven path → serial)
+        gpu_live = g.gpu_mem > 0 and g.gpu_pre_ids is None
+        ok = not (g.ports or (g.gpu_mem > 0 and not gpu_live)
+                  or (gpu_live and spread_live)
+                  or g.lvm_sizes or g.sdev_sizes
+                  or g.spread_sa or g.ss_counter >= 0)
         if ok:
             for cid in list(g.req_aff) + [c for c, _ in g.pref]:
                 if enc.counter_list[cid].matches_pod(tmpl):
@@ -326,14 +341,15 @@ class Simulator:
                     else:
                         ok = False
                         break
-        got = (ok, cap1)
+        got = (ok, cap1, ok and spread_live, ok and gpu_live)
         self._wave_elig_cache[gi] = got
         return got
 
     def _segments(self, bt: BatchTables, P: int) -> List[tuple]:
         """Split the batch into maximal runs of one (group, forced) pair; eligible
-        runs of >= WAVE_MIN become ('wave', start, len, g, cap1) segments, the
-        rest coalesce into ('serial', start, len) chunks."""
+        runs of >= WAVE_MIN become ('wave', start, len, g, cap1, gpu_live) or
+        ('spread', start, len, g, cap1) segments, the rest coalesce into
+        ('serial', start, len) chunks."""
         pg = np.asarray(bt.pod_group[:P])
         fn = np.asarray(bt.forced_node[:P])
         # vectorized run boundaries: one np.diff pass instead of a per-pod loop
@@ -345,12 +361,16 @@ class Simulator:
         for i, j in zip(starts.tolist(), ends.tolist()):
             g, f = int(pg[i]), int(fn[i])
             run = j - i
-            elig, cap1 = self._wave_eligibility(g) if f < 0 else (False, False)
+            elig, cap1, spread_live, gpu_live = (
+                self._wave_eligibility(g) if f < 0 else (False, False, False, False))
             if elig and run >= WAVE_MIN:
                 if ser_start is not None:
                     segs.append(("serial", ser_start, i - ser_start))
                     ser_start = None
-                segs.append(("wave", i, run, g, cap1))
+                if spread_live:
+                    segs.append(("spread", i, run, g, cap1))
+                else:
+                    segs.append(("wave", i, run, g, cap1, gpu_live))
             elif ser_start is None:
                 ser_start = i
         if ser_start is not None:
@@ -392,11 +412,21 @@ class Simulator:
                     enable_storage=enable_storage,
                 )
                 choices[start:start + length] = np.asarray(ch)[:length]
-            else:
+            elif seg[0] == "spread":
                 _, start, length, g, cap1 = seg
-                carry, counts, placed = kernels.schedule_wave(
-                    tables, carry, jnp.int32(g), jnp.int32(length), jnp.asarray(cap1)
+                pad = bucket_capped(length, 2048)
+                vd = np.zeros(pad, bool)
+                vd[:length] = True
+                carry, counts, placed = kernels.schedule_group_serial(
+                    tables, carry, jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1)
                 )
+            else:
+                _, start, length, g, cap1, gpu_live = seg
+                carry, counts, placed = kernels.schedule_wave(
+                    tables, carry, jnp.int32(g), jnp.int32(length),
+                    jnp.asarray(cap1), gpu_live=gpu_live,
+                )
+            if seg[0] != "serial":
                 counts = np.asarray(counts)
                 placed = int(placed)
                 # pods of one group are interchangeable: assign in node order;

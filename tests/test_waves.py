@@ -29,12 +29,14 @@ def census_of(sim: Simulator):
     return out
 
 
-def run_both(nodes, batches):
+def run_both(nodes, batches, extract=None):
     """batches: list of pod lists scheduled via consecutive schedule_pods calls.
-    Returns (wave_census, serial_census, wave_failed, serial_failed)."""
+    Returns (wave_census, serial_census, wave_failed, serial_failed) plus, when
+    `extract` is given, its per-sim result appended for each path."""
     results = []
     for waves in (True, False):
         sim = Simulator(copy.deepcopy(nodes))
+        sim.use_waves = waves
         failed = []
         for batch in batches:
             failed.extend(sim.schedule_pods(copy.deepcopy(batch)))
@@ -42,9 +44,11 @@ def run_both(nodes, batches):
         for up in failed:
             key = labels_of(up.pod).get("app") or name_of(up.pod)
             fail_count[key] = fail_count.get(key, 0) + 1
-        results.append((census_of(sim), fail_count))
-    (wc, wf), (sc, sf) = results
-    return wc, sc, wf, sf
+        results.append((census_of(sim), fail_count, extract(sim) if extract else None))
+    (wc, wf, wx), (sc, sf, sx) = results
+    if extract is None:
+        return wc, sc, wf, sf
+    return wc, sc, wf, sf, wx, sx
 
 
 def replicas(name, n, start=0, **kw):
@@ -215,3 +219,221 @@ def test_wave_segments_split():
     assert kinds == ["wave", "serial"]
     assert segs[0][1:3] == (0, 10)
     assert segs[1][1:3] == (10, 2)
+
+
+# ---------------------------------------------------------------- spread waves ----
+#
+# DoNotSchedule topology-spread groups are wave-eligible via the kernel's live
+# filter + inertness cut (schedule_wave dns_live). Every scenario below runs the
+# same pods through waves-on and waves-off engines; censuses must match exactly,
+# including when the constraint binds hard, when domains are blocked from the
+# start, and when the min-domain count rises mid-run.
+
+
+def spread(app, key="zone", max_skew=1):
+    return [{
+        "maxSkew": max_skew,
+        "topologyKey": key,
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": app}},
+    }]
+
+
+def zoned_nodes(counts, **kw):
+    """counts: pods-per-zone node counts, e.g. [4, 2, 1] builds 7 nodes in 3 zones."""
+    nodes = []
+    for z, c in enumerate(counts):
+        for i in range(c):
+            nodes.append(make_node(f"z{z}-n{i}", labels={"zone": f"zone-{z}"}, **kw))
+    return nodes
+
+
+def spread_replicas(app, n, max_skew=1, key="zone", start=0, **kw):
+    pods = replicas(app, n, start=start, **kw)
+    for p in pods:
+        p["spec"]["topologySpreadConstraints"] = spread(app, key=key, max_skew=max_skew)
+    return pods
+
+
+def test_spread_wave_balanced_zones():
+    nodes = zoned_nodes([3, 3, 3])
+    pods = spread_replicas("web", 60, cpu="100m", memory="128Mi")
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf == {}
+
+
+def test_spread_wave_skewed_zones_constraint_binds():
+    # zone-2 has one node: once it fills, skew blocks the big zones — the wave
+    # must cut exactly where serial's feasible set changes
+    nodes = zoned_nodes([6, 3, 1], cpu="4", memory="8Gi")
+    pods = spread_replicas("skew", 80, max_skew=1, cpu="200m", memory="256Mi")
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+    assert sum(wc.values()) < 80  # the single-node zone caps total placements
+
+
+def test_spread_wave_blocked_at_start_then_min_rise():
+    # seed zone-0 far above the others: zone-0 starts blocked and is re-admitted
+    # only when the min rises (the (b) cut direction)
+    nodes = zoned_nodes([2, 2, 2], cpu="16", memory="32Gi")
+    seed = [make_pod(f"seed-{i}", labels={"app": "riser"}, node_name="z0-n0",
+                     cpu="100m", memory="128Mi") for i in range(5)]
+    for p in seed:
+        p["spec"]["topologySpreadConstraints"] = spread("riser")
+    pods = spread_replicas("riser", 40, max_skew=2, cpu="100m", memory="128Mi")
+    wc, sc, wf, sf = run_both(nodes, [seed, pods])
+    assert wc == sc and wf == sf
+
+
+def test_spread_wave_maxskew_1_tight():
+    nodes = zoned_nodes([1, 1, 1, 1], cpu="16", memory="32Gi")
+    pods = spread_replicas("tight", 37, max_skew=1, cpu="50m", memory="64Mi")
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf == {}
+
+
+def test_spread_wave_missing_topo_key_nodes():
+    # two nodes lack the zone label entirely: they are never eligible domains and
+    # the spread filter must keep excluding them on both paths
+    nodes = zoned_nodes([2, 2]) + [make_node(f"plain{i}") for i in range(2)]
+    pods = spread_replicas("keyed", 30, cpu="100m", memory="128Mi")
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+    landed_plain = {k for k in wc if k[0] >= 4}
+    assert not landed_plain
+
+
+def test_spread_wave_hostname_key():
+    # hostname-keyed spread: every node is its own domain, so maxSkew=1 caps the
+    # per-node difference at one — a much larger domain count than zones
+    nodes = [make_node(f"n{i}") for i in range(7)]
+    pods = spread_replicas("host", 40, key="kubernetes.io/hostname",
+                           cpu="100m", memory="128Mi")
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+
+
+def test_spread_wave_non_self_matching_static():
+    # the constraint tracks a DIFFERENT app: counters never move during the run,
+    # so the group rides the plain (dns-static) wave path
+    nodes = zoned_nodes([2, 2])
+    anchors = [make_pod("anchor-0", labels={"app": "anchor"}, node_name="z0-n0"),
+               make_pod("anchor-1", labels={"app": "anchor"}, node_name="z1-n0")]
+    pods = replicas("obs", 20, cpu="100m", memory="128Mi")
+    for p in pods:
+        p["spec"]["topologySpreadConstraints"] = spread("anchor", max_skew=3)
+    wc, sc, wf, sf = run_both(nodes, [anchors, pods])
+    assert wc == sc and wf == sf
+
+
+def test_spread_wave_two_constraints():
+    # zone + hostname constraints together on one group
+    nodes = zoned_nodes([3, 2], cpu="8", memory="16Gi")
+    pods = spread_replicas("dual", 25, max_skew=2, cpu="100m", memory="128Mi")
+    for p in pods:
+        p["spec"]["topologySpreadConstraints"] += spread(
+            "dual", key="kubernetes.io/hostname", max_skew=2)
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+
+
+def test_spread_wave_segments_are_waves():
+    # the segmentation classifies a self-matching dns group as a spread segment
+    nodes = zoned_nodes([2, 2])
+    sim = Simulator(copy.deepcopy(nodes))
+    pods = spread_replicas("seg", 12, cpu="100m", memory="128Mi")
+    bt = sim.encode_batch(copy.deepcopy(pods))
+    segs = sim._segments(bt, len(pods))
+    assert [s[0] for s in segs] == ["spread"]
+
+
+# ------------------------------------------------------------------- gpu waves ----
+#
+# Shared-GPU groups (no pre-assigned gpu-index) are wave-eligible: depletion is
+# unit-countable so capacity is closed-form, and the aggregate commit replays
+# the per-node allocator exactly (schedule_wave gpu_live). Censuses, failure
+# counts, AND the per-device ledgers must match the serial path.
+
+GI = 1 << 30
+
+
+def wave_gpu_node(name, count=2, total_mem=32 * GI, cpu="64", memory="256Gi"):
+    caps = {"alibabacloud.com/gpu-count": str(count),
+            "alibabacloud.com/gpu-mem": str(total_mem)}
+    return make_node(name, cpu=cpu, memory=memory, extra_resources=caps)
+
+
+def wave_gpu_replicas(app, n, mem_gi=4, count=1, **kw):
+    pods = replicas(app, n, cpu="500m", memory="1Gi", **kw)
+    for p in pods:
+        p["metadata"]["annotations"] = {
+            "alibabacloud.com/gpu-mem": f"{mem_gi}Gi",
+            "alibabacloud.com/gpu-count": str(count),
+        }
+    return pods
+
+
+def run_both_gpu(nodes, batches):
+    """run_both + per-device ledger comparison."""
+    return run_both(nodes, batches, extract=lambda sim: [
+        tuple(s.dev_used) if s else None for s in sim.gpu_host.states
+    ])
+
+
+def test_gpu_wave_single_gpu_binpack():
+    nodes = [wave_gpu_node(f"g{i}", count=4, total_mem=64 * GI) for i in range(6)]
+    pods = wave_gpu_replicas("trainer", 50, mem_gi=4)
+    wc, sc, wf, sf, wl, sl = run_both_gpu(nodes, [pods])
+    assert wc == sc and wf == sf == {}
+    assert wl == sl
+
+
+def test_gpu_wave_exhaustion_and_ledger():
+    # 2 devices x 8Gi per node, 3Gi pods: 2 units per device with 2Gi stranded —
+    # the floor() unit math and the tightest-fit replay both matter here
+    nodes = [wave_gpu_node(f"g{i}", count=2, total_mem=16 * GI, cpu="128",
+                           memory="512Gi") for i in range(4)]
+    pods = wave_gpu_replicas("tight", 30, mem_gi=3)
+    wc, sc, wf, sf, wl, sl = run_both_gpu(nodes, [pods])
+    assert wc == sc and wf == sf
+    assert wf.get("tight", 0) > 0  # 4 nodes * 4 units = 16 < 30
+    assert wl == sl
+
+
+def test_gpu_wave_multi_gpu_greedy():
+    nodes = [wave_gpu_node(f"g{i}", count=4, total_mem=32 * GI) for i in range(3)]
+    pods = wave_gpu_replicas("dual", 16, mem_gi=4, count=2)
+    wc, sc, wf, sf, wl, sl = run_both_gpu(nodes, [pods])
+    assert wc == sc and wf == sf
+    assert wl == sl
+
+
+def test_gpu_wave_mixed_with_plain_pods():
+    nodes = [wave_gpu_node(f"g{i}", count=2, total_mem=16 * GI, cpu="8",
+                           memory="16Gi") for i in range(5)]
+    a = wave_gpu_replicas("gp", 12, mem_gi=2)
+    b = replicas("plain", 20, cpu="250m", memory="512Mi")
+    wc, sc, wf, sf, wl, sl = run_both_gpu(nodes, [a + b])
+    assert wc == sc and wf == sf
+    assert wl == sl
+
+
+def test_gpu_wave_preassigned_index_stays_serial():
+    nodes = [wave_gpu_node(f"g{i}") for i in range(3)]
+    sim = Simulator(copy.deepcopy(nodes))
+    pods = wave_gpu_replicas("pre", 10)
+    for p in pods:
+        p["metadata"]["annotations"]["alibabacloud.com/gpu-index"] = "1"
+    bt = sim.encode_batch(copy.deepcopy(pods))
+    segs = sim._segments(bt, len(pods))
+    assert [s[0] for s in segs] == ["serial"]
+
+
+def test_gpu_wave_segments_are_waves():
+    nodes = [wave_gpu_node(f"g{i}") for i in range(3)]
+    sim = Simulator(copy.deepcopy(nodes))
+    pods = wave_gpu_replicas("seg", 10)
+    bt = sim.encode_batch(copy.deepcopy(pods))
+    segs = sim._segments(bt, len(pods))
+    assert [s[0] for s in segs] == ["wave"]
+    assert segs[0][5] is True  # gpu_live
