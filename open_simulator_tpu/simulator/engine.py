@@ -396,6 +396,12 @@ class Simulator:
         P = len(to_schedule)
         choices = np.full(P, -1, np.int64)
         segs = self._segments(bt, P) if self.use_waves else [("serial", 0, P)]
+        # Dispatch every segment asynchronously and fetch ONE concatenated
+        # result at the end: the chip may sit behind a tunnel, so a per-segment
+        # np.asarray costs a full round trip — 50 segments used to spend ~7s
+        # waiting on ~35ms of actual device work. `placed` is recovered on the
+        # host as sum(counts), never fetched separately.
+        outs: List[tuple] = []  # (seg, device array: serial choices | counts)
         for seg in segs:
             if seg[0] == "serial":
                 _, start, length = seg
@@ -411,29 +417,40 @@ class Simulator:
                     n_zones=bt.n_zones, enable_gpu=enable_gpu,
                     enable_storage=enable_storage,
                 )
-                choices[start:start + length] = np.asarray(ch)[:length]
+                outs.append((seg, ch))
             elif seg[0] == "spread":
                 _, start, length, g, cap1 = seg
                 pad = bucket_capped(length, 2048)
                 vd = np.zeros(pad, bool)
                 vd[:length] = True
-                carry, counts, placed = kernels.schedule_group_serial(
+                carry, counts, _ = kernels.schedule_group_serial(
                     tables, carry, jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1)
                 )
+                outs.append((seg, counts))
             else:
                 _, start, length, g, cap1, gpu_live = seg
-                carry, counts, placed = kernels.schedule_wave(
+                carry, counts, _ = kernels.schedule_wave(
                     tables, carry, jnp.int32(g), jnp.int32(length),
                     jnp.asarray(cap1), gpu_live=gpu_live,
                 )
-            if seg[0] != "serial":
-                counts = np.asarray(counts)
-                placed = int(placed)
-                # pods of one group are interchangeable: assign in node order;
-                # the (length - placed) unschedulable pods stay -1 at the tail
-                assign = np.repeat(np.arange(counts.shape[0]), counts)
-                choices[start:start + placed] = assign[:placed]
+                outs.append((seg, counts))
         final_carry = carry
+        if outs:
+            flat = np.asarray(jnp.concatenate([a.astype(jnp.int32) for _, a in outs]))
+            off = 0
+            for seg, a in outs:
+                part = flat[off:off + a.shape[0]]
+                off += a.shape[0]
+                start, length = seg[1], seg[2]
+                if seg[0] == "serial":
+                    choices[start:start + length] = part[:length]
+                else:
+                    counts = part
+                    placed = int(counts.sum())
+                    # pods of one group are interchangeable: assign in node
+                    # order; the (length - placed) unschedulable pods stay -1
+                    assign = np.repeat(np.arange(counts.shape[0]), counts)
+                    choices[start:start + placed] = assign[:placed]
         self._last_tables, self._last_carry = bt, final_carry
 
         reason_cache: Dict[Tuple[int, int], Dict[str, int]] = {}
