@@ -83,35 +83,32 @@ def bench_gpushare(n_nodes=1_000, n_pods=5_000, repeats=2):
 
 
 def bench_capacity_plan(n_pods=100_000, repeats=1):
-    """Config 5: add-node auto search — from a 64-node base, double the simon
-    node count until all pods fit within a 60% MaxCPU envelope, timing the whole
-    search (each probe is one full simulation, as in apply.go:203-259)."""
+    """Config 5: add-node auto search — find the minimal simon-node count that
+    schedules all pods within a 60% MaxCPU envelope, timing the whole search.
+
+    Uses the applier's CapacityPlanner: the workload is expanded and encoded
+    once, the search starts at the arithmetic lower bound (below which
+    scheduling provably fails), and each candidate is one non-mutating device
+    probe — versus the reference's loop of full re-simulations per candidate
+    (apply.go:203-259). The planner's answer is exactly minimal, not the
+    doubling-granularity answer the old loop produced."""
     import os
 
-    from open_simulator_tpu.apply.applier import satisfy_resource_setting
-    from open_simulator_tpu.models.fakenode import new_fake_nodes
-    from open_simulator_tpu.simulator.engine import Simulator
+    from open_simulator_tpu.apply.applier import CapacityPlanner
     from open_simulator_tpu.utils.synth import synth_node, synth_pod
 
     os.environ["MaxCPU"] = "60"
     try:
         base_nodes = [synth_node(i) for i in range(64)]
         template = synth_node(0)
+        pods = [synth_pod(i) for i in range(n_pods)]
         best = None
         for _ in range(repeats + 1):
             t0 = time.perf_counter()
-            n, result_nodes = 64, None
-            while n <= 4_096:
-                trial = base_nodes + new_fake_nodes(template, n)
-                sim = Simulator(trial)
-                pods = [synth_pod(i) for i in range(n_pods)]
-                failed = sim.schedule_pods(pods)
-                ok, _ = satisfy_resource_setting(sim.get_cluster_node_status())
-                if not failed and ok:
-                    result_nodes = n
-                    break
-                n *= 2
+            planner = CapacityPlanner(base_nodes, template, pods)
+            found, n, _ = planner.search()
             dt = time.perf_counter() - t0
+            result_nodes = n if found else None
             if best is None or dt < best[0]:
                 best = (dt, result_nodes)
         dt, added = best

@@ -38,6 +38,201 @@ from ..utils.yamlio import (
 MAX_AUTO_NODES = 10_000  # auto-search upper bound before giving up
 
 
+class CapacityPlanner:
+    """Fast add-node search: expand the workload ONCE, probe candidate node
+    counts with non-mutating device runs (Simulator.probe_pods), and start from
+    an arithmetic lower bound below which scheduling provably fails.
+
+    The reference's loop re-simulates the whole workload per candidate
+    (apply.go:203-259); here a probe skips pod regeneration, placement
+    materialization, and failure diagnosis — the expensive host work — and the
+    authoritative full simulation runs only at the chosen answer (the Applier
+    re-validates it and falls back to the full-simulation search on any
+    divergence).
+
+    Only built when the probe is provably equivalent: no DaemonSets (their pod
+    sets depend on the candidate node list), no open-local storage (the
+    envelope check would need VG accounting), and no pre-bound pod AFTER an
+    unbound one (probe_pods commits all bound pods first, which could steal
+    capacity an earlier unbound pod would have taken in the serial order).
+    `try_build` returns None otherwise and the Applier keeps the original
+    loop."""
+
+    def __init__(self, base_nodes: List[dict], new_node: dict, pods: List[dict],
+                 cluster_objects: Optional[ResourceTypes] = None,
+                 app_objects: Optional[List[ResourceTypes]] = None) -> None:
+        self.base_nodes = base_nodes
+        self.new_node = new_node
+        self.pods = pods
+        self.cluster_objects = cluster_objects
+        self.app_objects = app_objects or []
+
+    @classmethod
+    def try_build(cls, cluster: ResourceTypes, apps: List[AppResource],
+                  new_node: Optional[dict], patch_funcs) -> Optional["CapacityPlanner"]:
+        from ..models.workloads import expand_workloads_excluding_daemonsets
+        from ..algo.queues import sort_affinity, sort_toleration
+
+        if new_node is None:
+            return None
+        if cluster.daemon_sets or any(a.resource.daemon_sets for a in apps):
+            return None
+        nodes = cluster.nodes + [new_node]
+        if any(annotations_of(n).get(C.AnnoNodeLocalStorage) for n in nodes):
+            return None
+        cluster2 = cluster.copy()
+        pods = expand_workloads_excluding_daemonsets(cluster2)
+        for app in apps:
+            from ..models.workloads import generate_valid_pods_from_app
+
+            app_pods = generate_valid_pods_from_app(app.name, app.resource, cluster.nodes)
+            app_pods = sort_toleration(sort_affinity(app_pods))
+            for patch in patch_funcs or []:
+                patch(app_pods)
+            pods.extend(app_pods)
+        seen_unbound = False
+        for p in pods:
+            if (p.get("spec") or {}).get("nodeName"):
+                if seen_unbound:
+                    return None  # bound-after-unbound: probe order-inequivalent
+            else:
+                seen_unbound = True
+        return cls(cluster.nodes, new_node, pods,
+                   cluster_objects=cluster, app_objects=[a.resource for a in apps])
+
+    # ------------------------------------------------------------ arithmetic ----
+
+    def _totals(self):
+        """Request totals over the pods the simulation will actually account:
+        pods bound to unknown nodes are dropped from every report (the engine's
+        homeless handling), so they must not inflate the lower bound either."""
+        known = {name_of(n) for n in self.base_nodes}
+        cpu_used = mem_used = 0.0
+        n_pods = 0
+        for p in self.pods:
+            nn = (p.get("spec") or {}).get("nodeName")
+            if nn and nn not in known:
+                continue
+            req = pod_resource_requests(p)
+            cpu_used += req.get("cpu", 0.0)
+            mem_used += req.get("memory", 0.0)
+            n_pods += 1
+        return cpu_used, mem_used, n_pods
+
+    @staticmethod
+    def _node_caps(node: dict):
+        alloc = (node.get("status") or {}).get("allocatable") or {}
+        return (parse_milli(alloc.get("cpu", 0)), parse_quantity(alloc.get("memory", 0)),
+                parse_quantity(alloc.get("pods", 0)))
+
+    @staticmethod
+    def _env_pct(name: str) -> int:
+        """Lenient variant of satisfy_resource_setting's env parse: probes never
+        raise — an unparsable env falls to 100 and the authoritative run (which
+        keeps the reference's ConfigError) reports it."""
+        s = os.environ.get(name, "")
+        try:
+            v = int(s) if s else 100
+        except ValueError:
+            return 100
+        return v if 0 <= v <= 100 else 100
+
+    @classmethod
+    def _envelope_ok(cls, cpu_used, cpu_alloc, mem_used, mem_alloc) -> bool:
+        """satisfy_resource_setting's integer occupancy-rate check
+        (apply.go:689-775) on aggregate totals — the single copy the probe and
+        the lower bound both use."""
+        cpu_rate = int(cpu_used / cpu_alloc * 100) if cpu_alloc else 0
+        mem_rate = int(mem_used / mem_alloc * 100) if mem_alloc else 0
+        return (cpu_rate <= cls._env_pct(C.EnvMaxCPU)
+                and mem_rate <= cls._env_pct(C.EnvMaxMemory))
+
+    def lower_bound(self) -> int:
+        """Smallest n passing the NECESSARY conditions: per-resource totals fit
+        AND the MaxCPU/MaxMemory integer-rate envelope of
+        satisfy_resource_setting holds. Any n below provably fails, so the
+        probe search starts here. Monotone in n -> binary search, no device."""
+        cpu_used, mem_used, n_pods = self._totals()
+        base = [self._node_caps(n) for n in self.base_nodes]
+        b_cpu = sum(c for c, _, _ in base)
+        b_mem = sum(m for _, m, _ in base)
+        b_pods = sum(p for _, _, p in base)
+        n_cpu, n_mem, n_podcap = self._node_caps(self.new_node)
+
+        def necessary_ok(n: int) -> bool:
+            cpu_a = b_cpu + n * n_cpu
+            mem_a = b_mem + n * n_mem
+            pods_a = b_pods + n * n_podcap
+            if cpu_used > cpu_a or mem_used > mem_a or n_pods > pods_a:
+                return False
+            return self._envelope_ok(cpu_used, cpu_a, mem_used, mem_a)
+
+        if necessary_ok(0):
+            return 0
+        lo, hi = 0, MAX_AUTO_NODES + 1
+        while lo + 1 < hi:
+            mid = (lo + hi) // 2
+            if necessary_ok(mid):
+                hi = mid
+            else:
+                lo = mid
+        return hi
+
+    # --------------------------------------------------------------- probing ----
+
+    def probe(self, n: int):
+        """(all_ok, n_failed) for base + n new nodes, via one non-mutating
+        device run plus the envelope check on the resulting carry totals."""
+        from ..simulator.engine import Simulator
+
+        trial = self.base_nodes + new_fake_nodes(self.new_node, n)
+        sim = Simulator(trial)
+        if self.cluster_objects is not None:
+            sim.register_cluster_objects(self.cluster_objects)
+        for rt in self.app_objects:
+            sim.register_app_objects(rt)
+        scheduled, total = sim.probe_pods(self.pods)
+        n_failed = total - scheduled
+        if n_failed:
+            return False, n_failed
+        u = sim.probe_utilization()
+        ok = self._envelope_ok(u["cpu_used"], u["cpu_alloc"],
+                               u["mem_used"], u["mem_alloc"])
+        return ok, 0
+
+    def search(self):
+        """(found, best_n, history) — doubling from the lower bound, then
+        binary refinement, all on probes. history = [(n, n_failed)] for the
+        give-up diagnostics. found=False means no-progress/max-exhausted."""
+        lb = self.lower_bound()
+        if lb == 0:
+            ok, nf = self.probe(0)
+            if ok:
+                return True, 0, []
+            lb = 1
+        hist = []
+        lo, hi = max(0, lb - 1), max(lb, 1)  # everything below lb provably fails
+        while hi <= MAX_AUTO_NODES:
+            ok, nf = self.probe(hi)
+            if ok:
+                break
+            hist.append((hi, nf))
+            # 4x capacity with no progress: remaining pods unfixable by nodes
+            if len(hist) >= 3 and hist[-1][1] >= hist[-3][1] > 0:
+                return False, hi, hist
+            lo, hi = hi, hi * 2
+        else:
+            return False, MAX_AUTO_NODES, hist
+        while lo + 1 < hi:
+            mid = (lo + hi) // 2
+            ok, _ = self.probe(mid)
+            if ok:
+                hi = mid
+            else:
+                lo = mid
+        return True, hi, hist
+
+
 @dataclass
 class Options:
     simon_config: str = ""
@@ -139,14 +334,46 @@ class Applier:
     def _plan(self, cluster, apps, new_node, patch_funcs):
         """Returns (result, nodes_added) or (None, 0) when the user exits / search
         fails. Interactive: the reference's survey loop. Non-interactive: auto-search
-        the minimal node count (doubling + binary search; each probe is one full
-        simulation, as in the reference's re-simulate-per-iteration loop)."""
+        the minimal node count — via CapacityPlanner probes when the workload
+        qualifies (the answer is re-validated by one full simulation; any
+        divergence falls back to the original loop), else the reference-style
+        full-simulation doubling + binary search (apply.go:203-259)."""
         if self.opts.interactive:
             return self._plan_interactive(cluster, apps, new_node, patch_funcs)
 
         def ok(res: SimulateResult) -> bool:
             satisfied, _ = satisfy_resource_setting(res.node_status)
             return not res.unscheduled_pods and satisfied
+
+        planner = CapacityPlanner.try_build(cluster, apps, new_node, patch_funcs)
+        if planner is not None:
+            found, n, hist = planner.search()
+            if found:
+                res = self._simulate_with(cluster, apps, new_node, n, patch_funcs)
+                if ok(res):
+                    return res, n
+                # probe/simulation divergence: fall back to the full search
+            elif hist:
+                # no-progress give-up: one full simulation at the last probe
+                # reproduces the reference-style diagnostics without replaying
+                # the whole search with full simulations
+                res_hi = self._simulate_with(cluster, apps, new_node, n, patch_funcs)
+                if ok(res_hi):
+                    return res_hi, n  # divergence in the passing direction
+                if res_hi.unscheduled_pods:
+                    for up in res_hi.unscheduled_pods:
+                        self._println(f"  {namespace_of(up.pod)}/{name_of(up.pod)}: {up.reason}")
+                    self._println(
+                        f"{len(res_hi.unscheduled_pods)} pod(s) still unschedulable "
+                        f"after adding {n} nodes with no improvement; they cannot "
+                        "be fixed by capacity"
+                    )
+                    return None, 0
+                # probes said unschedulable but the full run disagrees on the
+                # envelope only: fall back to the full search
+            else:
+                self._println(f"gave up after {MAX_AUTO_NODES} added nodes")
+                return None, 0
 
         res = self._simulate_with(cluster, apps, new_node, 0, patch_funcs)
         if ok(res):

@@ -471,6 +471,109 @@ class Simulator:
                 failed.append(UnscheduledPod(pod, self._format_reason(pod, reasons, self.na.N)))
         return failed
 
+    # ------------------------------------------------------------- probing -------
+
+    def probe_pods(self, pods: List[dict]) -> Tuple[int, int]:
+        """Capacity-probe scheduling: how many of `pods` would schedule, without
+        materializing placements. Pre-bound pods commit normally (they are
+        cluster state the probe must account); every unbound pod joins ONE
+        device run whose results are counted but never written back — no pod
+        mutation, no placed records, no failure diagnosis. Pods keep their
+        signature memos, so repeated probes over the same list skip the
+        per-pod encoding cost. Returns (scheduled, total).
+
+        Caveats the caller must own (CapacityPlanner.try_build guards both):
+        pre-bound pods all commit BEFORE the unbound run regardless of list
+        position, and pods bound to unknown nodes are dropped from the totals
+        exactly as schedule_pods drops them from every report (engine.py
+        homeless handling) — they are not schedulable failures.
+
+        The capacity planner's probe loop (apply.go:203-259 re-simulates the
+        whole workload per candidate node count) is the intended caller; the
+        authoritative placement run remains schedule_pods."""
+        run: List[dict] = []
+        scheduled = 0
+        homeless = 0
+        for pod in pods:
+            node_name = (pod.get("spec") or {}).get("nodeName")
+            if not node_name:
+                run.append(pod)
+                continue
+            ni = self.na.index.get(node_name)
+            if ni is None:
+                homeless += 1
+                self.homeless.append(pod)
+            else:
+                self._commit_pod(pod, ni, scheduled=False)
+                scheduled += 1
+        total_known = len(pods) - homeless
+        if not run:
+            return scheduled, total_known
+        if self.na.N == 0:
+            return scheduled, total_known
+        bt = self.encode_batch(run)
+        tables, carry = self._to_device(bt)
+        enable_gpu, enable_storage = plugin_flags(bt)
+        jnp = _jax()
+        P = len(run)
+        segs = self._segments(bt, P) if self.use_waves else [("serial", 0, P)]
+        placed_parts = []
+        for seg in segs:
+            if seg[0] == "serial":
+                _, start, length = seg
+                pad = bucket_capped(length, 2048)
+                pg = np.zeros(pad, np.int32)
+                pg[:length] = bt.pod_group[start:start + length]
+                fn = np.full(pad, -1, np.int32)
+                fn[:length] = bt.forced_node[start:start + length]
+                vd = np.zeros(pad, bool)
+                vd[:length] = True
+                carry, ch = kernels.schedule_batch(
+                    tables, carry, jnp.asarray(pg), jnp.asarray(fn), jnp.asarray(vd),
+                    n_zones=bt.n_zones, enable_gpu=enable_gpu,
+                    enable_storage=enable_storage,
+                )
+                placed_parts.append(jnp.sum((ch >= 0).astype(jnp.int32)))
+            elif seg[0] == "spread":
+                _, start, length, g, cap1 = seg
+                pad = bucket_capped(length, 2048)
+                vd = np.zeros(pad, bool)
+                vd[:length] = True
+                carry, _, placed = kernels.schedule_group_serial(
+                    tables, carry, jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1)
+                )
+                placed_parts.append(placed)
+            else:
+                _, start, length, g, cap1, gpu_live = seg
+                carry, _, placed = kernels.schedule_wave(
+                    tables, carry, jnp.int32(g), jnp.int32(length),
+                    jnp.asarray(cap1), gpu_live=gpu_live,
+                )
+                placed_parts.append(placed)
+        self._last_tables, self._last_carry = bt, carry
+        total = int(np.asarray(jnp.sum(jnp.stack(placed_parts))))  # one fetch
+        return scheduled + total, total_known
+
+    def probe_utilization(self) -> Dict[str, float]:
+        """Aggregate used/allocatable totals after a probe_pods run, read from
+        the device carry in one fetch — the inputs of satisfyResourceSetting
+        (apply.go:689-775) without materializing node statuses. CPU in milli,
+        memory in bytes (the axis units)."""
+        from ..ops.resources import CPU_I, MEM_I
+
+        N = self.na.N
+        if self._last_carry is None:
+            used = np.zeros((N, self.axis.R), np.float64)
+        else:
+            used = np.asarray(self._last_carry.requested)[:N].astype(np.float64)
+        alloc = self.na.alloc
+        return {
+            "cpu_used": float(used[:, CPU_I].sum()),
+            "cpu_alloc": float(alloc[:, CPU_I].sum()),
+            "mem_used": float(used[:, MEM_I].sum()),
+            "mem_alloc": float(alloc[:, MEM_I].sum()),
+        }
+
     def _to_device(self, bt: BatchTables):
         jnp = _jax()
         from ..parallel.mesh import tables_from_batch

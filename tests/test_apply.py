@@ -122,3 +122,138 @@ def test_applier_adds_nodes_when_needed(tmp_path, monkeypatch):
     ok, _ = satisfy_resource_setting(result.node_status)
     assert ok
     assert "added" in out.read_text()
+
+
+# ------------------------------------------------------------ CapacityPlanner ----
+
+
+def _planner_fixture(n_base=2, n_pods=20, cpu="2", memory="2Gi"):
+    from open_simulator_tpu.apply.applier import CapacityPlanner
+
+    base = [make_node(f"base-{i}", cpu="8", memory="16Gi") for i in range(n_base)]
+    template = make_node("tpl", cpu="8", memory="16Gi")
+    pods = [make_pod(f"p-{i}", cpu=cpu, memory=memory) for i in range(n_pods)]
+    return CapacityPlanner(base, template, pods), base, template, pods
+
+
+def test_planner_lower_bound_arithmetic(monkeypatch):
+    """20 pods x 2cpu = 40 cpu; base 2x8=16 -> fit needs ceil(24/8)=3 new nodes.
+    With MaxCPU=50 the envelope needs int(40000/cpu_a*100) <= 50 -> cpu_a > 78431m
+    -> 8 new nodes (16+64=80 cores)."""
+    planner, *_ = _planner_fixture()
+    monkeypatch.delenv("MaxCPU", raising=False)
+    assert planner.lower_bound() == 3
+    monkeypatch.setenv("MaxCPU", "50")
+    assert planner.lower_bound() == 8
+
+
+def test_planner_search_minimal_and_probe_agrees(monkeypatch):
+    monkeypatch.delenv("MaxCPU", raising=False)
+    monkeypatch.delenv("MaxMemory", raising=False)
+    planner, base, template, pods = _planner_fixture()
+    found, n, hist = planner.search()
+    assert found
+    # the answer is minimal: n schedules everything, n-1 does not
+    ok_n, _ = planner.probe(n)
+    assert ok_n
+    if n > 0:
+        ok_prev, _ = planner.probe(n - 1)
+        assert not ok_prev
+    # and matches a full simulation at n
+    from open_simulator_tpu.models.fakenode import new_fake_nodes
+    from open_simulator_tpu.simulator.engine import Simulator
+
+    import copy
+    sim = Simulator(base + new_fake_nodes(template, n))
+    failed = sim.schedule_pods(copy.deepcopy(pods))
+    assert not failed
+
+
+def test_planner_probe_does_not_mutate_pods(monkeypatch):
+    monkeypatch.delenv("MaxCPU", raising=False)
+    planner, _, _, pods = _planner_fixture()
+    planner.probe(4)
+    for p in pods:
+        assert "nodeName" not in p["spec"]
+        assert p.get("status") is None
+
+
+def test_planner_skips_daemonsets():
+    from open_simulator_tpu.apply.applier import CapacityPlanner
+    from open_simulator_tpu.core.types import AppResource, ResourceTypes
+
+    cluster = ResourceTypes()
+    cluster.nodes = [make_node("n0")]
+    app = ResourceTypes()
+    app.daemon_sets = [{"kind": "DaemonSet", "metadata": {"name": "ds"}}]
+    tpl = make_node("tpl")
+    assert CapacityPlanner.try_build(
+        cluster, [AppResource(name="a", resource=app)], tpl, []) is None
+    # without the DS it builds
+    app2 = ResourceTypes()
+    assert CapacityPlanner.try_build(
+        cluster, [AppResource(name="a", resource=app2)], tpl, []) is not None
+
+
+def test_planner_path_matches_full_search(tmp_path, monkeypatch):
+    """The applier's planner fast path and the full-simulation search must agree
+    on the node count and scheduled placements for the demo config."""
+    os.chdir(REPO)
+    monkeypatch.setenv("MaxCPU", "40")
+    import open_simulator_tpu.apply.applier as A
+
+    out1 = tmp_path / "fast.txt"
+    ap1 = Applier(Options(simon_config=CONFIG, output_file=str(out1)))
+    res1 = ap1.run()
+
+    monkeypatch.setattr(A.CapacityPlanner, "try_build",
+                        classmethod(lambda cls, *a, **k: None))
+    out2 = tmp_path / "slow.txt"
+    ap2 = Applier(Options(simon_config=CONFIG, output_file=str(out2)))
+    res2 = ap2.run()
+    assert (res1 is None) == (res2 is None)
+    if res1 is not None:
+        n1 = sum(1 for ns in res1.node_status
+                 if "simon/new-node" in (ns.node["metadata"].get("labels") or {}))
+        n2 = sum(1 for ns in res2.node_status
+                 if "simon/new-node" in (ns.node["metadata"].get("labels") or {}))
+        # the planner returns the exact minimum; the doubling search may only
+        # ever return MORE nodes than necessary
+        assert n1 <= n2
+        placed1 = sum(len(ns.pods) for ns in res1.node_status)
+        placed2 = sum(len(ns.pods) for ns in res2.node_status)
+        assert placed1 == placed2
+
+
+def test_planner_homeless_pods_not_failures(monkeypatch):
+    """Pods bound to unknown nodes are dropped from every report by the engine;
+    probes and the lower bound must not count them as failures or load."""
+    from open_simulator_tpu.apply.applier import CapacityPlanner
+
+    monkeypatch.delenv("MaxCPU", raising=False)
+    base = [make_node("base-0", cpu="8", memory="16Gi")]
+    template = make_node("tpl", cpu="8", memory="16Gi")
+    pods = [make_pod("ghost", cpu="64", memory="64Gi", node_name="no-such-node")]
+    pods += [make_pod(f"p-{i}", cpu="1", memory="1Gi") for i in range(4)]
+    planner = CapacityPlanner(base, template, pods)
+    assert planner.lower_bound() == 0  # the ghost's 64 cpu must not count
+    ok, nf = planner.probe(0)
+    assert ok and nf == 0
+
+
+def test_planner_rejects_bound_after_unbound():
+    from open_simulator_tpu.apply.applier import CapacityPlanner
+    from open_simulator_tpu.core.types import AppResource, ResourceTypes
+
+    cluster = ResourceTypes()
+    cluster.nodes = [make_node("n0")]
+    cluster.pods = [make_pod("pending-first"),
+                    make_pod("bound-later", node_name="n0")]
+    tpl = make_node("tpl")
+    assert CapacityPlanner.try_build(cluster, [], tpl, []) is None
+    # bound-then-pending order is the equivalent one and builds
+    cluster2 = ResourceTypes()
+    cluster2.nodes = [make_node("n0")]
+    cluster2.pods = [make_pod("bound-first", node_name="n0"),
+                     make_pod("pending-later")]
+    assert CapacityPlanner.try_build(cluster2, [], tpl, []) is not None
