@@ -60,16 +60,19 @@ class CapacityPlanner:
 
     def __init__(self, base_nodes: List[dict], new_node: dict, pods: List[dict],
                  cluster_objects: Optional[ResourceTypes] = None,
-                 app_objects: Optional[List[ResourceTypes]] = None) -> None:
+                 app_objects: Optional[List[ResourceTypes]] = None,
+                 sched_config=None) -> None:
         self.base_nodes = base_nodes
         self.new_node = new_node
         self.pods = pods
         self.cluster_objects = cluster_objects
         self.app_objects = app_objects or []
+        self.sched_config = sched_config
 
     @classmethod
     def try_build(cls, cluster: ResourceTypes, apps: List[AppResource],
-                  new_node: Optional[dict], patch_funcs) -> Optional["CapacityPlanner"]:
+                  new_node: Optional[dict], patch_funcs,
+                  sched_config=None) -> Optional["CapacityPlanner"]:
         from ..models.workloads import expand_workloads_excluding_daemonsets
         from ..algo.queues import sort_affinity, sort_toleration
 
@@ -98,7 +101,8 @@ class CapacityPlanner:
             else:
                 seen_unbound = True
         return cls(cluster.nodes, new_node, pods,
-                   cluster_objects=cluster, app_objects=[a.resource for a in apps])
+                   cluster_objects=cluster, app_objects=[a.resource for a in apps],
+                   sched_config=sched_config)
 
     # ------------------------------------------------------------ arithmetic ----
 
@@ -186,7 +190,7 @@ class CapacityPlanner:
         from ..simulator.engine import Simulator
 
         trial = self.base_nodes + new_fake_nodes(self.new_node, n)
-        sim = Simulator(trial)
+        sim = Simulator(trial, sched_config=self.sched_config)
         if self.cluster_objects is not None:
             sim.register_cluster_objects(self.cluster_objects)
         for rt in self.app_objects:
@@ -248,6 +252,15 @@ class Applier:
         self.opts = opts
         self.cfg: SimonConfig = parse_simon_config(opts.simon_config)
         validate_config(self.cfg, opts.default_scheduler_config)
+        # parse --default-scheduler-config for real (GetAndSetSchedulerConfig,
+        # pkg/simulator/utils.go:303-381): plugin enable/disable + score
+        # weights; unsupported fields raise ConfigError here, loudly
+        if opts.default_scheduler_config:
+            from ..api.schedconfig import parse_scheduler_config
+
+            self.sched_config = parse_scheduler_config(opts.default_scheduler_config)
+        else:
+            self.sched_config = None
         self.out: TextIO = sys.stdout
 
     # ------------------------------------------------------------------ inputs ----
@@ -329,7 +342,8 @@ class Applier:
     def _simulate_with(self, cluster, apps, new_node, n, patch_funcs) -> SimulateResult:
         trial = cluster.copy()
         trial.nodes = list(trial.nodes) + new_fake_nodes(new_node, n)
-        return simulate(trial, apps, patch_pod_funcs=patch_funcs)
+        return simulate(trial, apps, patch_pod_funcs=patch_funcs,
+                        sched_config=self.sched_config)
 
     def _plan(self, cluster, apps, new_node, patch_funcs):
         """Returns (result, nodes_added) or (None, 0) when the user exits / search
@@ -345,7 +359,8 @@ class Applier:
             satisfied, _ = satisfy_resource_setting(res.node_status)
             return not res.unscheduled_pods and satisfied
 
-        planner = CapacityPlanner.try_build(cluster, apps, new_node, patch_funcs)
+        planner = CapacityPlanner.try_build(cluster, apps, new_node, patch_funcs,
+                                            sched_config=self.sched_config)
         if planner is not None:
             found, n, hist = planner.search()
             if found:

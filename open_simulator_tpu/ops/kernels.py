@@ -36,24 +36,43 @@ import numpy as np
 
 from .resources import CPU_I, MEM_I
 
-# Score weights (registry.go:118-137; Simon/OpenLocal/GpuShare default to weight 1 via
-# the framework's zero→1 rule for enabled score plugins).
-W_LEAST = 1.0
-W_BALANCED = 1.0
-W_IMAGE = 1.0
-W_INTERPOD = 1.0
-W_NODEAFF = 1.0
-W_AVOID = 10000.0
-W_PTS = 2.0
-W_TAINT = 1.0
-W_SS = 1.0
-W_SIMON = 1.0
-# Open-Gpu-Share's Score (open-gpu-share.go:86-110) is the same max-share formula
-# and min-max normalization as Simon's, and both plugins are always enabled
-# (GetAndSetSchedulerConfig, pkg/simulator/utils.go:321-333) — so its contribution
-# is exactly a second Simon term.
-W_GPUSHARE = 1.0
-W_OPENLOCAL = 1.0
+class ScoreWeights(NamedTuple):
+    """Per-score-plugin weights, default = the v1.20 provider registry
+    (registry.go:118-137; Simon/OpenLocal/GpuShare default to weight 1 via the
+    framework's zero->1 rule for enabled score plugins). Passed as a STATIC jit
+    argument so custom --default-scheduler-config weights fold into the
+    compiled program as constants; a disabled score plugin is weight 0."""
+
+    least: float = 1.0       # NodeResourcesLeastAllocated
+    balanced: float = 1.0    # NodeResourcesBalancedAllocation
+    image: float = 1.0       # ImageLocality
+    interpod: float = 1.0    # InterPodAffinity
+    nodeaff: float = 1.0     # NodeAffinity
+    avoid: float = 10000.0   # NodePreferAvoidPods
+    pts: float = 2.0         # PodTopologySpread
+    taint: float = 1.0       # TaintToleration
+    ss: float = 1.0          # SelectorSpread
+    simon: float = 1.0       # Simon bin-packing
+    # Open-Gpu-Share's Score (open-gpu-share.go:86-110) is the same max-share
+    # formula and min-max normalization as Simon's — its contribution is
+    # exactly a second Simon term with its own weight.
+    gpushare: float = 1.0
+    openlocal: float = 1.0   # Open-Local
+
+
+class FilterFlags(NamedTuple):
+    """Enable flags for the filter plugins evaluated inside the kernel (the
+    statically-folded ones — taints/unschedulable/node-affinity — are disabled
+    at encode time instead; see Encoder.filter_disabled). STATIC jit args."""
+
+    fit: bool = True         # NodeResourcesFit
+    ports: bool = True       # NodePorts
+    interpod: bool = True    # InterPodAffinity
+    spread: bool = True      # PodTopologySpread
+
+
+DEFAULT_WEIGHTS = ScoreWeights()
+DEFAULT_FILTERS = FilterFlags()
 
 _F32 = jnp.float32
 
@@ -257,7 +276,7 @@ def storage_alloc(tb: Tables, cry: Carry, g):
 def feasibility(
     tb: Tables, cry: Carry, g, forced, valid,
     enable_gpu: bool = True, enable_storage: bool = True,
-    include_dns: bool = True,
+    include_dns: bool = True, filters: FilterFlags = DEFAULT_FILTERS,
 ) -> Tuple[jax.Array, dict]:
     """[N] feasibility mask for one pod, plus named per-stage masks for diagnostics.
 
@@ -266,7 +285,8 @@ def feasibility(
     math would otherwise cost ~35% of each scan step). `include_dns=False` (also
     static) drops the PodTopologySpread DoNotSchedule filter — used by the live-
     spread wave path, which re-evaluates that filter against its own running
-    counters each wave iteration (schedule_wave dns_live)."""
+    counters each wave iteration (schedule_group_serial). `filters` (static)
+    carries --default-scheduler-config per-plugin disables."""
     N = tb.alloc.shape[0]
     D = cry.counter.shape[1] - 1
 
@@ -274,14 +294,21 @@ def feasibility(
     smask = tb.static_mask[g]
 
     # NodeResourcesFit (noderesources/fit.go): only requested resources are checked.
-    eps = tb.alloc * 1e-6  # absorb f32 accumulation noise; never enough to overcommit
-    new_req = cry.requested + req[None, :]
-    fit_each = (new_req <= tb.alloc + eps) | (req[None, :] == 0)
-    fit = jnp.all(fit_each, axis=1) & ~tb.grp_unknown[g]
+    if filters.fit:
+        eps = tb.alloc * 1e-6  # absorb f32 noise; never enough to overcommit
+        new_req = cry.requested + req[None, :]
+        fit_each = (new_req <= tb.alloc + eps) | (req[None, :] == 0)
+        fit = jnp.all(fit_each, axis=1) & ~tb.grp_unknown[g]
+    else:
+        fit_each = jnp.ones((N, tb.alloc.shape[1]), bool)
+        fit = jnp.ones(N, bool)
 
     # NodePorts
-    pids = tb.grp_ports[g]
-    conflict = jnp.any(cry.port_used[:, pids] & (pids > 0)[None, :], axis=1)
+    if filters.ports:
+        pids = tb.grp_ports[g]
+        conflict = jnp.any(cry.port_used[:, pids] & (pids > 0)[None, :], axis=1)
+    else:
+        conflict = jnp.zeros(N, bool)
 
     # counter gathers shared by inter-pod affinity and topology spread
     cnt_at = jnp.take_along_axis(cry.counter, tb.counter_dom, axis=1)      # [T, N]
@@ -289,29 +316,34 @@ def feasibility(
     totals = jnp.sum(cry.counter[:, :D], axis=1)                           # [T]
 
     # InterPodAffinity: required affinity (filtering.go satisfyPodAffinity)
-    aff_ids = tb.req_aff_t[g]
-    avalid = aff_ids >= 0
-    aids = jnp.maximum(aff_ids, 0)
-    sat = (key_present[aids] & (cnt_at[aids] > 0)) | ~avalid[:, None]
-    aff_all = jnp.all(sat, axis=0)
-    has_aff = jnp.any(avalid)
-    total_aff = jnp.sum(jnp.where(avalid, totals[aids], 0.0))
-    bootstrap = has_aff & (total_aff == 0.0) & tb.grp_aff_self[g]
-    aff_ok = jnp.where(bootstrap, jnp.ones_like(aff_all), aff_all)
+    if filters.interpod:
+        aff_ids = tb.req_aff_t[g]
+        avalid = aff_ids >= 0
+        aids = jnp.maximum(aff_ids, 0)
+        sat = (key_present[aids] & (cnt_at[aids] > 0)) | ~avalid[:, None]
+        aff_all = jnp.all(sat, axis=0)
+        has_aff = jnp.any(avalid)
+        total_aff = jnp.sum(jnp.where(avalid, totals[aids], 0.0))
+        bootstrap = has_aff & (total_aff == 0.0) & tb.grp_aff_self[g]
+        aff_ok = jnp.where(bootstrap, jnp.ones_like(aff_all), aff_all)
 
-    # incoming required anti-affinity (satisfyPodAntiAffinity)
-    anti_ids = tb.req_anti_t[g]
-    bvalid = anti_ids >= 0
-    bids = jnp.maximum(anti_ids, 0)
-    blocked_in = jnp.any((cnt_at[bids] > 0) & bvalid[:, None], axis=0)
+        # incoming required anti-affinity (satisfyPodAntiAffinity)
+        anti_ids = tb.req_anti_t[g]
+        bvalid = anti_ids >= 0
+        bids = jnp.maximum(anti_ids, 0)
+        blocked_in = jnp.any((cnt_at[bids] > 0) & bvalid[:, None], axis=0)
 
-    # existing pods' required anti-affinity (satisfyExistingPodsAntiAffinity)
-    carr_at = jnp.take_along_axis(cry.carrier, tb.carr_dom, axis=1)        # [Tc, N]
-    relevant = tb.carr_use_anti & tb.carr_sel_match_g[:, g]
-    blocked_ex = jnp.any((carr_at > 0) & relevant[:, None], axis=0)
+        # existing pods' required anti-affinity (satisfyExistingPodsAntiAffinity)
+        carr_at = jnp.take_along_axis(cry.carrier, tb.carr_dom, axis=1)    # [Tc, N]
+        relevant = tb.carr_use_anti & tb.carr_sel_match_g[:, g]
+        blocked_ex = jnp.any((carr_at > 0) & relevant[:, None], axis=0)
+    else:
+        aff_ok = jnp.ones(N, bool)
+        blocked_in = jnp.zeros(N, bool)
+        blocked_ex = jnp.zeros(N, bool)
 
     # PodTopologySpread DoNotSchedule (filtering.go Filter)
-    if include_dns:
+    if include_dns and filters.spread:
         dns_ids = tb.dns_t[g]
         dvalid = dns_ids >= 0
         dids = jnp.maximum(dns_ids, 0)
@@ -378,9 +410,11 @@ def feasibility(
 
 
 def scores(
-    tb: Tables, cry: Carry, g, feasible, n_zones: int, enable_storage: bool = True
+    tb: Tables, cry: Carry, g, feasible, n_zones: int, enable_storage: bool = True,
+    w: ScoreWeights = DEFAULT_WEIGHTS,
 ) -> jax.Array:
-    """Weighted sum of all normalized plugin scores over the feasible set ([N] f32)."""
+    """Weighted sum of all normalized plugin scores over the feasible set ([N] f32).
+    `w` is STATIC (--default-scheduler-config weights fold in as constants)."""
     F = feasible
     alloc_cm = tb.alloc[:, (CPU_I, MEM_I)]
     used = cry.nonzero + tb.grp_nonzero[g][None, :]
@@ -410,8 +444,8 @@ def scores(
     pref_ids = tb.pref_t[g]
     pvalid = pref_ids >= 0
     pidx = jnp.maximum(pref_ids, 0)
-    w = tb.pref_w[g]
-    ip_raw = jnp.sum(jnp.where(pvalid[:, None], w[:, None] * cnt_at[pidx], 0.0), axis=0)
+    pw = tb.pref_w[g]
+    ip_raw = jnp.sum(jnp.where(pvalid[:, None], pw[:, None] * cnt_at[pidx], 0.0), axis=0)
     carr_w = (tb.carr_hard_w + tb.carr_pref_w) * tb.carr_sel_match_g[:, g]
     ip_raw = ip_raw + jnp.sum(carr_w[:, None] * carr_at, axis=0)
     ip_max = jnp.maximum(jnp.max(jnp.where(F, ip_raw, -jnp.inf)), 0.0)
@@ -481,17 +515,17 @@ def scores(
         openlocal = 0.0
 
     total = (
-        W_LEAST * least
-        + W_BALANCED * balanced
-        + W_OPENLOCAL * openlocal
-        + (W_SIMON + W_GPUSHARE) * simon  # Open-Gpu-Share Score ≡ Simon Score
-        + W_NODEAFF * nodeaff
-        + W_TAINT * taint
-        + W_INTERPOD * interpod
-        + W_SS * selector_spread
-        + W_PTS * pts
-        + W_AVOID * tb.avoid_raw[g]
-        + W_IMAGE * tb.image_raw[g]
+        w.least * least
+        + w.balanced * balanced
+        + w.openlocal * openlocal
+        + (w.simon + w.gpushare) * simon  # Open-Gpu-Share Score ≡ Simon Score
+        + w.nodeaff * nodeaff
+        + w.taint * taint
+        + w.interpod * interpod
+        + w.ss * selector_spread
+        + w.pts * pts
+        + w.avoid * tb.avoid_raw[g]
+        + w.image * tb.image_raw[g]
     )
     return total
 
@@ -558,11 +592,13 @@ def commit(
                  vg_req, sdev_alloc)
 
 
-def _step(tb: Tables, cry: Carry, xs, n_zones: int, enable_gpu: bool, enable_storage: bool):
+def _step(tb: Tables, cry: Carry, xs, n_zones: int, enable_gpu: bool, enable_storage: bool,
+          w: ScoreWeights = DEFAULT_WEIGHTS, filters: FilterFlags = DEFAULT_FILTERS):
     g, forced, valid = xs
-    feasible, _ = feasibility(tb, cry, g, forced, valid, enable_gpu, enable_storage)
+    feasible, _ = feasibility(tb, cry, g, forced, valid, enable_gpu, enable_storage,
+                              filters=filters)
     any_f = jnp.any(feasible)
-    sc = scores(tb, cry, g, feasible, n_zones, enable_storage)
+    sc = scores(tb, cry, g, feasible, n_zones, enable_storage, w=w)
     masked = jnp.where(feasible, sc, -jnp.inf)
     choice = jnp.argmax(masked).astype(jnp.int32)  # first max → lowest node index
     choice = jnp.where(any_f, choice, jnp.int32(-1))
@@ -572,7 +608,8 @@ def _step(tb: Tables, cry: Carry, xs, n_zones: int, enable_gpu: bool, enable_sto
 
 # Module-level jit so repeated diagnostic calls hit the compile cache.
 feasibility_jit = jax.jit(
-    feasibility, static_argnames=("enable_gpu", "enable_storage", "include_dns")
+    feasibility,
+    static_argnames=("enable_gpu", "enable_storage", "include_dns", "filters"),
 )
 
 
@@ -617,7 +654,7 @@ feasibility_jit = jax.jit(
 WAVE_BLOCK = 64  # B: score-table depth = max copies per node per wave iteration
 
 
-def _wave_statics(tb: Tables, cry: Carry, g):
+def _wave_statics(tb: Tables, cry: Carry, g, w: ScoreWeights = DEFAULT_WEIGHTS):
     """Per-segment constants: ip_raw (counters can't change during the wave) and
     the static score vectors, exactly as scores() computes them."""
     cnt_at = jnp.take_along_axis(cry.counter, tb.counter_dom, axis=1)
@@ -625,8 +662,8 @@ def _wave_statics(tb: Tables, cry: Carry, g):
     pref_ids = tb.pref_t[g]
     pvalid = pref_ids >= 0
     pidx = jnp.maximum(pref_ids, 0)
-    w = tb.pref_w[g]
-    ip_raw = jnp.sum(jnp.where(pvalid[:, None], w[:, None] * cnt_at[pidx], 0.0), axis=0)
+    pw = tb.pref_w[g]
+    ip_raw = jnp.sum(jnp.where(pvalid[:, None], pw[:, None] * cnt_at[pidx], 0.0), axis=0)
     carr_w = (tb.carr_hard_w + tb.carr_pref_w) * tb.carr_sel_match_g[:, g]
     ip_raw = ip_raw + jnp.sum(carr_w[:, None] * carr_at, axis=0)
     return {
@@ -634,7 +671,7 @@ def _wave_statics(tb: Tables, cry: Carry, g):
         "simon_s": _flr(100.0 * tb.simon_raw[g]),
         "na_raw": tb.nodeaff_raw[g],
         "t_raw": tb.taint_raw[g],
-        "static": W_AVOID * tb.avoid_raw[g] + W_IMAGE * tb.image_raw[g],
+        "static": w.avoid * tb.avoid_raw[g] + w.image * tb.image_raw[g],
     }
 
 
@@ -649,7 +686,8 @@ def _wave_norms(st: dict, F):
     return (simon_hi, simon_lo, na_max, t_max, ip_max, ip_min)
 
 
-def _wave_score_table(tb: Tables, cry: Carry, st: dict, norms, g, j):
+def _wave_score_table(tb: Tables, cry: Carry, st: dict, norms, g, j,
+                      w: ScoreWeights = DEFAULT_WEIGHTS):
     """[N, B] score table: entry (n, k) = score of placing the (j_n+k+1)-th copy
     of group g on node n given current usage. Formulas mirror scores() term by
     term; the constant-on-F plugins (SelectorSpread=100, PodTopologySpread=100,
@@ -670,9 +708,9 @@ def _wave_score_table(tb: Tables, cry: Carry, st: dict, norms, g, j):
     taint = jnp.where(t_max > 0, 100.0 - _flr(st["t_raw"] * 100.0 / t_max), 100.0)
     ip_rng = ip_max - ip_min
     interpod = jnp.where(ip_rng > 0, _flr(100.0 * (st["ip_raw"] - ip_min) / ip_rng), 0.0)
-    static_n = ((W_SIMON + W_GPUSHARE) * simon + W_NODEAFF * nodeaff
-                + W_TAINT * taint + W_INTERPOD * interpod + st["static"])
-    return W_LEAST * least + W_BALANCED * balanced + static_n[:, None]
+    static_n = ((w.simon + w.gpushare) * simon + w.nodeaff * nodeaff
+                + w.taint * taint + w.interpod * interpod + st["static"])
+    return w.least * least + w.balanced * balanced + static_n[:, None]
 
 
 def _wave_capacity(tb: Tables, cry: Carry, g, cap1):
@@ -747,8 +785,10 @@ def _aggregate_commit(tb: Tables, cry: Carry, g, j, gpu_live: bool) -> Carry:
                  dev_used, cry.vg_req, cry.sdev_alloc)
 
 
-@partial(jax.jit, static_argnames=("gpu_live",))
-def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False):
+@partial(jax.jit, static_argnames=("gpu_live", "w", "filters"))
+def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
+                  w: ScoreWeights = DEFAULT_WEIGHTS,
+                  filters: FilterFlags = DEFAULT_FILTERS):
     """Place up to m pods of wave-eligible group g, exactly reproducing m serial
     _step placements. Returns (new carry, per-node counts [N] i32, placed i32).
 
@@ -765,10 +805,12 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False):
     iota_n = jnp.arange(N, dtype=jnp.int32)
     base_feas, _ = feasibility(
         tb, cry, g, jnp.int32(-1), jnp.asarray(True),
-        enable_gpu=gpu_live, enable_storage=False,
+        enable_gpu=gpu_live, enable_storage=False, filters=filters,
     )
-    st = _wave_statics(tb, cry, g)
+    st = _wave_statics(tb, cry, g, w)
     capacity = jnp.where(base_feas, _wave_capacity(tb, cry, g, cap1), 0)
+    if not filters.fit:
+        capacity = jnp.where(base_feas, 2_147_483_000, 0)
     if gpu_live:
         capacity = _gpu_capacity(tb, cry, g, capacity)
 
@@ -777,7 +819,7 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False):
         avail = capacity - j                                   # copies left per node
         F = base_feas & (avail > 0)
         norms = _wave_norms(st, F)
-        table_ext = _wave_score_table(tb, cry, st, norms, g, j)  # [N, B+1]
+        table_ext = _wave_score_table(tb, cry, st, norms, g, j, w)  # [N, B+1]
         table = table_ext[:, :B]
         ks = jnp.arange(B, dtype=jnp.int32)[None, :]
         # usable entries: within remaining capacity, and monotone prefix only
@@ -867,8 +909,10 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False):
     return _aggregate_commit(tb, cry, g, j, gpu_live), j, placed
 
 
-@jax.jit
-def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1):
+@partial(jax.jit, static_argnames=("w", "filters"))
+def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
+                          w: ScoreWeights = DEFAULT_WEIGHTS,
+                          filters: FilterFlags = DEFAULT_FILTERS):
     """Serial scheduling of one group with self-interacting DoNotSchedule
     topology-spread constraints, as a FUSED scan: exactly the reference's
     one-pod-per-cycle process (same per-step feasible set and scores as
@@ -890,10 +934,12 @@ def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1):
     D = cry.counter.shape[1] - 1
     base_feas, _ = feasibility(
         tb, cry, g, jnp.int32(-1), jnp.asarray(True),
-        enable_gpu=False, enable_storage=False, include_dns=False,
+        enable_gpu=False, enable_storage=False, include_dns=False, filters=filters,
     )
-    st = _wave_statics(tb, cry, g)
+    st = _wave_statics(tb, cry, g, w)
     capacity = jnp.where(base_feas, _wave_capacity(tb, cry, g, cap1), 0)
+    if not filters.fit:
+        capacity = jnp.where(base_feas, 2_147_483_000, 0)
 
     dids_raw = tb.dns_t[g]                                 # [Sd]
     dvalid = dids_raw >= 0
@@ -934,9 +980,9 @@ def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1):
         ip_rng = ip_max - ip_min
         interpod = jnp.where(ip_rng > 0,
                              _flr(100.0 * (st["ip_raw"] - ip_min) / ip_rng), 0.0)
-        score = (W_LEAST * least + W_BALANCED * balanced
-                 + (W_SIMON + W_GPUSHARE) * simon + W_NODEAFF * nodeaff
-                 + W_TAINT * taint + W_INTERPOD * interpod + st["static"])
+        score = (w.least * least + w.balanced * balanced
+                 + (w.simon + w.gpushare) * simon + w.nodeaff * nodeaff
+                 + w.taint * taint + w.interpod * interpod + st["static"])
         choice = jnp.argmax(jnp.where(F, score, -jnp.inf)).astype(jnp.int32)
         do = any_f.astype(jnp.int32)
         j = j.at[choice].add(do)
@@ -948,15 +994,16 @@ def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1):
     return _aggregate_commit(tb, cry, g, j, False), j, placed
 
 
-@partial(jax.jit, static_argnames=("n_zones", "enable_gpu", "enable_storage"))
+@partial(jax.jit, static_argnames=("n_zones", "enable_gpu", "enable_storage", "w", "filters"))
 def schedule_batch(
     tb: Tables, cry: Carry, pod_group, forced_node, valid, n_zones: int,
     enable_gpu: bool = True, enable_storage: bool = True,
+    w: ScoreWeights = DEFAULT_WEIGHTS, filters: FilterFlags = DEFAULT_FILTERS,
 ):
     """Scan the whole batch; returns (final carry, placements[P] int32, -1=unschedulable)."""
 
     def body(c, xs):
-        return _step(tb, c, xs, n_zones, enable_gpu, enable_storage)
+        return _step(tb, c, xs, n_zones, enable_gpu, enable_storage, w, filters)
 
     final, choices = jax.lax.scan(body, cry, (pod_group, forced_node, valid))
     return final, choices

@@ -22,6 +22,7 @@ def simulate(
     apps: List[AppResource],
     disable_progress: bool = True,
     patch_pod_funcs: Optional[List[Callable]] = None,
+    sched_config=None,
 ) -> SimulateResult:
     """Run one full simulation; returns placements + unschedulable pods.
 
@@ -36,7 +37,7 @@ def simulate(
     cluster.pods = pods
 
     sim = Simulator(cluster.nodes, disable_progress=disable_progress,
-                    patch_pod_funcs=patch_pod_funcs)
+                    patch_pod_funcs=patch_pod_funcs, sched_config=sched_config)
     result = sim.run_cluster(cluster)
     failed = list(result.unscheduled_pods)
     for app in apps:

@@ -529,6 +529,9 @@ class Encoder:
         self.ports = StringTable()  # (protocol, port) → id; hostIP folded (see kernels)
         self.gpu_host = None  # plugins.gpushare.GpuShareHost, set by the engine
         self.local_host = None  # plugins.openlocal.OpenLocalHost, set by the engine
+        # --default-scheduler-config disables for the statically-folded filter
+        # plugins (taints/unschedulable/node-affinity); set by the engine
+        self.filter_disabled: frozenset = frozenset()
 
     # -- interning ---------------------------------------------------------------
 
@@ -573,6 +576,15 @@ class Encoder:
         hard_ok, prefer_cnt = _taint_masks(na, tolerations)
         unsched_ok = _unschedulable_ok(na, tolerations)
         aff_ok = node_affinity_vec(na, spec)
+        # scheduler-config filter disables (kernel-evaluated filters are
+        # flagged off in kernels.FilterFlags instead); NodeName pinning is a
+        # separate plugin and stays on
+        if "TaintToleration" in self.filter_disabled:
+            hard_ok = np.ones(na.N, bool)
+        if "NodeUnschedulable" in self.filter_disabled:
+            unsched_ok = np.ones(na.N, bool)
+        if "NodeAffinity" in self.filter_disabled:
+            aff_ok = np.ones(na.N, bool)
         if spec.get("nodeName"):
             aff_ok = aff_ok & (na.name_ids == na.values.lookup(spec["nodeName"]))
         mask = hard_ok & unsched_ok & aff_ok

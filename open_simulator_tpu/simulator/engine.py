@@ -126,17 +126,27 @@ class Simulator:
         nodes: List[dict],
         disable_progress: bool = True,
         patch_pod_funcs: Optional[List[Callable]] = None,
+        sched_config=None,
     ) -> None:
         # The simulator owns its node objects, like the reference's fakeclient
         # (Create deep-copies): the plugins write annotations/allocatable back into
         # nodes, and repeated simulations over one caller-owned cluster (the
         # capacity planner's probes) must never see a previous run's mutations.
         nodes = copy.deepcopy(nodes)
+        from ..api.schedconfig import DEFAULT_SCHEDULER_CONFIG, KERNEL_FILTERS
+
+        self.sched_config = sched_config or DEFAULT_SCHEDULER_CONFIG
+        self.score_w = kernels.ScoreWeights(**self.sched_config.weight_kwargs())
+        self.filter_flags = kernels.FilterFlags(**{
+            flag: name not in self.sched_config.disabled_kernel_filters
+            for name, flag in KERNEL_FILTERS.items()
+        })
         self.axis = ResourceAxis()
         self.axis.discover(nodes, [])
         self.model = ClusterModel()
         self.na = NodeArrays(nodes, self.axis)
         self.encoder = Encoder(self.na, self.axis, self.model)
+        self.encoder.filter_disabled = self.sched_config.disabled_encoder_filters
         from ..plugins.gpushare import GpuShareHost
         from ..plugins.openlocal import OpenLocalHost
 
@@ -312,7 +322,8 @@ class Simulator:
 
         tmpl = g.template
         cap1 = False
-        spread_live = any(selfm for _, _, selfm in g.spread_dns)
+        spread_live = (any(selfm for _, _, selfm in g.spread_dns)
+                       and self.filter_flags.spread)
         # shared-GPU groups are unit-countable (kernels.schedule_wave gpu_live)
         # unless they carry a pre-assigned gpu-index (host-driven path → serial)
         gpu_live = g.gpu_mem > 0 and g.gpu_pre_ids is None
@@ -416,6 +427,7 @@ class Simulator:
                     tables, carry, jnp.asarray(pg), jnp.asarray(fn), jnp.asarray(vd),
                     n_zones=bt.n_zones, enable_gpu=enable_gpu,
                     enable_storage=enable_storage,
+                    w=self.score_w, filters=self.filter_flags,
                 )
                 outs.append((seg, ch))
             elif seg[0] == "spread":
@@ -424,7 +436,8 @@ class Simulator:
                 vd = np.zeros(pad, bool)
                 vd[:length] = True
                 carry, counts, _ = kernels.schedule_group_serial(
-                    tables, carry, jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1)
+                    tables, carry, jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1),
+                    w=self.score_w, filters=self.filter_flags,
                 )
                 outs.append((seg, counts))
             else:
@@ -432,6 +445,7 @@ class Simulator:
                 carry, counts, _ = kernels.schedule_wave(
                     tables, carry, jnp.int32(g), jnp.int32(length),
                     jnp.asarray(cap1), gpu_live=gpu_live,
+                    w=self.score_w, filters=self.filter_flags,
                 )
                 outs.append((seg, counts))
         final_carry = carry
@@ -532,6 +546,7 @@ class Simulator:
                     tables, carry, jnp.asarray(pg), jnp.asarray(fn), jnp.asarray(vd),
                     n_zones=bt.n_zones, enable_gpu=enable_gpu,
                     enable_storage=enable_storage,
+                    w=self.score_w, filters=self.filter_flags,
                 )
                 placed_parts.append(jnp.sum((ch >= 0).astype(jnp.int32)))
             elif seg[0] == "spread":
@@ -540,7 +555,8 @@ class Simulator:
                 vd = np.zeros(pad, bool)
                 vd[:length] = True
                 carry, _, placed = kernels.schedule_group_serial(
-                    tables, carry, jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1)
+                    tables, carry, jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1),
+                    w=self.score_w, filters=self.filter_flags,
                 )
                 placed_parts.append(placed)
             else:
@@ -548,6 +564,7 @@ class Simulator:
                 carry, _, placed = kernels.schedule_wave(
                     tables, carry, jnp.int32(g), jnp.int32(length),
                     jnp.asarray(cap1), gpu_live=gpu_live,
+                    w=self.score_w, filters=self.filter_flags,
                 )
                 placed_parts.append(placed)
         self._last_tables, self._last_carry = bt, carry
@@ -616,6 +633,7 @@ class Simulator:
         feasible, stages = kernels.feasibility_jit(
             tables, carry, jnp.int32(g), jnp.int32(forced), jnp.asarray(True),
             enable_gpu=enable_gpu, enable_storage=enable_storage,
+            filters=self.filter_flags,
         )
         N = self.na.N  # stages arrays may carry phantom node padding; slice it off
         stages = {k: np.asarray(v)[:N] for k, v in stages.items()}

@@ -1,0 +1,226 @@
+"""--default-scheduler-config semantics: the KubeSchedulerConfiguration file's
+plugin enable/disable lists and score weights govern scheduling (the reference
+threads the file through the kube-scheduler options machinery,
+pkg/simulator/utils.go:303-381); anything the engine cannot honor raises
+ConfigError instead of silently using defaults."""
+
+import copy
+
+import pytest
+
+from open_simulator_tpu.api.schedconfig import (
+    DEFAULT_SCHEDULER_CONFIG,
+    SchedulerConfig,
+    parse_scheduler_config,
+)
+from open_simulator_tpu.api.v1alpha1 import ConfigError
+from open_simulator_tpu.simulator.engine import Simulator
+
+from fixtures import make_node, make_pod
+
+
+def write(tmp_path, text):
+    p = tmp_path / "sched.yaml"
+    p.write_text(text)
+    return str(p)
+
+
+HEADER = "apiVersion: kubescheduler.config.k8s.io/v1beta1\nkind: KubeSchedulerConfiguration\n"
+
+
+def test_parse_empty_and_default(tmp_path):
+    cfg = parse_scheduler_config(write(tmp_path, HEADER))
+    assert cfg == DEFAULT_SCHEDULER_CONFIG
+    assert cfg.score_weights["PodTopologySpread"] == 2.0
+    assert cfg.score_weights["NodePreferAvoidPods"] == 10000.0
+
+
+def test_parse_weights_and_disables(tmp_path):
+    cfg = parse_scheduler_config(write(tmp_path, HEADER + """
+profiles:
+  - schedulerName: default-scheduler
+    plugins:
+      score:
+        enabled:
+          - name: Simon
+            weight: 5
+          - name: ImageLocality   # weight 0 -> framework zero->1 rule
+        disabled:
+          - name: TaintToleration
+      filter:
+        disabled:
+          - name: NodePorts
+          - name: TaintToleration
+"""))
+    assert cfg.score_weights["Simon"] == 5.0
+    assert cfg.score_weights["ImageLocality"] == 1.0
+    assert cfg.score_weights["TaintToleration"] == 0.0
+    assert cfg.disabled_kernel_filters == frozenset({"NodePorts"})
+    assert cfg.disabled_encoder_filters == frozenset({"TaintToleration"})
+
+
+def test_parse_wildcard_disable(tmp_path):
+    cfg = parse_scheduler_config(write(tmp_path, HEADER + """
+profiles:
+  - plugins:
+      score:
+        disabled:
+          - name: "*"
+        enabled:
+          - name: Simon
+            weight: 3
+"""))
+    assert cfg.score_weights["Simon"] == 3.0
+    assert all(w == 0.0 for k, w in cfg.score_weights.items() if k != "Simon")
+
+
+@pytest.mark.parametrize("body,msg", [
+    ("percentageOfNodesToScore: 50\n", "percentageOfNodesToScore"),
+    ("extenders:\n  - urlPrefix: http://x\n", "extenders"),
+    ("profiles:\n  - schedulerName: other\n", "schedulerName"),
+    ("profiles:\n  - plugins:\n      score:\n        enabled:\n          - name: NoSuchPlugin\n",
+     "NoSuchPlugin"),
+    ("profiles:\n  - pluginConfig:\n      - name: Simon\n", "pluginConfig"),
+    ("profiles:\n  - plugins:\n      preFilter:\n        disabled:\n          - name: NodePorts\n",
+     "preFilter"),
+    ("someUnknownField: 3\n", "someUnknownField"),
+])
+def test_parse_rejects_unsupported_loudly(tmp_path, body, msg):
+    with pytest.raises(ConfigError) as e:
+        parse_scheduler_config(write(tmp_path, HEADER + body))
+    assert msg in str(e.value)
+
+
+def test_volume_plugins_accepted_as_inert(tmp_path):
+    cfg = parse_scheduler_config(write(tmp_path, HEADER + """
+profiles:
+  - plugins:
+      filter:
+        disabled:
+          - name: VolumeBinding
+          - name: VolumeZone
+"""))
+    assert cfg.disabled_kernel_filters == frozenset()
+    assert cfg.disabled_encoder_filters == frozenset()
+
+
+# ------------------------------------------------------------ engine effects ----
+
+
+def _sched(nodes, pods, cfg=None):
+    sim = Simulator(copy.deepcopy(nodes), sched_config=cfg)
+    failed = sim.schedule_pods(copy.deepcopy(pods))
+    placements = {}
+    for i, nodepods in enumerate(sim.pods_on_node):
+        for p in nodepods:
+            placements[p["metadata"]["name"]] = i
+    return placements, failed
+
+
+def test_score_weights_change_placement(tmp_path):
+    """Two nodes: Simon's bin-packing prefers the small node (min-max gives it
+    the full 100 there), LeastAllocated prefers the roomy one. With default
+    weights Simon (+ its Open-Gpu-Share twin) dominates; a config file that
+    boosts LeastAllocated flips the winner, and so does disabling Simon —
+    the file's weights demonstrably govern scoring in both directions."""
+    nodes = [
+        make_node("roomy", cpu="32", memory="64Gi"),
+        make_node("snug", cpu="8", memory="16Gi"),
+    ]
+    # pre-load snug a bit so least/balanced scores separate
+    seed = [make_pod("seed", cpu="2", memory="4Gi", node_name="snug")]
+    pod = [make_pod("probe", cpu="2", memory="4Gi")]
+
+    default_place, _ = _sched(nodes, seed + pod)
+    assert default_place["probe"] == 1  # Simon's bin-packing wins by default
+
+    boosted = parse_scheduler_config(write(tmp_path, HEADER + """
+profiles:
+  - plugins:
+      score:
+        enabled:
+          - name: NodeResourcesLeastAllocated
+            weight: 10
+"""))
+    boosted_place, _ = _sched(nodes, seed + pod, boosted)
+    assert boosted_place["probe"] == 0  # least-allocated now dominates
+
+    no_simon = parse_scheduler_config(write(tmp_path, HEADER + """
+profiles:
+  - plugins:
+      score:
+        disabled:
+          - name: Simon
+          - name: Open-Gpu-Share
+"""))
+    no_simon_place, _ = _sched(nodes, seed + pod, no_simon)
+    assert no_simon_place["probe"] == 0
+
+
+def test_filter_disable_taints():
+    nodes = [make_node("tainted", taints=[{
+        "key": "dedicated", "value": "x", "effect": "NoSchedule"}])]
+    pods = [make_pod("p0", cpu="1", memory="1Gi")]
+    _, failed = _sched(nodes, pods)
+    assert len(failed) == 1  # taint blocks by default
+    cfg = SchedulerConfig(disabled_encoder_filters=frozenset({"TaintToleration"}))
+    placements, failed = _sched(nodes, pods, cfg)
+    assert not failed and placements["p0"] == 0
+
+
+def test_filter_disable_ports():
+    nodes = [make_node("n0")]
+    pods = [make_pod(f"p{i}", cpu="100m", memory="128Mi", host_ports=[8080])
+            for i in range(2)]
+    _, failed = _sched(nodes, pods)
+    assert len(failed) == 1  # second pod conflicts on the host port
+    cfg = SchedulerConfig(disabled_kernel_filters=frozenset({"NodePorts"}))
+    _, failed = _sched(nodes, pods, cfg)
+    assert not failed
+
+
+def test_filter_disable_spread():
+    nodes = [make_node(f"n{i}", labels={"zone": "a" if i < 2 else "b"})
+             for i in range(3)]
+    pods = []
+    for i in range(8):
+        p = make_pod(f"s{i}", cpu="100m", memory="128Mi", labels={"app": "s"})
+        p["spec"]["topologySpreadConstraints"] = [{
+            "maxSkew": 1, "topologyKey": "zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "s"}},
+        }]
+        pods.append(p)
+    nodes[2]["status"]["allocatable"]["cpu"] = "200m"  # zone b nearly full
+    nodes[2]["status"]["capacity"]["cpu"] = "200m"
+    _, failed = _sched(nodes, pods)
+    assert failed  # zone-b capacity caps the whole workload via maxSkew
+    cfg = SchedulerConfig(disabled_kernel_filters=frozenset({"PodTopologySpread"}))
+    _, failed = _sched(nodes, pods, cfg)
+    assert not failed
+
+
+def test_applier_accepts_scheduler_config_file(tmp_path, monkeypatch):
+    import os as _os
+
+    from open_simulator_tpu.apply.applier import Applier, Options
+
+    REPO = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    _os.chdir(REPO)
+    sched = write(tmp_path, HEADER + """
+profiles:
+  - plugins:
+      score:
+        enabled:
+          - name: Simon
+            weight: 2
+""")
+    ap = Applier(Options(simon_config="examples/simon-smoke-config.yaml",
+                         default_scheduler_config=sched))
+    res = ap.run()
+    assert res is not None
+
+    bad = write(tmp_path, HEADER + "extenders:\n  - urlPrefix: http://x\n")
+    with pytest.raises(ConfigError):
+        Applier(Options(simon_config="examples/simon-smoke-config.yaml",
+                        default_scheduler_config=bad))
