@@ -23,6 +23,7 @@ lax.scan step per pod).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -117,6 +118,59 @@ def bench_capacity_plan(n_pods=100_000, repeats=1):
         os.environ.pop("MaxCPU", None)
 
 
+def bench_mesh_cpu(n_nodes=1_000, n_pods=10_000, shards=8):
+    """Mesh-sharded product path on a virtual CPU mesh: same workload through
+    Simulator(use_mesh=True) over `shards` devices and the single-device
+    engine, in a subprocess (the CPU device count must be set before backend
+    init). Returns (pods_per_sec, placements_match, error)."""
+    import json as _json
+    import subprocess
+
+    code = f"""
+import json, os, sys, time
+sys.path.insert(0, {repr(__file__.rsplit('/', 1)[0])})
+from open_simulator_tpu.utils.synth import synth_cluster
+from open_simulator_tpu.simulator.engine import Simulator
+
+def census(sim):
+    out = {{}}
+    for i, pods in enumerate(sim.pods_on_node):
+        out[i] = len(pods)
+    return out
+
+nodes, pods = synth_cluster({n_nodes}, {n_pods})
+import copy
+best = None
+for use_mesh in (True, True):  # first run pays the distributed compile
+    sim = Simulator(copy.deepcopy(nodes), use_mesh=True)
+    t0 = time.perf_counter()
+    sim.schedule_pods(copy.deepcopy(pods))
+    dt = time.perf_counter() - t0
+    mesh_census = census(sim)
+    if best is None or dt < best:
+        best = dt
+single = Simulator(copy.deepcopy(nodes), use_mesh=False)
+single.schedule_pods(copy.deepcopy(pods))
+print(json.dumps({{"rate": {n_pods} / best, "match": census(single) == mesh_census}}))
+"""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={shards}",
+        "OPEN_SIMULATOR_MESH": "1",
+    })
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True,
+            text=True, timeout=900,
+        )
+        line = out.stdout.strip().splitlines()[-1]
+        data = _json.loads(line)
+        return data["rate"], bool(data["match"]), ""
+    except Exception as e:  # the mesh metric is best-effort; report, don't die
+        return 0.0, False, f"{type(e).__name__}: {e}"
+
+
 def main() -> None:
     results = []
 
@@ -156,6 +210,16 @@ def main() -> None:
         "value": round(rate, 1), "unit": "pods/s",
         "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4),
         "wall_s": round(dt, 3), "scheduled": placed, "total": total,
+    })
+
+    # ---- mesh: sharded product path on a virtual CPU mesh --------------------
+    rate, match, err = bench_mesh_cpu()
+    results.append({
+        "metric": "mesh8_cpu_pods_per_sec_10k_pods_1k_nodes",
+        "value": round(rate, 1), "unit": "pods/s",
+        "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4),
+        "placements_match_single_device": match,
+        **({"error": err} if err else {}),
     })
 
     # ---- config 5: capacity planning ----------------------------------------

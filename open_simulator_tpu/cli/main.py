@@ -59,6 +59,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p_apply.add_argument("--output-file", default="", help="save report to output file.")
     p_apply.add_argument(
+        "--profile", default="", metavar="DIR",
+        help="write a jax.profiler device trace of the run to DIR "
+             "(view with TensorBoard); the device-side analog of the "
+             "reference's pprof endpoints.")
+    p_apply.add_argument(
         "--use-greed", action="store_true", help="use greedy algorithm when queue pods"
     )
     p_apply.add_argument(
@@ -113,7 +118,13 @@ def cmd_apply(args) -> int:
             extended_resources=ext,
             output_file=args.output_file,
         ))
-        result = applier.run()
+        if args.profile:
+            import jax
+
+            with jax.profiler.trace(args.profile):
+                result = applier.run()
+        else:
+            result = applier.run()
         if result is not None and args.placement_dump:
             from ..parity import placement_dump, save_dump
 

@@ -182,6 +182,8 @@ class Server:
 
     # --------------------------------------------------------------- serving ------
 
+    _t_start = __import__("time").time()
+
     def start(self, port: int = 8080, host: str = "") -> None:
         httpd = self.build_httpd(port, host)
         print(f"simon server listening on :{port}")
@@ -205,6 +207,19 @@ class Server:
             def do_GET(self):
                 if self.path == "/healthz":
                     self._send(200, {"message": "ok"})
+                elif self.path == "/debug/vars":
+                    # the profiling surface the reference exposes via pprof
+                    # (server.go:152): uptime, rss, and recent traced phases
+                    import resource
+                    import time as _time
+
+                    from ..utils.trace import recent_spans
+
+                    self._send(200, {
+                        "uptime_seconds": round(_time.time() - server._t_start, 3),
+                        "max_rss_kb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
+                        "recent_traces": recent_spans(),
+                    })
                 elif self.path == "/test":
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")

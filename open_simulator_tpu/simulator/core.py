@@ -30,18 +30,24 @@ def simulate(
     Deployments/RS/RC/STS/Jobs/CronJobs, then DaemonSets against the node list), exactly
     like Simulate (core.go:85-96).
     """
-    cluster = cluster.copy()
-    pods = expand_workloads_excluding_daemonsets(cluster)
-    for ds in cluster.daemon_sets:
-        pods.extend(pods_from_daemonset(ds, cluster.nodes))
-    cluster.pods = pods
+    from ..utils.trace import Span
 
-    sim = Simulator(cluster.nodes, disable_progress=disable_progress,
-                    patch_pod_funcs=patch_pod_funcs, sched_config=sched_config)
-    result = sim.run_cluster(cluster)
-    failed = list(result.unscheduled_pods)
-    for app in apps:
-        result = sim.schedule_app(app)
-        failed.extend(result.unscheduled_pods)
-    result.unscheduled_pods = failed
+    with Span("Simulate", log_if_longer=1.0) as span:  # core.go:67-73 LogIfLong
+        cluster = cluster.copy()
+        pods = expand_workloads_excluding_daemonsets(cluster)
+        for ds in cluster.daemon_sets:
+            pods.extend(pods_from_daemonset(ds, cluster.nodes))
+        cluster.pods = pods
+        span.step("expand cluster workloads")
+
+        sim = Simulator(cluster.nodes, disable_progress=disable_progress,
+                        patch_pod_funcs=patch_pod_funcs, sched_config=sched_config)
+        result = sim.run_cluster(cluster)
+        span.step("sync cluster")
+        failed = list(result.unscheduled_pods)
+        for app in apps:
+            result = sim.schedule_app(app)
+            span.step(f"schedule app {app.name}")
+            failed.extend(result.unscheduled_pods)
+        result.unscheduled_pods = failed
     return result

@@ -64,6 +64,8 @@ _jnp = None  # lazy jax import so host-only paths (ingestion, reports) stay jax-
 # shorter runs ride the serial scan (one compiled dispatch covers many runs).
 WAVE_MIN = 8
 
+_UNSET = object()  # Simulator._mesh sentinel: mesh decision not yet made
+
 
 def _jax():
     global _jnp
@@ -127,7 +129,14 @@ class Simulator:
         disable_progress: bool = True,
         patch_pod_funcs: Optional[List[Callable]] = None,
         sched_config=None,
+        use_mesh: Optional[bool] = None,
     ) -> None:
+        """use_mesh: shard the node axis over every visible accelerator
+        (parallel/mesh.py). None = auto: shard whenever >1 device is visible
+        (overridable via OPEN_SIMULATOR_MESH=0/1); True/False force it. The
+        sharded and single-device paths produce identical placements — the
+        mesh only distributes the [*, N] tables and carry rows, and XLA
+        inserts the cross-shard collectives for normalizers and argmax."""
         # The simulator owns its node objects, like the reference's fakeclient
         # (Create deep-copies): the plugins write annotations/allocatable back into
         # nodes, and repeated simulations over one caller-owned cluster (the
@@ -166,6 +175,8 @@ class Simulator:
         # whose only self-interaction is capacity commit in bulk. Settable to
         # False to force the pure serial scan (used by the parity tests).
         self.use_waves = True
+        self.use_mesh = use_mesh
+        self._mesh = _UNSET
         self._wave_elig_cache: Dict[int, Tuple[bool, bool, bool, bool]] = {}
         # signature → (req_vec, nonzero, port_ids, carrier_ids): identical pods
         # share all PlacedRecord vectors, so commit bookkeeping is O(1) per pod
@@ -240,8 +251,12 @@ class Simulator:
         scan; a pre-bound pod (spec.nodeName) flushes the run first, then commits
         directly — so earlier unbound pods never see capacity a later bound pod will
         take, exactly as in the serial loop."""
+        from ..utils.trace import Progress
+
         failed: List[UnscheduledPod] = []
         run: List[dict] = []
+        self._progress = Progress("Scheduling pods", len(pods),
+                                  enabled=not self.disable_progress)
         for pod in pods:
             node_name = (pod.get("spec") or {}).get("nodeName")
             if not node_name:
@@ -249,6 +264,7 @@ class Simulator:
                 continue
             failed.extend(self._schedule_run(run))
             run = []
+            self._progress.advance(1)
             ni = self.na.index.get(node_name)
             if ni is None:
                 # Parity: the reference's fakeclient accepts pods bound to unknown
@@ -259,6 +275,7 @@ class Simulator:
             else:
                 self._commit_pod(pod, ni, scheduled=False)
         failed.extend(self._schedule_run(run))
+        self._progress.close()
         if self.gpu_host.enabled:
             self.gpu_host.flush()
         return failed
@@ -467,8 +484,11 @@ class Simulator:
                     choices[start:start + placed] = assign[:placed]
         self._last_tables, self._last_carry = bt, final_carry
 
+        progress = getattr(self, "_progress", None)
         reason_cache: Dict[Tuple[int, int], Dict[str, int]] = {}
         for i, pod in enumerate(to_schedule):
+            if progress is not None:
+                progress.advance(1)
             node_i = int(choices[i])
             if node_i >= 0:
                 self._commit_pod(pod, node_i)
@@ -591,10 +611,39 @@ class Simulator:
             "mem_alloc": float(alloc[:, MEM_I].sum()),
         }
 
+    def _resolve_mesh(self):
+        """Decide (once) whether to shard: use_mesh True/False forces it; None
+        autodetects >1 visible device, overridable via OPEN_SIMULATOR_MESH."""
+        if self._mesh is not _UNSET:
+            return self._mesh
+        import os
+
+        want = self.use_mesh
+        env = os.environ.get("OPEN_SIMULATOR_MESH", "")
+        if want is None and env:
+            want = env not in ("0", "false", "no")
+        mesh = None
+        if want is not False:
+            import jax
+
+            n = len(jax.devices())
+            if n > 1 or (want and n >= 1):
+                from ..parallel.mesh import make_node_mesh
+
+                mesh = make_node_mesh(n)
+        self._mesh = mesh
+        return mesh
+
     def _to_device(self, bt: BatchTables):
         jnp = _jax()
         from ..parallel.mesh import tables_from_batch
 
+        mesh = self._resolve_mesh()
+        if mesh is not None:
+            from ..parallel.mesh import to_device_sharded
+
+            tables, carry, _ = to_device_sharded(bt, mesh)
+            return tables, carry
         tables = kernels.Tables(*(jnp.asarray(v) for v in tables_from_batch(bt)))
         carry = kernels.Carry(
             requested=jnp.asarray(bt.seed_requested),

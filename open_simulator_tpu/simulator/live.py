@@ -121,7 +121,7 @@ def _split_pods(pods: List[dict]) -> Tuple[List[dict], List[dict]]:
     return running, pending
 
 
-def create_cluster_resource_from_client(client_or_path, master: str = "") -> ResourceTypes:
+def _create_cluster_resource_from_client(client_or_path, master: str = "") -> ResourceTypes:
     """Snapshot the cluster objects the simulation needs. Accepts a KubeClient or a
     kubeconfig path."""
     client = (
@@ -153,3 +153,12 @@ __all__ = [
     "create_kube_client",
     "create_cluster_resource_from_client",
 ]
+
+
+def create_cluster_resource_from_client(client_or_path, master: str = "") -> ResourceTypes:
+    """Traced wrapper: the reference shows a spinner and logs slow cluster
+    fetches at 100ms (simulator.go:506-512)."""
+    from ..utils.trace import Span
+
+    with Span("fetch cluster from kube-apiserver", log_if_longer=0.1):
+        return _create_cluster_resource_from_client(client_or_path, master)

@@ -1,0 +1,102 @@
+"""utiltrace-style phase spans with LogIfLong thresholds.
+
+The reference instruments Simulate with k8s.io/utils/trace spans — e.g.
+`utiltrace.New("Simulate")` logged when a step exceeds 1s (pkg/simulator/
+core.go:67-73) and the live-cluster fetch spinner at 100ms
+(pkg/simulator/simulator.go:506-512). This is the same idea without the
+vendored package: nested steps, wall-clock per step, and a single log line
+(via `logging`) when the span outlives its threshold. Recent spans are kept in
+a small ring so the server's /debug/vars endpoint can expose them.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+log = logging.getLogger("open_simulator_tpu.trace")
+
+# (name, total_seconds, [(step_name, seconds), ...], logged)
+_RECENT: Deque[tuple] = deque(maxlen=32)
+_LOCK = threading.Lock()
+
+
+class Span:
+    """One traced phase. Use as a context manager; `step(name)` marks interior
+    progress like utiltrace's trace.Step. On exit, logs when total wall time
+    exceeds `log_if_longer` seconds."""
+
+    def __init__(self, name: str, log_if_longer: float = 1.0) -> None:
+        self.name = name
+        self.threshold = log_if_longer
+        self.steps: List[Tuple[str, float]] = []
+        self._t0 = 0.0
+        self._last = 0.0
+        self.total = 0.0
+
+    def __enter__(self) -> "Span":
+        self._t0 = self._last = time.perf_counter()
+        return self
+
+    def step(self, name: str) -> None:
+        now = time.perf_counter()
+        self.steps.append((name, now - self._last))
+        self._last = now
+
+    def __exit__(self, *exc) -> None:
+        self.total = time.perf_counter() - self._t0
+        logged = self.total >= self.threshold
+        if logged:
+            detail = "; ".join(f"{n}: {dt * 1000:.0f}ms" for n, dt in self.steps)
+            log.warning("Trace %r took %.3fs (threshold %.3fs)%s",
+                        self.name, self.total, self.threshold,
+                        f" — {detail}" if detail else "")
+        with _LOCK:
+            _RECENT.append((self.name, self.total, list(self.steps), logged))
+
+
+def recent_spans() -> List[dict]:
+    """Snapshot for /debug/vars: most recent first."""
+    with _LOCK:
+        items = list(_RECENT)
+    return [
+        {"name": n, "seconds": round(t, 6), "logged": lg,
+         "steps": [{"name": sn, "seconds": round(st, 6)} for sn, st in steps]}
+        for n, t, steps, lg in reversed(items)
+    ]
+
+
+class Progress:
+    """The schedulePods progress line (the reference renders a pterm progress
+    bar per pod, simulator.go:311-321). Text-mode: carriage-return updates to
+    stderr, one final newline; inert when disabled or not a tty-ish stream."""
+
+    def __init__(self, title: str, total: int, enabled: bool, stream=None) -> None:
+        import sys
+
+        self.title = title
+        self.total = total
+        self.done = 0
+        self.enabled = enabled and total > 0
+        self.stream = stream if stream is not None else sys.stderr
+        self._last_render = 0.0
+
+    def advance(self, n: int) -> None:
+        if not self.enabled:
+            return
+        self.done += n
+        now = time.perf_counter()
+        # rate-limit renders; always render the final state
+        if self.done < self.total and now - self._last_render < 0.1:
+            return
+        self._last_render = now
+        pct = int(self.done / self.total * 100)
+        print(f"\r{self.title} {self.done}/{self.total} ({pct}%)",
+              end="", file=self.stream, flush=True)
+
+    def close(self) -> None:
+        if self.enabled and self.done:
+            print(file=self.stream, flush=True)

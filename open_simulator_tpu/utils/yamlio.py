@@ -83,7 +83,7 @@ def match_and_set_local_storage_annotation(nodes: List[dict], directory: str) ->
             ] = json.dumps(info)
 
 
-def load_cluster_from_directory(directory: str, strict: bool = True) -> ResourceTypes:
+def _load_cluster_from_directory(directory: str, strict: bool = True) -> ResourceTypes:
     """CreateClusterResourceFromClusterConfig (simulator.go:604-619): YAML objects
     plus node-name-matched local-storage specs applied as node annotations."""
     rt = load_resources_from_directory(directory, strict=strict)
@@ -106,3 +106,11 @@ def load_json_files(directory: str) -> dict:
                 with open(os.path.join(root, fname), "r", encoding="utf-8") as f:
                     out[os.path.splitext(fname)[0]] = json.load(f)
     return out
+
+
+def load_cluster_from_directory(directory: str, strict: bool = True) -> ResourceTypes:
+    """Traced wrapper — same 100ms LogIfLong as the live-cluster fetch."""
+    from .trace import Span
+
+    with Span("load cluster from directory", log_if_longer=0.1):
+        return _load_cluster_from_directory(directory, strict)

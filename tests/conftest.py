@@ -10,3 +10,8 @@ from open_simulator_tpu.utils.devices import force_cpu_platform, request_cpu_dev
 
 request_cpu_devices(8)
 force_cpu_platform()
+
+# The 8 virtual devices would auto-enable the engine's mesh path for every
+# test (Simulator._resolve_mesh); keep the default suite single-device and let
+# the parallel/mesh tests opt in with use_mesh=True.
+os.environ.setdefault("OPEN_SIMULATOR_MESH", "0")

@@ -69,3 +69,46 @@ def test_scenarios_dp_axis():
     # scenario 0 (empty cluster) must equal the plain single-device run
     want = _run_single(sim, bt)
     np.testing.assert_array_equal(want, choices[0])
+
+
+def test_engine_mesh_product_path_matches_single_device():
+    """The PRODUCT path (Simulator(use_mesh=True) -> _to_device shards over all
+    visible devices) must place identically to the single-device engine on a
+    mixed workload: waves, spread group-serial, and serial segments."""
+    import copy
+
+    from open_simulator_tpu.simulator.engine import Simulator
+
+    from fixtures import make_node, make_pod
+
+    nodes = []
+    for z in range(4):
+        for i in range(4):
+            nodes.append(make_node(f"z{z}-n{i}", cpu="8", memory="16Gi",
+                                   labels={"zone": f"z{z}"}))
+    pods = [make_pod(f"web-{i}", cpu="250m", memory="256Mi",
+                     labels={"app": "web"}) for i in range(40)]
+    for i in range(16):
+        p = make_pod(f"spread-{i}", cpu="250m", memory="256Mi",
+                     labels={"app": "spread"})
+        p["spec"]["topologySpreadConstraints"] = [{
+            "maxSkew": 1, "topologyKey": "zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "spread"}},
+        }]
+        pods.append(p)
+    pods += [make_pod(f"porty-{i}", cpu="250m", memory="256Mi",
+                      labels={"app": "porty"}, host_ports=[9090])
+             for i in range(3)]
+
+    results = []
+    for use_mesh in (True, False):
+        sim = Simulator(copy.deepcopy(nodes), use_mesh=use_mesh)
+        failed = sim.schedule_pods(copy.deepcopy(pods))
+        census = {}
+        for i, nodepods in enumerate(sim.pods_on_node):
+            for p in nodepods:
+                key = (i, p["metadata"]["labels"]["app"])
+                census[key] = census.get(key, 0) + 1
+        results.append((census, len(failed)))
+    assert results[0] == results[1]

@@ -1,0 +1,99 @@
+"""Phase tracing + progress reporting (the reference's utiltrace spans with
+LogIfLong thresholds, core.go:67-73, and the pterm progress bar,
+simulator.go:311-321)."""
+
+import io
+import logging
+
+from open_simulator_tpu.utils.trace import Progress, Span, recent_spans
+
+from fixtures import make_node, make_pod
+
+
+def test_span_logs_only_over_threshold(caplog):
+    with caplog.at_level(logging.WARNING, logger="open_simulator_tpu.trace"):
+        with Span("fast phase", log_if_longer=10.0) as sp:
+            sp.step("a")
+        assert not caplog.records
+        with Span("slow phase", log_if_longer=0.0) as sp:
+            sp.step("b")
+        assert any("slow phase" in r.getMessage() for r in caplog.records)
+    spans = recent_spans()
+    assert spans[0]["name"] == "slow phase" and spans[0]["logged"]
+    assert spans[0]["steps"][0]["name"] == "b"
+    assert spans[1]["name"] == "fast phase" and not spans[1]["logged"]
+
+
+def test_simulate_emits_span():
+    from open_simulator_tpu.core.types import AppResource, ResourceTypes
+    from open_simulator_tpu.simulator.core import simulate
+
+    cluster = ResourceTypes()
+    cluster.nodes = [make_node("n0")]
+    cluster.pods = [make_pod("p0", cpu="1", memory="1Gi")]
+    simulate(cluster, [])
+    names = [s["name"] for s in recent_spans()]
+    assert "Simulate" in names
+    sim_span = next(s for s in recent_spans() if s["name"] == "Simulate")
+    step_names = [st["name"] for st in sim_span["steps"]]
+    assert "expand cluster workloads" in step_names
+    assert "sync cluster" in step_names
+
+
+def test_progress_renders_and_closes():
+    buf = io.StringIO()
+    pr = Progress("Scheduling pods", 4, enabled=True, stream=buf)
+    pr.advance(2)
+    pr.advance(2)
+    pr.close()
+    out = buf.getvalue()
+    assert "Scheduling pods 4/4 (100%)" in out
+    assert out.endswith("\n")
+
+
+def test_progress_disabled_is_silent():
+    buf = io.StringIO()
+    pr = Progress("x", 4, enabled=False, stream=buf)
+    pr.advance(4)
+    pr.close()
+    assert buf.getvalue() == ""
+
+
+def test_engine_progress_wiring():
+    """disable_progress=False must actually render (the round-2 gap: a dead
+    parameter)."""
+    import contextlib
+    import copy
+    import io as _io
+    import sys
+
+    from open_simulator_tpu.simulator.engine import Simulator
+
+    nodes = [make_node("n0")]
+    pods = [make_pod(f"p{i}", cpu="100m", memory="128Mi") for i in range(12)]
+    sim = Simulator(copy.deepcopy(nodes), disable_progress=False)
+    buf = _io.StringIO()
+    with contextlib.redirect_stderr(buf):
+        sim.schedule_pods(copy.deepcopy(pods))
+    assert "Scheduling pods 12/12" in buf.getvalue()
+
+
+def test_server_debug_vars():
+    import json
+    import threading
+    import urllib.request
+
+    from open_simulator_tpu.server.http import Server
+
+    srv = Server.__new__(Server)  # endpoint needs no cluster client
+    httpd = srv.build_httpd(port=0, host="127.0.0.1")
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/debug/vars") as r:
+            data = json.loads(r.read())
+        assert "uptime_seconds" in data and "recent_traces" in data
+        assert "max_rss_kb" in data
+    finally:
+        httpd.shutdown()
