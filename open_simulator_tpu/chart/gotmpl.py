@@ -244,7 +244,8 @@ class _Scope:
                 s.map[name] = val
                 return
             s = s.parent
-        self.map[name] = val  # lenient: undeclared `=` declares in place
+        # text/template errors on `$x = v` without a prior `$x :=` declaration
+        raise TemplateError(f"undefined variable ${name}")
 
 
 class _Ctx:

@@ -81,6 +81,8 @@ def match_rate(a: dict, b: dict) -> Tuple[float, dict]:
     """(rate, detail). Rate over aggregated (workload, node) placement counts;
     detail lists the disagreeing keys."""
     pa, pb = a.get("placements") or {}, b.get("placements") or {}
+    if not pa and not pb:
+        return 1.0, {}  # two empty dumps agree vacuously, not 0%
     keys = set(pa) | set(pb)
     agree = sum(min(pa.get(k, 0), pb.get(k, 0)) for k in keys)
     total = max(sum(pa.values()), sum(pb.values())) or 1

@@ -18,6 +18,7 @@ which trades the informer cache for zero dependencies.
 from __future__ import annotations
 
 import json
+import time
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, List, Optional, Tuple
@@ -52,7 +53,7 @@ def snapshot_from_client(client) -> ClusterSnapshot:
 
     rt = ResourceTypes()
     rt.nodes = client.list("/api/v1/nodes")
-    running, pending = _split_pods(client.list("/api/v1/pods", resourceVersion=0))
+    running, pending = _split_pods(client.list("/api/v1/pods"))
     rt.pods = running
     try:
         rt.pod_disruption_budgets = client.list("/apis/policy/v1/poddisruptionbudgets")
@@ -182,9 +183,8 @@ class Server:
 
     # --------------------------------------------------------------- serving ------
 
-    _t_start = __import__("time").time()
-
     def start(self, port: int = 8080, host: str = "") -> None:
+        self._t_start = time.time()
         httpd = self.build_httpd(port, host)
         print(f"simon server listening on :{port}")
         httpd.serve_forever()
@@ -211,12 +211,13 @@ class Server:
                     # the profiling surface the reference exposes via pprof
                     # (server.go:152): uptime, rss, and recent traced phases
                     import resource
-                    import time as _time
 
                     from ..utils.trace import recent_spans
 
+                    started = getattr(server, "_t_start", None)
                     self._send(200, {
-                        "uptime_seconds": round(_time.time() - server._t_start, 3),
+                        "uptime_seconds": (
+                            round(time.time() - started, 3) if started else None),
                         "max_rss_kb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
                         "recent_traces": recent_spans(),
                     })

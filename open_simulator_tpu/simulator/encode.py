@@ -739,9 +739,12 @@ class Encoder:
 
     def _image_raw(self, pod: dict) -> np.ndarray:
         """ImageLocality (imagelocality plugin): scaled sum of present image sizes,
-        normalized over [23MB, 1000MB]. Zero when nodes advertise no images."""
+        normalized over [23MB, 1000MB x numContainers] (calculatePriority scales
+        the max threshold per container, image_locality.go:82-91). Zero when
+        nodes advertise no images."""
         mb = 1024 * 1024
-        min_t, max_t = 23 * mb, 1000 * mb
+        n_containers = max(1, len((pod.get("spec") or {}).get("containers") or []))
+        min_t, max_t = 23 * mb, 1000 * mb * n_containers
         sizes: List[Dict[str, float]] = []
         have_any = False
         for node in self.na.nodes:

@@ -253,6 +253,7 @@ class Simulator:
         take, exactly as in the serial loop."""
         from ..utils.trace import Progress
 
+        self._warn_on_mixed_priorities(pods)
         failed: List[UnscheduledPod] = []
         run: List[dict] = []
         self._progress = Progress("Scheduling pods", len(pods),
@@ -279,6 +280,36 @@ class Simulator:
         if self.gpu_host.enabled:
             self.gpu_host.flush()
         return failed
+
+    def _warn_on_mixed_priorities(self, pods: List[dict]) -> None:
+        """DefaultPreemption (PostFilter) is NOT simulated. With uniform pod
+        priorities this is provably inert: preemption requires a victim of
+        strictly lower priority than the failed pod (default_preemption.go
+        selectVictimsOnNode), so with one priority class there is never a
+        candidate and the reference's scheduler returns the same unschedulable
+        verdict. Inputs carrying MULTIPLE distinct spec.priority values could
+        preempt in the reference, so they get a loud warning here instead of a
+        silent divergence (see PARITY.md 'Preemption')."""
+        if getattr(self, "_priority_warned", False):
+            return
+        # persists across schedule_pods calls: cluster pods and app pods are
+        # scheduled in separate calls, and a priority gap BETWEEN those sets is
+        # exactly where the reference could preempt
+        seen = getattr(self, "_priority_seen", None)
+        if seen is None:
+            seen = self._priority_seen = set()
+        for p in pods:
+            seen.add((p.get("spec") or {}).get("priority") or 0)
+            if len(seen) > 1:
+                import logging
+
+                logging.getLogger("open_simulator_tpu").warning(
+                    "pods carry %d distinct spec.priority values; preemption "
+                    "(DefaultPreemption PostFilter) is not simulated — "
+                    "placements may diverge from a preempting scheduler for "
+                    "workloads that overflow capacity", len(seen))
+                self._priority_warned = True
+                return
 
     def encode_batch(self, to_schedule: List[dict]) -> BatchTables:
         """Encode a pod batch into device-ready tables (no scheduling). Exposed for

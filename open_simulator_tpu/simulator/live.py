@@ -37,6 +37,47 @@ def _b64_to_tempfile(data: str, suffix: str) -> str:
     return f.name
 
 
+def _text_to_tempfile(text: str, suffix: str) -> str:
+    f = tempfile.NamedTemporaryFile("w", suffix=suffix, delete=False)
+    f.write(text)
+    f.close()
+    return f.name
+
+
+def _run_exec_credential(exec_cfg: dict):
+    """Run a client-go exec credential plugin (kubeconfig user.exec) and parse
+    its ExecCredential output. Returns (token, (cert_file, key_file) | None)."""
+    import subprocess
+
+    cmd = [exec_cfg.get("command") or ""]
+    cmd += list(exec_cfg.get("args") or [])
+    env = dict(os.environ)
+    for e in exec_cfg.get("env") or []:
+        if e.get("name"):
+            env[e["name"]] = e.get("value", "")
+    env.setdefault(
+        "KUBERNETES_EXEC_INFO",
+        json.dumps({"apiVersion": exec_cfg.get("apiVersion", ""),
+                    "kind": "ExecCredential", "spec": {"interactive": False}}),
+    )
+    try:
+        out = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                             timeout=60, check=True)
+        cred = json.loads(out.stdout)
+    except Exception as e:
+        raise LiveClusterError(
+            f"exec credential plugin {cmd[0]!r} failed: {e}") from e
+    status = cred.get("status") or {}
+    token = status.get("token")
+    cert_pair = None
+    if status.get("clientCertificateData") and status.get("clientKeyData"):
+        cert_pair = (
+            _text_to_tempfile(status["clientCertificateData"], ".crt"),
+            _text_to_tempfile(status["clientKeyData"], ".key"),
+        )
+    return token, cert_pair
+
+
 class KubeClient:
     """Minimal typed GET client for one kubeconfig context."""
 
@@ -59,6 +100,13 @@ class KubeClient:
         token_file = user.get("tokenFile")
         if not self.token and token_file and os.path.exists(token_file):
             self.token = open(token_file).read().strip()
+        exec_cfg = user.get("exec")
+        self._exec_cert: Optional[Tuple[str, str]] = None
+        if not self.token and exec_cfg:
+            # client-go exec credential plugins (the auth mode managed clouds
+            # use); the plugin prints an ExecCredential whose status carries a
+            # bearer token and/or a client cert pair
+            self.token, self._exec_cert = _run_exec_credential(exec_cfg)
 
         self.ssl_ctx = ssl.create_default_context()
         if cluster.get("insecure-skip-tls-verify"):
@@ -76,6 +124,8 @@ class KubeClient:
             cert_file = _b64_to_tempfile(user["client-certificate-data"], ".crt")
         if user.get("client-key-data"):
             key_file = _b64_to_tempfile(user["client-key-data"], ".key")
+        if not (cert_file and key_file) and self._exec_cert:
+            cert_file, key_file = self._exec_cert
         if cert_file and key_file:
             self.ssl_ctx.load_cert_chain(certfile=cert_file, keyfile=key_file)
 
@@ -90,18 +140,32 @@ class KubeClient:
         except Exception as e:  # urllib raises a zoo of types; wrap them all
             raise LiveClusterError(f"GET {path} failed: {e}") from e
 
+    # Chunk size per LIST request: apiserver-friendly paging so 3,000+-node
+    # clusters (the reference's claimed scale, changelogs/v0.1.3.md) never
+    # materialize one giant response.
+    PAGE_LIMIT = 500
+
     def list(self, path: str, **params) -> List[dict]:
-        if params:
-            q = "&".join(f"{k}={v}" for k, v in params.items())
-            path = f"{path}?{q}"
-        body = self.get(path)
-        kind = (body.get("kind") or "").removesuffix("List")
-        api_version = body.get("apiVersion", "v1")
-        items = body.get("items") or []
-        for it in items:  # items in a List response omit their own TypeMeta
-            it.setdefault("kind", kind)
-            it.setdefault("apiVersion", api_version)
-        return items
+        from urllib.parse import urlencode
+
+        items: List[dict] = []
+        cont: Optional[str] = None
+        while True:
+            q = dict(params)
+            q.setdefault("limit", self.PAGE_LIMIT)
+            if cont:
+                q["continue"] = cont
+            body = self.get(f"{path}?{urlencode(q)}")
+            kind = (body.get("kind") or "").removesuffix("List")
+            api_version = body.get("apiVersion", "v1")
+            page = body.get("items") or []
+            for it in page:  # items in a List response omit their own TypeMeta
+                it.setdefault("kind", kind)
+                it.setdefault("apiVersion", api_version)
+            items.extend(page)
+            cont = (body.get("metadata") or {}).get("continue")
+            if not cont:
+                return items
 
 
 def create_kube_client(kubeconfig: str, master: str = "") -> KubeClient:
@@ -131,7 +195,9 @@ def _create_cluster_resource_from_client(client_or_path, master: str = "") -> Re
     )
     rt = ResourceTypes()
     rt.nodes = client.list("/api/v1/nodes")
-    running, pending = _split_pods(client.list("/api/v1/pods", resourceVersion=0))
+    # no resourceVersion=0 here: the apiserver ignores `limit` for cache reads,
+    # which would defeat pagination on big clusters
+    running, pending = _split_pods(client.list("/api/v1/pods"))
     rt.pods = running + pending  # Running first, then Pending, like the reference
     # policy/v1beta1 (what the reference's v1.20 client uses) was removed in k8s
     # 1.25; prefer policy/v1 and fall back for old clusters.

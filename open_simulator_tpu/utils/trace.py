@@ -71,8 +71,9 @@ def recent_spans() -> List[dict]:
 
 class Progress:
     """The schedulePods progress line (the reference renders a pterm progress
-    bar per pod, simulator.go:311-321). Text-mode: carriage-return updates to
-    stderr, one final newline; inert when disabled or not a tty-ish stream."""
+    bar per pod, simulator.go:311-321). On a tty: carriage-return updates,
+    rate-limited. On a non-tty stream (log files, pipes): whole lines at 10%
+    steps, so logs never fill with control-character frames."""
 
     def __init__(self, title: str, total: int, enabled: bool, stream=None) -> None:
         import sys
@@ -82,21 +83,34 @@ class Progress:
         self.done = 0
         self.enabled = enabled and total > 0
         self.stream = stream if stream is not None else sys.stderr
+        try:
+            self._tty = bool(self.stream.isatty())
+        except (AttributeError, ValueError):
+            self._tty = False
         self._last_render = 0.0
+        self._last_pct = -1
 
     def advance(self, n: int) -> None:
         if not self.enabled:
             return
         self.done += n
-        now = time.perf_counter()
-        # rate-limit renders; always render the final state
-        if self.done < self.total and now - self._last_render < 0.1:
-            return
-        self._last_render = now
         pct = int(self.done / self.total * 100)
-        print(f"\r{self.title} {self.done}/{self.total} ({pct}%)",
-              end="", file=self.stream, flush=True)
+        if self._tty:
+            now = time.perf_counter()
+            # rate-limit renders; always render the final state
+            if self.done < self.total and now - self._last_render < 0.1:
+                return
+            self._last_render = now
+            print(f"\r{self.title} {self.done}/{self.total} ({pct}%)",
+                  end="", file=self.stream, flush=True)
+        else:
+            # one line per 10% step (and the final state), newline-terminated
+            if pct // 10 == self._last_pct // 10 and self.done < self.total:
+                return
+            self._last_pct = pct
+            print(f"{self.title} {self.done}/{self.total} ({pct}%)",
+                  file=self.stream, flush=True)
 
     def close(self) -> None:
-        if self.enabled and self.done:
+        if self.enabled and self.done and self._tty:
             print(file=self.stream, flush=True)

@@ -207,3 +207,16 @@ def test_library_chart_rejected(tmp_path):
     (root / "Chart.yaml").write_text("name: lib\nversion: 0.1.0\ntype: library\n")
     with pytest.raises(ChartError):
         render_chart(load_chart(str(root)))
+
+
+def test_assign_requires_declaration():
+    """text/template semantics: `$x = v` without `$x :=` is an error; after a
+    declaration, `=` assigns to the nearest enclosing scope."""
+    import pytest
+
+    from open_simulator_tpu.chart.gotmpl import TemplateError, render_template
+
+    ok = render_template('{{ $x := 1 }}{{ $x = 2 }}{{ $x }}', {})
+    assert ok.strip() == "2"
+    with pytest.raises(TemplateError):
+        render_template('{{ $y = 2 }}', {})

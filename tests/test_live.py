@@ -1,0 +1,137 @@
+"""Live-cluster client: paginated LIST (limit/continue) and exec-credential
+auth, against an in-process fake apiserver — the hardening behind the
+reference's 3,000+-node claim (changelogs/v0.1.3.md)."""
+
+import json
+import os
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from open_simulator_tpu.simulator.live import (
+    KubeClient,
+    LiveClusterError,
+    create_cluster_resource_from_client,
+)
+
+
+def fake_apiserver(n_nodes=7, page=3, require_token=None):
+    """Serves /api/v1/nodes with limit/continue pagination; other LISTs empty.
+    Returns (httpd, port, seen_requests)."""
+    nodes = [{"metadata": {"name": f"n{i}"},
+              "status": {"allocatable": {"cpu": "1", "memory": "1Gi", "pods": "10"}}}
+             for i in range(n_nodes)]
+    seen = []
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            u = urlparse(self.path)
+            q = {k: v[0] for k, v in parse_qs(u.query).items()}
+            seen.append((u.path, q, self.headers.get("Authorization")))
+            if require_token and self.headers.get("Authorization") != f"Bearer {require_token}":
+                self.send_response(401)
+                self.end_headers()
+                return
+            if u.path == "/api/v1/nodes":
+                limit = int(q.get("limit", 0)) or len(nodes)
+                start = int(q.get("continue", 0))
+                items = nodes[start:start + limit]
+                nxt = start + limit
+                body = {"kind": "NodeList", "apiVersion": "v1", "items": items,
+                        "metadata": ({"continue": str(nxt)} if nxt < len(nodes) else {})}
+            else:
+                kind = "PodList" if "pods" in u.path else "List"
+                body = {"kind": kind, "apiVersion": "v1", "items": [], "metadata": {}}
+            data = json.dumps(body).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, httpd.server_address[1], seen
+
+
+def write_kubeconfig(tmp_path, port, user=None):
+    cfg = {
+        "current-context": "c",
+        "contexts": [{"name": "c", "context": {"cluster": "cl", "user": "u"}}],
+        "clusters": [{"name": "cl", "cluster": {"server": f"http://127.0.0.1:{port}"}}],
+        "users": [{"name": "u", "user": user or {}}],
+    }
+    p = tmp_path / "kubeconfig"
+    import yaml
+
+    p.write_text(yaml.safe_dump(cfg))
+    return str(p)
+
+
+def test_paginated_list_fetches_all_pages(tmp_path):
+    httpd, port, seen = fake_apiserver(n_nodes=7, page=3)
+    try:
+        client = KubeClient(write_kubeconfig(tmp_path, port))
+        client.PAGE_LIMIT = 3
+        nodes = client.list("/api/v1/nodes")
+        assert [n["metadata"]["name"] for n in nodes] == [f"n{i}" for i in range(7)]
+        # TypeMeta restored on every item from every page
+        assert all(n["kind"] == "Node" and n["apiVersion"] == "v1" for n in nodes)
+        node_reqs = [(p, q) for p, q, _ in seen if p == "/api/v1/nodes"]
+        assert len(node_reqs) == 3  # 3 + 3 + 1
+        assert all(q.get("limit") == "3" for _, q in node_reqs)
+        assert node_reqs[1][1].get("continue") == "3"
+    finally:
+        httpd.shutdown()
+
+
+def test_full_snapshot_uses_pagination(tmp_path):
+    httpd, port, seen = fake_apiserver(n_nodes=5)
+    try:
+        client = KubeClient(write_kubeconfig(tmp_path, port))
+        client.PAGE_LIMIT = 2
+        rt = create_cluster_resource_from_client(client)
+        assert len(rt.nodes) == 5
+        pod_reqs = [q for p, q, _ in seen if p == "/api/v1/pods"]
+        # pagination params present; no resourceVersion=0 (it disables limit)
+        assert all("resourceVersion" not in q for q in pod_reqs)
+        assert all(q.get("limit") == "2" for q in pod_reqs)
+    finally:
+        httpd.shutdown()
+
+
+def test_exec_credential_token(tmp_path):
+    httpd, port, seen = fake_apiserver(n_nodes=2, require_token="exec-tok-123")
+    try:
+        plugin = tmp_path / "cred.py"
+        plugin.write_text(
+            "import json, os\n"
+            "assert 'KUBERNETES_EXEC_INFO' in os.environ\n"
+            "print(json.dumps({'apiVersion': 'client.authentication.k8s.io/v1beta1',"
+            "'kind': 'ExecCredential', 'status': {'token': 'exec-tok-123'}}))\n")
+        user = {"exec": {
+            "apiVersion": "client.authentication.k8s.io/v1beta1",
+            "command": sys.executable,
+            "args": [str(plugin)],
+            "env": [{"name": "CRED_MODE", "value": "token"}],
+        }}
+        client = KubeClient(write_kubeconfig(tmp_path, port, user=user))
+        nodes = client.list("/api/v1/nodes")
+        assert len(nodes) == 2
+        assert all(auth == "Bearer exec-tok-123" for _, _, auth in seen)
+    finally:
+        httpd.shutdown()
+
+
+def test_exec_credential_failure_is_loud(tmp_path):
+    user = {"exec": {"command": sys.executable,
+                     "args": ["-c", "import sys; sys.exit(3)"]}}
+    with pytest.raises(LiveClusterError) as e:
+        KubeClient(write_kubeconfig(tmp_path, 1, user=user))
+    assert "exec credential" in str(e.value)

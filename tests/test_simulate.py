@@ -391,3 +391,102 @@ class TestReviewRegressions:
         res = simulate(cluster, [])
         assert res.all_scheduled  # early fits before hog commits; node ends overcommitted
         assert len(pods_per_node(res)["w0"]) == 2
+
+
+# ------------------------------------------------- preemption/volume inertness ----
+
+
+def test_uniform_priorities_no_warning(caplog):
+    import logging
+
+    from open_simulator_tpu.simulator.engine import Simulator
+
+    nodes = [make_node("n0")]
+    pods = [make_pod(f"p{i}", cpu="100m", memory="128Mi") for i in range(3)]
+    for p in pods:
+        p["spec"]["priority"] = 1000  # one class: preemption provably inert
+    with caplog.at_level(logging.WARNING, logger="open_simulator_tpu"):
+        Simulator(nodes).schedule_pods(pods)
+    assert not [r for r in caplog.records if "preemption" in r.getMessage()]
+
+
+def test_mixed_priorities_warn_loudly(caplog):
+    import logging
+
+    from open_simulator_tpu.simulator.engine import Simulator
+
+    nodes = [make_node("n0")]
+    pods = [make_pod(f"p{i}", cpu="100m", memory="128Mi") for i in range(3)]
+    pods[0]["spec"]["priority"] = 1000
+    pods[1]["spec"]["priority"] = 0
+    with caplog.at_level(logging.WARNING, logger="open_simulator_tpu"):
+        Simulator(nodes).schedule_pods(pods)
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any("preemption" in m and "not simulated" in m for m in msgs)
+
+
+def test_pvc_volumes_rewritten_to_hostpath():
+    """MakeValidPod parity (pkg/utils/utils.go:378-463): every PVC volume
+    becomes hostPath /tmp before scheduling, so the volume filter plugins
+    (VolumeBinding/NodeVolumeLimits/VolumeZone/VolumeRestrictions) have no PVC
+    to act on for ANY reachable input — they are inert by construction (see
+    PARITY.md 'Volume filter plugins')."""
+    from open_simulator_tpu.core.types import ResourceTypes
+    from open_simulator_tpu.models.workloads import expand_workloads_excluding_daemonsets
+
+    dep = {
+        "kind": "Deployment", "apiVersion": "apps/v1",
+        "metadata": {"name": "db", "namespace": "default"},
+        "spec": {
+            "replicas": 2,
+            "selector": {"matchLabels": {"app": "db"}},
+            "template": {
+                "metadata": {"labels": {"app": "db"}},
+                "spec": {
+                    "containers": [{"name": "c", "image": "db:1", "resources": {
+                        "requests": {"cpu": "100m", "memory": "128Mi"}}}],
+                    "volumes": [
+                        {"name": "data",
+                         "persistentVolumeClaim": {"claimName": "db-data"}},
+                        {"name": "cfg", "configMap": {"name": "db-cfg"}},
+                    ],
+                },
+            },
+        },
+    }
+    rt = ResourceTypes()
+    rt.deployments = [dep]
+    pods = expand_workloads_excluding_daemonsets(rt)
+    assert len(pods) == 2
+    for p in pods:
+        vols = p["spec"]["volumes"]
+        data = next(v for v in vols if v["name"] == "data")
+        assert "persistentVolumeClaim" not in data
+        assert data["hostPath"] == {"path": "/tmp"}
+        cfg = next(v for v in vols if v["name"] == "cfg")
+        assert "configMap" in cfg  # only PVC volumes are rewritten
+    # and such pods schedule without any volume filtering
+    from open_simulator_tpu.simulator.engine import Simulator
+
+    failed = Simulator([make_node("n0")]).schedule_pods(pods)
+    assert not failed
+
+
+def test_mixed_priorities_across_batches_warn(caplog):
+    """Cluster pods and app pods are scheduled in separate calls; a priority
+    gap BETWEEN the sets must still warn (the seen-set persists on the
+    Simulator)."""
+    import logging
+
+    from open_simulator_tpu.simulator.engine import Simulator
+
+    nodes = [make_node("n0")]
+    low = [make_pod("low", cpu="100m", memory="128Mi")]
+    high = [make_pod("high", cpu="100m", memory="128Mi")]
+    high[0]["spec"]["priority"] = 1000
+    sim = Simulator(nodes)
+    with caplog.at_level(logging.WARNING, logger="open_simulator_tpu"):
+        sim.schedule_pods(low)
+        sim.schedule_pods(high)
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any("preemption" in m for m in msgs)

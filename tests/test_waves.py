@@ -437,3 +437,15 @@ def test_gpu_wave_segments_are_waves():
     segs = sim._segments(bt, len(pods))
     assert [s[0] for s in segs] == ["wave"]
     assert segs[0][5] is True  # gpu_live
+
+
+def test_wave_f32_ulp_stress():
+    # odd capacities and request sizes drive cumulative f32 rounding close to
+    # ULP boundaries; the wave score table multiplies (j * req) where serial
+    # accumulates one pod at a time, so census equality here guards the eps
+    # slack in the NodeResourcesFit bound (ADVICE r2: ULP stress)
+    nodes = [make_node(f"odd{i}", cpu=f"{3001 + 7 * i}m",
+                       memory=str((7 << 30) + 4097 * i)) for i in range(9)]
+    pods = replicas("ulp", 260, cpu="77m", memory=str((333 << 20) + 13))
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
