@@ -86,11 +86,13 @@ class Tables(NamedTuple):
     mask_taint: jax.Array
     mask_unsched: jax.Array
     mask_aff: jax.Array
+    mask_extra: jax.Array  # [G, N] bool: out-of-tree plugin filters (static)
     simon_raw: jax.Array
     nodeaff_raw: jax.Array
     taint_raw: jax.Array
     avoid_raw: jax.Array
     image_raw: jax.Array
+    extra_raw: jax.Array  # [G, N] f32: out-of-tree plugin score sum (static)
     grp_requests: jax.Array
     grp_nonzero: jax.Array
     grp_unknown: jax.Array
@@ -397,6 +399,7 @@ def feasibility(
         "taint": tb.mask_taint[g],
         "unsched": tb.mask_unsched[g],
         "affinity": tb.mask_aff[g],
+        "extra": tb.mask_extra[g],
         "fit": fit,
         "fit_each": fit_each,
         "ports": ~conflict,
@@ -526,6 +529,7 @@ def scores(
         + w.pts * pts
         + w.avoid * tb.avoid_raw[g]
         + w.image * tb.image_raw[g]
+        + tb.extra_raw[g]  # out-of-tree plugins, pre-weighted at encode time
     )
     return total
 
@@ -671,7 +675,8 @@ def _wave_statics(tb: Tables, cry: Carry, g, w: ScoreWeights = DEFAULT_WEIGHTS):
         "simon_s": _flr(100.0 * tb.simon_raw[g]),
         "na_raw": tb.nodeaff_raw[g],
         "t_raw": tb.taint_raw[g],
-        "static": w.avoid * tb.avoid_raw[g] + w.image * tb.image_raw[g],
+        "static": (w.avoid * tb.avoid_raw[g] + w.image * tb.image_raw[g]
+                   + tb.extra_raw[g]),
     }
 
 

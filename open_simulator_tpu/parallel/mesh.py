@@ -71,8 +71,9 @@ def table_shardings(mesh: Mesh) -> kernels.Tables:
         alloc=s(P(NODE_AXIS, None)),
         node_zone=s(P(NODE_AXIS)),
         static_mask=s(n), mask_taint=s(n), mask_unsched=s(n), mask_aff=s(n),
+        mask_extra=s(n),
         simon_raw=s(n), nodeaff_raw=s(n), taint_raw=s(n), avoid_raw=s(n),
-        image_raw=s(n),
+        image_raw=s(n), extra_raw=s(n),
         grp_requests=s(r), grp_nonzero=s(r), grp_unknown=s(r), grp_ports=s(r),
         counter_dom=s(n), counter_sel_match_g=s(r),
         req_aff_t=s(r), grp_aff_self=s(r), req_anti_t=s(r),

@@ -1,0 +1,52 @@
+"""Out-of-tree plugin extension point.
+
+The reference's library API accepts extra scheduler-framework registries
+(`WithFrameworkOutOfTreeRegistry`, /root/reference/pkg/simulator/
+simulator.go:190-213 + the `extraRegistry` option :471-500) so embedders can
+add their own filter/score plugins. The TPU-native equivalent: a plugin
+contributes a per-(pod-template, node) FILTER verdict and/or a raw SCORE,
+evaluated once per scheduling group at encode time and folded into the static
+device tables — zero cost per scheduling step, and batched/wave/mesh paths all
+honor it automatically.
+
+Boundary (documented, deliberate): verdicts may depend only on the pod
+template and the node object — not on placement state. Every state-dependent
+plugin the reference ships (Simon, Open-Local, Open-Gpu-Share, the default
+set) is already built into the kernels; the out-of-tree surface exists for
+custom extended resources, label policies, and cost models, which are
+(pod, node)-static in the reference's registry users too.
+
+Usage::
+
+    class FpgaPlugin(SimulatorPlugin):
+        name = "example.com/fpga"
+        def filter(self, pod, node):
+            want = int(pod_requests(pod).get("example.com/fpga", 0))
+            have = int(allocatable(node).get("example.com/fpga", 0))
+            return want <= have
+        def score(self, pod, node):
+            return 100.0 - usage_pct(node)
+
+    simulate(cluster, apps, extra_plugins=[FpgaPlugin()])
+"""
+
+from __future__ import annotations
+
+
+class SimulatorPlugin:
+    """Base class for out-of-tree plugins. Override `filter` and/or `score`.
+
+    - `filter(pod, node) -> bool`: False removes the node for this pod
+      (reported as "filtered out by an out-of-tree plugin" in FitErrors).
+    - `score(pod, node) -> float`: raw score added to the node's total,
+      multiplied by `weight`. Convention: 0..100 like framework plugins.
+    """
+
+    name: str = "out-of-tree"
+    weight: float = 1.0
+
+    def filter(self, pod: dict, node: dict) -> bool:  # pragma: no cover - default
+        return True
+
+    def score(self, pod: dict, node: dict) -> float:  # pragma: no cover - default
+        return 0.0

@@ -23,6 +23,7 @@ def simulate(
     disable_progress: bool = True,
     patch_pod_funcs: Optional[List[Callable]] = None,
     sched_config=None,
+    extra_plugins: Optional[List] = None,
 ) -> SimulateResult:
     """Run one full simulation; returns placements + unschedulable pods.
 
@@ -41,7 +42,8 @@ def simulate(
         span.step("expand cluster workloads")
 
         sim = Simulator(cluster.nodes, disable_progress=disable_progress,
-                        patch_pod_funcs=patch_pod_funcs, sched_config=sched_config)
+                        patch_pod_funcs=patch_pod_funcs, sched_config=sched_config,
+                        extra_plugins=extra_plugins)
         result = sim.run_cluster(cluster)
         span.step("sync cluster")
         failed = list(result.unscheduled_pods)

@@ -487,11 +487,13 @@ class GroupInfo:
     mask_taint: np.ndarray        # [N] bool  (component masks kept for diagnostics)
     mask_unsched: np.ndarray      # [N] bool
     mask_aff: np.ndarray          # [N] bool
+    mask_extra: np.ndarray        # [N] bool (out-of-tree plugin filters)
     simon_raw: np.ndarray         # [N] f32 (0..1+ max share)
     nodeaff_raw: np.ndarray       # [N] f32
     taint_raw: np.ndarray         # [N] f32
     avoid_raw: np.ndarray         # [N] f32 (0 or 100)
     image_raw: np.ndarray         # [N] f32 (0..100)
+    extra_raw: np.ndarray         # [N] f32: out-of-tree plugin score sum
     # term slots (counter ids + params)
     req_aff: List[int] = field(default_factory=list)
     req_anti: List[int] = field(default_factory=list)
@@ -532,6 +534,8 @@ class Encoder:
         # --default-scheduler-config disables for the statically-folded filter
         # plugins (taints/unschedulable/node-affinity); set by the engine
         self.filter_disabled: frozenset = frozenset()
+        # out-of-tree plugin objects (see plugins/registry.py), set by the engine
+        self.extra_plugins: list = []
 
     # -- interning ---------------------------------------------------------------
 
@@ -562,6 +566,13 @@ class Encoder:
 
     def group_of(self, pod: dict) -> int:
         sig = scheduling_signature(pod)
+        if self.extra_plugins:
+            # out-of-tree plugins may read any template content; the built-in
+            # signature only covers the fields the built-in plugins read, so
+            # widen the group key with the full annotations (the plugin
+            # contract: verdicts depend on template content — spec, labels,
+            # annotations, namespace — never on pod identity like name/uid)
+            sig = (sig, _freeze((pod.get("metadata") or {}).get("annotations")))
         gi = self.groups.get(sig)
         if gi is None:
             gi = len(self.group_list)
@@ -605,8 +616,25 @@ class Encoder:
             taint_raw=prefer_cnt,
             avoid_raw=self._avoid_raw(pod),
             image_raw=self._image_raw(pod),
+            extra_raw=np.zeros(na.N, np.float32),
+            mask_extra=np.ones(na.N, bool),
             aff_self=True,
         )
+        # out-of-tree plugins (extension point parity: the reference's library
+        # API accepts extra framework registries, simulator.go:471-500). Their
+        # verdicts depend only on (pod template, node), so they fold into the
+        # static tables and cost nothing per scheduling step.
+        for pl in self.extra_plugins:
+            w = float(getattr(pl, "weight", 1.0))
+            flt = getattr(pl, "filter", None)
+            score = getattr(pl, "score", None)
+            for i, node in enumerate(na.nodes):
+                if flt is not None and not flt(pod, node):
+                    g.mask_extra[i] = False
+                if score is not None:
+                    g.extra_raw[i] += w * float(score(pod, node))
+        g.static_mask = g.static_mask & g.mask_extra
+
         from ..plugins.gpushare import gpu_id_str_to_list, pod_gpu_count, pod_gpu_index, pod_gpu_mem
 
         g.gpu_mem = float(pod_gpu_mem(pod))
@@ -810,11 +838,13 @@ class BatchTables:
     mask_taint: np.ndarray       # [G, N] bool
     mask_unsched: np.ndarray     # [G, N] bool
     mask_aff: np.ndarray         # [G, N] bool
+    mask_extra: np.ndarray       # [G, N] bool
     simon_raw: np.ndarray        # [G, N] f32
     nodeaff_raw: np.ndarray      # [G, N] f32
     taint_raw: np.ndarray        # [G, N] f32
     avoid_raw: np.ndarray        # [G, N] f32
     image_raw: np.ndarray        # [G, N] f32
+    extra_raw: np.ndarray        # [G, N] f32: out-of-tree plugin scores
     grp_requests: np.ndarray     # [G, R] f32
     grp_nonzero: np.ndarray      # [G, 2] f32
     grp_unknown: np.ndarray      # [G] bool
@@ -934,11 +964,13 @@ def pad_batch_tables(bt: "BatchTables", multiple: int) -> "BatchTables":
         mask_taint=_pad_axis(bt.mask_taint, 1, target, False),
         mask_unsched=_pad_axis(bt.mask_unsched, 1, target, False),
         mask_aff=_pad_axis(bt.mask_aff, 1, target, False),
+        mask_extra=_pad_axis(bt.mask_extra, 1, target, False),
         simon_raw=_pad_axis(bt.simon_raw, 1, target, 0.0),
         nodeaff_raw=_pad_axis(bt.nodeaff_raw, 1, target, 0.0),
         taint_raw=_pad_axis(bt.taint_raw, 1, target, 0.0),
         avoid_raw=_pad_axis(bt.avoid_raw, 1, target, 0.0),
         image_raw=_pad_axis(bt.image_raw, 1, target, 0.0),
+        extra_raw=_pad_axis(bt.extra_raw, 1, target, 0.0),
         counter_dom=_pad_axis(bt.counter_dom, 1, target, D),
         carr_dom=_pad_axis(bt.carr_dom, 1, target, D),
         dev_total=_pad_axis(bt.dev_total, 0, target, 0.0),
@@ -1000,11 +1032,13 @@ def pad_encoder_axes(bt: "BatchTables") -> "BatchTables":
         mask_taint=pad_axis(bt.mask_taint, 0, Gp, False),
         mask_unsched=pad_axis(bt.mask_unsched, 0, Gp, False),
         mask_aff=pad_axis(bt.mask_aff, 0, Gp, False),
+        mask_extra=pad_axis(bt.mask_extra, 0, Gp, False),
         simon_raw=pad_axis(bt.simon_raw, 0, Gp, 0.0),
         nodeaff_raw=pad_axis(bt.nodeaff_raw, 0, Gp, 0.0),
         taint_raw=pad_axis(bt.taint_raw, 0, Gp, 0.0),
         avoid_raw=pad_axis(bt.avoid_raw, 0, Gp, 0.0),
         image_raw=pad_axis(bt.image_raw, 0, Gp, 0.0),
+        extra_raw=pad_axis(bt.extra_raw, 0, Gp, 0.0),
         grp_requests=pad_axis(bt.grp_requests, 0, Gp, 0.0),
         grp_nonzero=pad_axis(bt.grp_nonzero, 0, Gp, 0.0),
         grp_unknown=pad_axis(bt.grp_unknown, 0, Gp, False),
@@ -1215,11 +1249,13 @@ def build_batch_tables(
         mask_taint=(np.stack([g.mask_taint for g in groups]) if groups else np.zeros((G, N), bool)),
         mask_unsched=(np.stack([g.mask_unsched for g in groups]) if groups else np.zeros((G, N), bool)),
         mask_aff=(np.stack([g.mask_aff for g in groups]) if groups else np.zeros((G, N), bool)),
+        mask_extra=(np.stack([g.mask_extra for g in groups]) if groups else np.zeros((G, N), bool)),
         simon_raw=stack("simon_raw"),
         nodeaff_raw=stack("nodeaff_raw"),
         taint_raw=stack("taint_raw"),
         avoid_raw=stack("avoid_raw"),
         image_raw=stack("image_raw"),
+        extra_raw=stack("extra_raw"),
         grp_requests=(
             np.stack([g.requests for g in groups]) if groups else np.zeros((G, R), np.float32)
         ),

@@ -130,6 +130,7 @@ class Simulator:
         patch_pod_funcs: Optional[List[Callable]] = None,
         sched_config=None,
         use_mesh: Optional[bool] = None,
+        extra_plugins: Optional[List] = None,
     ) -> None:
         """use_mesh: shard the node axis over every visible accelerator
         (parallel/mesh.py). None = auto: shard whenever >1 device is visible
@@ -156,6 +157,7 @@ class Simulator:
         self.na = NodeArrays(nodes, self.axis)
         self.encoder = Encoder(self.na, self.axis, self.model)
         self.encoder.filter_disabled = self.sched_config.disabled_encoder_filters
+        self.encoder.extra_plugins = list(extra_plugins or [])
         from ..plugins.gpushare import GpuShareHost
         from ..plugins.openlocal import OpenLocalHost
 
@@ -694,6 +696,7 @@ class Simulator:
         ("unsched", "node(s) were unschedulable"),
         ("taint", None),  # expanded per-taint below
         ("affinity", "node(s) didn't match node selector"),
+        ("extra", "node(s) were filtered out by an out-of-tree plugin"),
         ("ports", "node(s) didn't have free ports for the requested pod ports"),
         ("fit", None),  # expanded per-resource below
         ("spread", "node(s) didn't match pod topology spread constraints"),

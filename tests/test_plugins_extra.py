@@ -1,0 +1,94 @@
+"""Out-of-tree plugin extension point (WithFrameworkOutOfTreeRegistry parity,
+simulator.go:471-500): custom filter/score plugins fold into the static
+tables and work identically through serial, wave, and simulate() paths."""
+
+import copy
+
+from open_simulator_tpu.core.types import AppResource, ResourceTypes
+from open_simulator_tpu.plugins.registry import SimulatorPlugin
+from open_simulator_tpu.simulator.core import simulate
+from open_simulator_tpu.simulator.engine import Simulator
+
+from fixtures import make_node, make_pod
+
+
+class FpgaFilter(SimulatorPlugin):
+    """Extended resource the kernel knows nothing about: pods requesting
+    example.com/fpga only fit nodes advertising enough."""
+
+    name = "example.com/fpga"
+
+    def filter(self, pod, node):
+        want = int((pod.get("metadata", {}).get("annotations") or {})
+                   .get("example.com/fpga", 0))
+        have = int(((node.get("status") or {}).get("allocatable") or {})
+                   .get("example.com/fpga", 0))
+        return want <= have
+
+
+class PreferLabeled(SimulatorPlugin):
+    name = "prefer-labeled"
+    weight = 1000.0  # dominate the built-in scores
+
+    def score(self, pod, node):
+        lbls = (node.get("metadata") or {}).get("labels") or {}
+        return 100.0 if lbls.get("tier") == "gold" else 0.0
+
+
+def test_extra_filter_blocks_and_reports():
+    nodes = [make_node("plain"),
+             make_node("fpga", extra_resources={"example.com/fpga": "2"})]
+    pods = [make_pod(f"p{i}", cpu="100m", memory="128Mi",
+                     annotations={"example.com/fpga": "1"}) for i in range(3)]
+    sim = Simulator(copy.deepcopy(nodes), extra_plugins=[FpgaFilter()])
+    failed = sim.schedule_pods(copy.deepcopy(pods))
+    assert not failed
+    assert all(len(p) == 0 for p in [sim.pods_on_node[0]])  # plain got nothing
+    assert len(sim.pods_on_node[1]) == 3
+
+    # unsatisfiable request: the FitError names the out-of-tree plugin
+    big = [make_pod("big", cpu="100m", memory="128Mi",
+                    annotations={"example.com/fpga": "5"})]
+    failed = sim.schedule_pods(copy.deepcopy(big))
+    assert len(failed) == 1
+    assert "out-of-tree plugin" in failed[0].reason
+
+
+def test_extra_score_changes_placement():
+    nodes = [make_node("silver"), make_node("gold", labels={"tier": "gold"})]
+    pods = [make_pod("p", cpu="100m", memory="128Mi")]
+    base = Simulator(copy.deepcopy(nodes))
+    base.schedule_pods(copy.deepcopy(pods))
+    assert len(base.pods_on_node[0]) == 1  # lowest-index tie-break by default
+
+    boosted = Simulator(copy.deepcopy(nodes), extra_plugins=[PreferLabeled()])
+    boosted.schedule_pods(copy.deepcopy(pods))
+    assert len(boosted.pods_on_node[1]) == 1  # plugin score wins
+
+
+def test_extra_plugins_wave_serial_equal():
+    nodes = [make_node(f"n{i}", labels=({"tier": "gold"} if i % 3 == 0 else {}),
+                       cpu="4", memory="8Gi") for i in range(6)]
+    pods = [make_pod(f"w{i}", cpu="250m", memory="256Mi", labels={"app": "w"})
+            for i in range(30)]
+    results = []
+    for waves in (True, False):
+        sim = Simulator(copy.deepcopy(nodes), extra_plugins=[PreferLabeled()])
+        sim.use_waves = waves
+        failed = sim.schedule_pods(copy.deepcopy(pods))
+        results.append(([len(p) for p in sim.pods_on_node], len(failed)))
+    assert results[0] == results[1]
+
+
+def test_simulate_facade_accepts_extra_plugins():
+    cluster = ResourceTypes()
+    cluster.nodes = [make_node("plain"),
+                     make_node("fpga", extra_resources={"example.com/fpga": "4"})]
+    app = ResourceTypes()
+    app.pods = [make_pod("p0", cpu="100m", memory="128Mi",
+                         annotations={"example.com/fpga": "1"})]
+    res = simulate(cluster, [AppResource(name="a", resource=app)],
+                   extra_plugins=[FpgaFilter()])
+    assert not res.unscheduled_pods
+    placed = {ns.node["metadata"]["name"]: len(ns.pods) for ns in res.node_status}
+    assert placed == {"plain": 0, "fpga": 1}
