@@ -1,6 +1,9 @@
 """Device-mesh parallelism: shard the node axis (and scenario axis) of the batched
-scheduler over a jax.sharding.Mesh. See mesh.py for the design notes."""
+scheduler over a jax.sharding.Mesh. See mesh.py for the single-host design notes
+and distributed.py for the multi-host (jax.distributed + DCN) layout."""
 
+from .distributed import initialize as initialize_distributed
+from .distributed import make_global_mesh, node_mesh_local
 from .mesh import (
     NODE_AXIS,
     SCENARIO_AXIS,
@@ -15,6 +18,9 @@ from .mesh import (
 )
 
 __all__ = [
+    "initialize_distributed",
+    "make_global_mesh",
+    "node_mesh_local",
     "NODE_AXIS",
     "SCENARIO_AXIS",
     "make_node_mesh",

@@ -1,0 +1,95 @@
+"""Multi-host scaling: jax.distributed + DCN-aware meshes.
+
+The reference is a single Go process; SURVEY.md §2.3 and BASELINE's north star
+ask this framework to scale past one host the way distributed schedulers do.
+The TPU-native design has two parallel axes with very different communication
+profiles, and the mesh layout maps each onto the right fabric:
+
+- **node axis ("nodes")**: the cluster's node dimension. Filtering/scoring is
+  embarrassingly parallel per node; the per-step collectives (score
+  normalizer min/max, winner argmax, counter broadcasts) are small
+  all-reduces that must be CHEAP -> this axis lives on ICI (the chips within
+  one slice/host).
+- **scenario axis ("scenarios")**: independent what-if simulations (capacity
+  probes, the server's concurrent requests). ZERO cross-scenario
+  communication -> this axis rides DCN across hosts, where bandwidth is
+  scarce but independence makes that irrelevant.
+
+`initialize()` wraps jax.distributed.initialize with the standard env
+conventions; `make_global_mesh()` builds the (scenarios, nodes) mesh with the
+scenario axis over hosts (DCN) and the node axis within each host (ICI),
+falling back to a flat single-host mesh when there is one process. The layout
+recipe is the scaling-book one: pick the mesh, annotate shardings
+(parallel/mesh.py), let XLA insert the collectives.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from .mesh import NODE_AXIS, SCENARIO_AXIS
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join (or form) a multi-host JAX cluster. Arguments fall back to the
+    standard env vars (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID, or the TPU pod metadata on Cloud TPU). Returns True when
+    running distributed (process_count > 1), False for single-process runs —
+    in which case this is a no-op, so callers can invoke it unconditionally."""
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address or (num_processes or 0) > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return jax.process_count() > 1
+
+
+def make_global_mesh(scenario_axis: Optional[int] = None, devices=None):
+    """A (scenarios, nodes) jax.sharding.Mesh over every device in the job.
+
+    Multi-process: the scenario axis spans process groups (DCN) and the node
+    axis the devices within each process (ICI) — jax.devices() orders devices
+    by process, so reshaping to (n_procs * k, per_proc // k) keeps each node
+    shard intra-host. Single-process: scenario_axis (default 1) splits the
+    local devices. Returns a Mesh usable by schedule_batch_on_mesh /
+    schedule_scenarios_on_mesh and the engine's product path."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    n_procs = getattr(jax, "process_count", lambda: 1)()
+    if scenario_axis is None:
+        scenario_axis = n_procs if n_procs > 1 else 1
+    if n % scenario_axis:
+        raise ValueError(
+            f"{n} devices not divisible by scenario axis {scenario_axis}")
+    grid = np.asarray(devs).reshape(scenario_axis, n // scenario_axis)
+    return Mesh(grid, (SCENARIO_AXIS, NODE_AXIS))
+
+
+def node_mesh_local(devices=None):
+    """The single-axis node mesh over this process's addressable devices —
+    what the engine uses per-host when scenarios are farmed out at a higher
+    level (one capacity probe per host)."""
+    import jax
+
+    from .mesh import make_node_mesh
+
+    devs = list(devices) if devices is not None else jax.local_devices()
+    return make_node_mesh(len(devs), devices=devs)

@@ -252,3 +252,76 @@ class TestWorkloadExpansion:
         pods = W.generate_valid_pods_from_app("myapp", rt, nodes)
         assert len(pods) == 2 + 2 + 1
         assert all(O.labels_of(p)[C.LabelAppName] == "myapp" for p in pods)
+
+
+# --------------------------------------------------------- validation depth ----
+
+
+def test_validate_pod_labels_ports_tolerations():
+    import pytest
+
+    from open_simulator_tpu.utils.validate import ValidationError, validate_pod
+
+    def base():
+        return {
+            "metadata": {"name": "p", "namespace": "default", "labels": {}},
+            "spec": {"containers": [{"name": "c", "image": "i"}]},
+        }
+
+    p = base()
+    p["metadata"]["labels"] = {"bad key!": "v"}
+    with pytest.raises(ValidationError, match="label key"):
+        validate_pod(p)
+
+    p = base()
+    p["spec"]["containers"][0]["ports"] = [{"containerPort": 99999}]
+    with pytest.raises(ValidationError, match="containerPort"):
+        validate_pod(p)
+
+    p = base()
+    p["spec"]["containers"][0]["ports"] = [
+        {"containerPort": 80, "hostPort": 8080},
+        {"containerPort": 81, "hostPort": 8080},
+    ]
+    with pytest.raises(ValidationError, match="duplicate hostPort"):
+        validate_pod(p)
+
+    p = base()
+    p["spec"]["tolerations"] = [{"key": "k", "operator": "Exists", "value": "x"}]
+    with pytest.raises(ValidationError, match="operator Exists"):
+        validate_pod(p)
+
+    p = base()
+    p["spec"]["topologySpreadConstraints"] = [
+        {"maxSkew": 0, "topologyKey": "zone", "whenUnsatisfiable": "DoNotSchedule"}]
+    with pytest.raises(ValidationError, match="maxSkew"):
+        validate_pod(p)
+
+    p = base()
+    p["spec"]["affinity"] = {"nodeAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": {"nodeSelectorTerms": [
+            {"matchExpressions": [{"key": "k", "operator": "In", "values": []}]}]}}}
+    with pytest.raises(ValidationError, match="requires values"):
+        validate_pod(p)
+
+    p = base()
+    p["spec"]["volumes"] = [{"name": "v", "hostPath": {"path": "/tmp"}},
+                            {"name": "v", "hostPath": {"path": "/tmp"}}]
+    with pytest.raises(ValidationError, match="duplicate name"):
+        validate_pod(p)
+
+    validate_pod(base())  # a clean pod still validates
+
+
+def test_validate_node_taints_and_labels():
+    import pytest
+
+    from open_simulator_tpu.utils.validate import ValidationError, validate_node
+
+    node = {"metadata": {"name": "n", "labels": {"ok": "yes"}},
+            "spec": {"taints": [{"key": "k", "effect": "BadEffect"}]},
+            "status": {"allocatable": {"cpu": "1"}}}
+    with pytest.raises(ValidationError, match="invalid effect"):
+        validate_node(node)
+    node["spec"]["taints"][0]["effect"] = "NoSchedule"
+    validate_node(node)
