@@ -207,6 +207,30 @@ class Server:
             def do_GET(self):
                 if self.path == "/healthz":
                     self._send(200, {"message": "ok"})
+                elif self.path.startswith("/debug/pprof/profile"):
+                    # pprof-style CPU profile (server.go:152 registers pprof):
+                    # sample this process for ?seconds=N (default 5), return
+                    # pstats dump text sorted by cumulative time
+                    import cProfile
+                    import io
+                    import pstats
+                    import time as _t
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    seconds = min(float((q.get("seconds") or ["5"])[0]), 60.0)
+                    pr = cProfile.Profile()
+                    pr.enable()
+                    _t.sleep(seconds)
+                    pr.disable()
+                    buf = io.StringIO()
+                    pstats.Stats(pr, stream=buf).sort_stats("cumulative").print_stats(50)
+                    data = buf.getvalue().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
                 elif self.path == "/debug/vars":
                     # the profiling surface the reference exposes via pprof
                     # (server.go:152): uptime, rss, and recent traced phases

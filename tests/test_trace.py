@@ -97,3 +97,22 @@ def test_server_debug_vars():
         assert "max_rss_kb" in data
     finally:
         httpd.shutdown()
+
+
+def test_server_debug_pprof_profile():
+    import threading
+    import urllib.request
+
+    from open_simulator_tpu.server.http import Server
+
+    srv = Server.__new__(Server)
+    httpd = srv.build_httpd(port=0, host="127.0.0.1")
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/pprof/profile?seconds=0.1") as r:
+            text = r.read().decode()
+        assert "cumulative" in text  # a pstats table came back
+    finally:
+        httpd.shutdown()
