@@ -10,7 +10,9 @@ BENCH_DETAIL.json:
   - throughput_10k_1k:   config 2, 10k nginx pods / 1k nodes (round-1 headline)
   - gpushare_1k:         config 3, GPU-memory bin-packing on 1k GPU nodes
   - hard_predicates_50k_5k: config 4, 50k pods / 5k nodes with taints +
-    anti-affinity + zone topology spread (mixed wave/serial segments)
+    anti-affinity + zone topology spread (wave + fused group-serial segments)
+  - mesh8_cpu:           the mesh-sharded product path on an 8-device virtual
+    CPU mesh, with a placements-match check against single-device
   - capacity_plan_100k:  config 5, add-node auto-search until 100k pods fit
 
 All runs preserve the reference's serial placement semantics
@@ -129,6 +131,11 @@ def bench_mesh_cpu(n_nodes=1_000, n_pods=10_000, shards=8):
     code = f"""
 import json, os, sys, time
 sys.path.insert(0, {repr(__file__.rsplit('/', 1)[0])})
+# config-based CPU forcing BEFORE any backend init: some images inject an
+# accelerator plugin whose env-var platform override can hang at import
+from open_simulator_tpu.utils.devices import force_cpu_platform, request_cpu_devices
+request_cpu_devices({shards})
+force_cpu_platform()
 from open_simulator_tpu.utils.synth import synth_cluster
 from open_simulator_tpu.simulator.engine import Simulator
 
@@ -154,11 +161,8 @@ single.schedule_pods(copy.deepcopy(pods))
 print(json.dumps({{"rate": {n_pods} / best, "match": census(single) == mesh_census}}))
 """
     env = dict(os.environ)
-    env.update({
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": f"--xla_force_host_platform_device_count={shards}",
-        "OPEN_SIMULATOR_MESH": "1",
-    })
+    env.pop("JAX_PLATFORMS", None)  # see the subprocess preamble
+    env["OPEN_SIMULATOR_MESH"] = "1"
     try:
         out = subprocess.run(
             [sys.executable, "-c", code], env=env, capture_output=True,
