@@ -15,9 +15,10 @@ Behavioral parity notes:
   Services (simulator.go:252-267), so app services never feed SelectorSpread; cluster
   services do (syncClusterResourceList:365-447).
 - Unschedulable reasons are rebuilt from per-stage masks in the k8s FitError format
-  ("0/N nodes are available: ..."). They are computed against the end-of-batch state,
-  not the mid-batch state the reference would report (documented deviation; placement
-  itself is unaffected).
+  ("0/N nodes are available: ..."). They are computed against the end state of the
+  failing pod's SEGMENT — exact for wave/spread segments, whose failures happen at
+  segment end, and at most one serial segment away from the reference's per-attempt
+  state otherwise (documented deviation; placement itself is unaffected).
 """
 
 from __future__ import annotations
@@ -462,7 +463,7 @@ class Simulator:
         # np.asarray costs a full round trip — 50 segments used to spend ~7s
         # waiting on ~35ms of actual device work. `placed` is recovered on the
         # host as sum(counts), never fetched separately.
-        outs: List[tuple] = []  # (seg, device array: serial choices | counts)
+        outs: List[tuple] = []  # (seg, device array, carry AFTER the segment)
         for seg in segs:
             if seg[0] == "serial":
                 _, start, length = seg
@@ -479,7 +480,7 @@ class Simulator:
                     enable_storage=enable_storage,
                     w=self.score_w, filters=self.filter_flags,
                 )
-                outs.append((seg, ch))
+                outs.append((seg, ch, carry))
             elif seg[0] == "spread":
                 _, start, length, g, cap1 = seg
                 pad = bucket_capped(length, 2048)
@@ -489,7 +490,7 @@ class Simulator:
                     tables, carry, jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1),
                     w=self.score_w, filters=self.filter_flags,
                 )
-                outs.append((seg, counts))
+                outs.append((seg, counts, carry))
             else:
                 _, start, length, g, cap1, gpu_live = seg
                 carry, counts, _ = kernels.schedule_wave(
@@ -497,15 +498,21 @@ class Simulator:
                     jnp.asarray(cap1), gpu_live=gpu_live,
                     w=self.score_w, filters=self.filter_flags,
                 )
-                outs.append((seg, counts))
+                outs.append((seg, counts, carry))
         final_carry = carry
+        # carry snapshot per pod index's segment, for failure diagnosis against
+        # the state the pod actually failed under (the end of ITS segment) —
+        # much closer to the reference's mid-batch FitErrors than the
+        # end-of-batch state used before
+        seg_carry_of: Dict[int, object] = {}
         if outs:
-            flat = np.asarray(jnp.concatenate([a.astype(jnp.int32) for _, a in outs]))
+            flat = np.asarray(jnp.concatenate([a.astype(jnp.int32) for _, a, _ in outs]))
             off = 0
-            for seg, a in outs:
+            for k, (seg, a, seg_carry) in enumerate(outs):
                 part = flat[off:off + a.shape[0]]
                 off += a.shape[0]
                 start, length = seg[1], seg[2]
+                seg_carry_of[k] = seg_carry
                 if seg[0] == "serial":
                     choices[start:start + length] = part[:length]
                 else:
@@ -515,10 +522,13 @@ class Simulator:
                     # order; the (length - placed) unschedulable pods stay -1
                     assign = np.repeat(np.arange(counts.shape[0]), counts)
                     choices[start:start + placed] = assign[:placed]
+        seg_of = np.zeros(P, np.int32)
+        for k, (seg, _, _) in enumerate(outs):
+            seg_of[seg[1]:seg[1] + seg[2]] = k
         self._last_tables, self._last_carry = bt, final_carry
 
         progress = getattr(self, "_progress", None)
-        reason_cache: Dict[Tuple[int, int], Dict[str, int]] = {}
+        reason_cache: Dict[Tuple[int, int, int], Dict[str, int]] = {}
         for i, pod in enumerate(to_schedule):
             if progress is not None:
                 progress.advance(1)
@@ -527,12 +537,14 @@ class Simulator:
                 self._commit_pod(pod, node_i)
             else:
                 # Pods of one group share tolerations/requests, so the per-stage
-                # failure counts are identical — diagnose once per (group, forced).
-                key = (int(bt.pod_group[i]), int(bt.forced_node[i]))
+                # failure counts are identical — diagnose once per
+                # (group, forced, segment), against that segment's end state.
+                key = (int(bt.pod_group[i]), int(bt.forced_node[i]), int(seg_of[i]))
                 reasons = reason_cache.get(key)
                 if reasons is None:
                     reasons = reason_cache[key] = self._explain_reasons(
-                        pod, key[0], key[1], tables, final_carry
+                        pod, key[0], key[1], tables,
+                        seg_carry_of.get(int(seg_of[i]), final_carry)
                     )
                 pod.pop(SIG_MEMO_KEY, None)
                 failed.append(UnscheduledPod(pod, self._format_reason(pod, reasons, self.na.N)))

@@ -490,3 +490,25 @@ def test_mixed_priorities_across_batches_warn(caplog):
         sim.schedule_pods(high)
     msgs = [r.getMessage() for r in caplog.records]
     assert any("preemption" in m for m in msgs)
+
+
+def test_failure_reasons_use_segment_state():
+    """A pod failing in an early segment must be diagnosed against the state
+    it failed under, not the end-of-batch state: here the porty pods fail on
+    ports while the node still has cpu room, and a later segment fills the
+    cpu — the reason must say ports, not insufficient cpu."""
+    from open_simulator_tpu.simulator.engine import Simulator
+
+    nodes = [make_node("n0", cpu="4", memory="8Gi")]
+    porty = [make_pod(f"porty{i}", cpu="100m", memory="128Mi",
+                      labels={"app": "porty"}, host_ports=[8080])
+             for i in range(10)]
+    fillers = [make_pod(f"fill{i}", cpu="300m", memory="256Mi",
+                        labels={"app": "fill"}) for i in range(20)]
+    sim = Simulator(nodes)
+    failed = sim.schedule_pods(porty + fillers)
+    porty_failures = [f for f in failed if "porty" in f.pod["metadata"]["name"]]
+    assert porty_failures
+    for f in porty_failures:
+        assert "free ports" in f.reason
+        assert "Insufficient cpu" not in f.reason
