@@ -27,6 +27,7 @@ _SRC = os.path.join(_DIR, "_hashobj.cpp")
 _SO = os.path.join(_DIR, "_hashobj" + (sysconfig.get_config_var("EXT_SUFFIX") or ".so"))
 
 _canon_hash: Optional[Callable] = None
+_pod_sig: Optional[Callable] = None
 _tried = False
 
 
@@ -48,33 +49,47 @@ def _build() -> bool:
     return True
 
 
-def _load() -> Optional[Callable]:
+def _load():
     spec = importlib.util.spec_from_file_location("open_simulator_tpu.native._hashobj", _SO)
     if spec is None or spec.loader is None:
         return None
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    return mod.canon_hash
+    return mod
 
 
-def canon_hash_fn() -> Optional[Callable]:
-    """The native hash function, building it on first call; None when unavailable
-    (missing compiler, SIMON_NO_NATIVE=1, ...)."""
-    global _canon_hash, _tried
+def _ensure_built() -> None:
+    global _canon_hash, _pod_sig, _tried
     if _tried:
-        return _canon_hash
+        return
     _tried = True
     if os.environ.get("SIMON_NO_NATIVE"):
-        return None
+        return
     try:
         # <= so equal mtimes (e.g. both stamped by a checkout) rebuild: loading a
         # stale binary would silently change signature semantics
         stale = (not os.path.exists(_SO)
                  or os.path.getmtime(_SO) <= os.path.getmtime(_SRC))
         if stale and not _build():
-            return None
-        _canon_hash = _load()
+            return
+        mod = _load()
+        if mod is not None:
+            _canon_hash = mod.canon_hash
+            _pod_sig = getattr(mod, "pod_sig", None)
     except Exception as e:  # any failure → Python fallback
         logging.debug("native hash unavailable: %s", e)
-        _canon_hash = None
+        _canon_hash = _pod_sig = None
+
+
+def canon_hash_fn() -> Optional[Callable]:
+    """The native hash function, building it on first call; None when unavailable
+    (missing compiler, SIMON_NO_NATIVE=1, ...)."""
+    _ensure_built()
     return _canon_hash
+
+
+def pod_sig_fn() -> Optional[Callable]:
+    """The native one-call pod-signature function (extraction + hash); None when
+    the extension is unavailable."""
+    _ensure_built()
+    return _pod_sig

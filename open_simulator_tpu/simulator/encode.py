@@ -354,6 +354,10 @@ SIG_MEMO_KEY = "__sig_memo__"  # stamped by workload expansion; popped by the en
 
 _native_hash = "unresolved"
 
+# annotation keys that change Filter/commit behavior (plugins/) — part of the
+# signature subtree on both the native and computed paths
+_SIG_ANNO_KEYS = (C.AnnoGpuMem, C.AnnoGpuCount, C.AnnoGpuIndex, C.AnnoPodLocalStorage)
+
 
 def scheduling_signature(pod: dict):
     """Pods with equal signatures are interchangeable to every predicate and score.
@@ -361,10 +365,13 @@ def scheduling_signature(pod: dict):
 
     Fast paths, in order:
     1. workload memo — replicas of one template share a precomputed signature;
-    2. native canon_hash (C++, open_simulator_tpu/native) over the RAW
-       scheduling-relevant subtree. Raw hashing may split groups the computed
-       form would merge (e.g. "1000m" vs "1" cpu), which only duplicates
-       identical groups — never merges distinct ones;
+    2. native pod_sig (C++, open_simulator_tpu/native): one call that extracts
+       and canonically hashes the RAW scheduling-relevant subtree — namespace,
+       labels, nodeSelector, affinity, tolerations, topologySpreadConstraints,
+       nodeName, hostNetwork, containers, initContainers, overhead, sorted
+       owner kinds, and the extended-resource annotations. Raw hashing may
+       split groups the computed form would merge (e.g. "1000m" vs "1" cpu),
+       which only duplicates identical groups — never merges distinct ones;
     3. the pure-Python computed tuple.
     """
     memo = pod.get(SIG_MEMO_KEY)
@@ -373,30 +380,16 @@ def scheduling_signature(pod: dict):
 
     global _native_hash
     if _native_hash == "unresolved":
-        from ..native import canon_hash_fn
+        from ..native import pod_sig_fn
 
-        _native_hash = canon_hash_fn()
+        _native_hash = pod_sig_fn()
     spec = pod.get("spec") or {}
     if _native_hash is not None:
-        md = pod.get("metadata") or {}
-        anns = md.get("annotations") or {}
         try:
-            return _native_hash((
-                namespace_of(pod),
-                md.get("labels"),
-                spec.get("nodeSelector"),
-                spec.get("affinity"),
-                spec.get("tolerations"),
-                spec.get("topologySpreadConstraints"),
-                spec.get("nodeName"),
-                spec.get("hostNetwork"),  # turns containerPorts into host ports
-                spec.get("containers"),
-                spec.get("initContainers"),
-                spec.get("overhead"),
-                sorted({r.get("kind", "") for r in md.get("ownerReferences") or []}),
-                [anns.get(k) for k in
-                 (C.AnnoGpuMem, C.AnnoGpuCount, C.AnnoGpuIndex, C.AnnoPodLocalStorage)],
-            ))
+            # one C call: subtree extraction + canonical hash (native/_hashobj.cpp
+            # pod_sig) — hash-identical to canon_hash over the tuple listed in
+            # the docstring above, without the ~15 Python dict gets per pod
+            return _native_hash(pod, _SIG_ANNO_KEYS)
         except TypeError:
             pass  # exotic object in the tree → computed tuple below
     owner_kinds = sorted({r.get("kind", "") for r in (pod.get("metadata") or {}).get("ownerReferences") or []})
@@ -416,10 +409,7 @@ def scheduling_signature(pod: dict):
         tuple(owner_kinds),
         tuple(images),
         # extended-resource annotations change Filter/commit behavior (plugins/)
-        tuple(
-            annotations_of(pod).get(k)
-            for k in (C.AnnoGpuMem, C.AnnoGpuCount, C.AnnoGpuIndex, C.AnnoPodLocalStorage)
-        ),
+        tuple(annotations_of(pod).get(k) for k in _SIG_ANNO_KEYS),
     )
 
 
@@ -805,18 +795,20 @@ class Encoder:
 
 
 @dataclass
-class PlacedRecord:
-    """Host-side memo of one bound pod: everything seeds need, strings pre-resolved."""
+class PlacedGroup:
+    """Host-side memo of every bound pod sharing one scheduling signature:
+    everything the batch-table seeds need, aggregated as per-node counts so
+    committing a pod is a dict increment instead of an object allocation
+    (the engine's commit loop runs once per pod — 100k allocations were a
+    measurable slice of the headline bench)."""
 
-    pod: dict
-    node_i: int
+    pod: dict    # representative pod (selector matching reads template fields only)
     sig: object  # opaque hashable scheduling_signature key
-    labels: dict
-    namespace: str
     req_vec: np.ndarray      # [R] f32
     nonzero: np.ndarray      # [2] f32
     port_ids: List[int]
     carrier_ids: List[int]
+    node_counts: Dict[int, int] = field(default_factory=dict)  # node_i → pods placed
 
 
 # ---------------------------------------------------------------- batch tables --------
@@ -1097,7 +1089,7 @@ def _pad_slots(rows: List[List], width: int, fill, dtype) -> np.ndarray:
 def build_batch_tables(
     enc: Encoder,
     batch: List[Tuple[int, int]],          # (group_id, forced_node) per pod, in order
-    placed: List[PlacedRecord],
+    placed: Dict[object, PlacedGroup],
     match_cache: Dict[Tuple[int, str], bool],
     pad_to: Optional[int] = None,
 ) -> BatchTables:
@@ -1170,25 +1162,29 @@ def build_batch_tables(
     seed_port_used = np.zeros((N, PORT + 1), bool)
     seed_counter = np.zeros((T, D + 1), np.float32)
     seed_carrier = np.zeros((Tc, D + 1), np.float32)
-    for rec in placed:
-        seed_requested[rec.node_i] += rec.req_vec
-        seed_nonzero[rec.node_i] += rec.nonzero
-        for pid in rec.port_ids:
+    for pg in placed.values():
+        nis = np.fromiter(pg.node_counts.keys(), np.int64, len(pg.node_counts))
+        cnts = np.fromiter(pg.node_counts.values(), np.float32, len(pg.node_counts))
+        # node keys are unique per group, so fancy-index += never drops adds;
+        # count-scaled vectors match the wave kernel's aggregate commit math
+        seed_requested[nis] += pg.req_vec[None, :] * cnts[:, None]
+        seed_nonzero[nis] += pg.nonzero[None, :] * cnts[:, None]
+        for pid in pg.port_ids:
             if pid <= PORT:
-                seed_port_used[rec.node_i, pid] = True
+                seed_port_used[nis, pid] = True
         for t, cs in enumerate(enc.counter_list):
-            key = (t, rec.sig)
+            key = (t, pg.sig)
             m = match_cache.get(key)
             if m is None:
-                m = match_cache[key] = cs.matches_pod(rec.pod)
+                m = match_cache[key] = cs.matches_pod(pg.pod)
             if m:
-                d = counter_dom[t, rec.node_i]
-                if d < D:
-                    seed_counter[t, d] += 1.0
-        for cid in rec.carrier_ids:
-            d = carr_dom[cid, rec.node_i]
-            if d < D:
-                seed_carrier[cid, d] += 1.0
+                d = counter_dom[t, nis]
+                ok = d < D
+                np.add.at(seed_counter[t], d[ok], cnts[ok])
+        for cid in pg.carrier_ids:
+            d = carr_dom[cid, nis]
+            ok = d < D
+            np.add.at(seed_carrier[cid], d[ok], cnts[ok])
 
     # ---- gpu-share tables -------------------------------------------------------
     gpu_host = enc.gpu_host

@@ -49,7 +49,7 @@ from .encode import (
     BatchTables,
     Encoder,
     NodeArrays,
-    PlacedRecord,
+    PlacedGroup,
     bucket_capped,
     build_batch_tables,
     carried_specs_of_pod,
@@ -166,7 +166,7 @@ class Simulator:
         self.encoder.gpu_host = self.gpu_host
         self.local_host = OpenLocalHost(self.na.nodes)
         self.encoder.local_host = self.local_host
-        self.placed: List[PlacedRecord] = []
+        self.placed: Dict[object, PlacedGroup] = {}  # signature → aggregated commits
         self.pods_on_node: List[List[dict]] = [[] for _ in nodes]
         self.homeless: List[dict] = []  # bound to a node name we don't know
         self.match_cache: Dict[Tuple[int, object], bool] = {}  # (counter id, sched signature)
@@ -181,9 +181,6 @@ class Simulator:
         self.use_mesh = use_mesh
         self._mesh = _UNSET
         self._wave_elig_cache: Dict[int, Tuple[bool, bool, bool, bool]] = {}
-        # signature → (req_vec, nonzero, port_ids, carrier_ids): identical pods
-        # share all PlacedRecord vectors, so commit bookkeeping is O(1) per pod
-        self._rec_cache: Dict[object, tuple] = {}
 
     # ------------------------------------------------------------- state ----------
 
@@ -192,7 +189,11 @@ class Simulator:
         pod["status"] = {"phase": "Running"}
         # Snapshot the signature BEFORE reserve() writes gpu-index/assume-time
         # annotations, so identical pods keep one signature (match-cache key).
-        sig = scheduling_signature(pod)
+        # Inline the memo hit (stamped by encode_batch/workload expansion) —
+        # this runs once per placed pod.
+        sig = pod.get(SIG_MEMO_KEY)
+        if sig is None:
+            sig = scheduling_signature(pod)
         if scheduled:
             # Open-Gpu-Share Reserve: assign device ids, write the gpu-index pod
             # annotation + simon/node-gpu-share node annotation, adjust whole-GPU
@@ -206,27 +207,20 @@ class Simulator:
         elif self.gpu_host.enabled:
             # pre-bound pod with an existing gpu-index (live snapshot): account it
             self.gpu_host.seed_pod(pod, node_i)
-        vecs = self._rec_cache.get(sig)
-        if vecs is None:
-            vecs = self._rec_cache[sig] = (
-                self.axis.pod_vector(pod).astype(np.float32),
-                pod_nonzero_cpu_mem(pod).astype(np.float32),
-                self.encoder.port_ids(pod_host_ports(pod)),
-                [self.encoder.carrier_id(cs) for cs in carried_specs_of_pod(pod)],
+        pg = self.placed.get(sig)
+        if pg is None:
+            pg = self.placed[sig] = PlacedGroup(
+                pod=pod,
+                sig=sig,
+                req_vec=self.axis.pod_vector(pod).astype(np.float32),
+                nonzero=pod_nonzero_cpu_mem(pod).astype(np.float32),
+                port_ids=self.encoder.port_ids(pod_host_ports(pod)),
+                carrier_ids=[self.encoder.carrier_id(cs)
+                             for cs in carried_specs_of_pod(pod)],
             )
-        rec = PlacedRecord(
-            pod=pod,
-            node_i=node_i,
-            sig=sig,
-            labels=labels_of(pod),
-            namespace=namespace_of(pod),
-            req_vec=vecs[0],
-            nonzero=vecs[1],
-            port_ids=vecs[2],
-            carrier_ids=vecs[3],
-        )
+        nc = pg.node_counts
+        nc[node_i] = nc.get(node_i, 0) + 1
         pod.pop(SIG_MEMO_KEY, None)  # internal marker; keep result objects clean
-        self.placed.append(rec)
         self.pods_on_node[node_i].append(pod)
 
     def register_cluster_objects(self, rt: ResourceTypes) -> None:
@@ -259,8 +253,11 @@ class Simulator:
         self._warn_on_mixed_priorities(pods)
         failed: List[UnscheduledPod] = []
         run: List[dict] = []
-        self._progress = Progress("Scheduling pods", len(pods),
-                                  enabled=not self.disable_progress)
+        # None when disabled so the per-pod loops skip the call entirely
+        # (100k no-op advance() calls are measurable on the headline bench)
+        progress = Progress("Scheduling pods", len(pods),
+                            enabled=not self.disable_progress)
+        self._progress = progress if progress.enabled else None
         for pod in pods:
             node_name = (pod.get("spec") or {}).get("nodeName")
             if not node_name:
@@ -268,7 +265,8 @@ class Simulator:
                 continue
             failed.extend(self._schedule_run(run))
             run = []
-            self._progress.advance(1)
+            if self._progress is not None:
+                self._progress.advance(1)
             ni = self.na.index.get(node_name)
             if ni is None:
                 # Parity: the reference's fakeclient accepts pods bound to unknown
@@ -279,7 +277,7 @@ class Simulator:
             else:
                 self._commit_pod(pod, ni, scheduled=False)
         failed.extend(self._schedule_run(run))
-        self._progress.close()
+        progress.close()
         if self.gpu_host.enabled:
             self.gpu_host.flush()
         return failed
@@ -301,25 +299,28 @@ class Simulator:
         seen = getattr(self, "_priority_seen", None)
         if seen is None:
             seen = self._priority_seen = set()
-        for p in pods:
-            seen.add((p.get("spec") or {}).get("priority") or 0)
-            if len(seen) > 1:
-                import logging
+        seen.update((p.get("spec") or {}).get("priority") or 0 for p in pods)
+        if len(seen) > 1:
+            import logging
 
-                logging.getLogger("open_simulator_tpu").warning(
-                    "pods carry %d distinct spec.priority values; preemption "
-                    "(DefaultPreemption PostFilter) is not simulated — "
-                    "placements may diverge from a preempting scheduler for "
-                    "workloads that overflow capacity", len(seen))
-                self._priority_warned = True
-                return
+            logging.getLogger("open_simulator_tpu").warning(
+                "pods carry %d distinct spec.priority values; preemption "
+                "(DefaultPreemption PostFilter) is not simulated — "
+                "placements may diverge from a preempting scheduler for "
+                "workloads that overflow capacity", len(seen))
+            self._priority_warned = True
 
     def encode_batch(self, to_schedule: List[dict]) -> BatchTables:
         """Encode a pod batch into device-ready tables (no scheduling). Exposed for
         the bench/graft harnesses and the parallel (mesh-sharded) path."""
         batch: List[Tuple[int, int]] = []
         for pod in to_schedule:
-            stripped, target = strip_daemon_pin(pod)
+            # strip_daemon_pin can only fire on pods with node affinity; the
+            # inline guard keeps the (common) affinity-less pod off the call
+            if ((pod.get("spec") or {}).get("affinity")) is not None:
+                stripped, target = strip_daemon_pin(pod)
+            else:
+                stripped, target = pod, None
             if target is None:
                 forced, enc_pod = -1, pod
                 if SIG_MEMO_KEY not in pod:
@@ -500,19 +501,15 @@ class Simulator:
                 )
                 outs.append((seg, counts, carry))
         final_carry = carry
-        # carry snapshot per pod index's segment, for failure diagnosis against
-        # the state the pod actually failed under (the end of ITS segment) —
-        # much closer to the reference's mid-batch FitErrors than the
-        # end-of-batch state used before
-        seg_carry_of: Dict[int, object] = {}
+        seg_of = np.zeros(P, np.int32)
         if outs:
             flat = np.asarray(jnp.concatenate([a.astype(jnp.int32) for _, a, _ in outs]))
             off = 0
-            for k, (seg, a, seg_carry) in enumerate(outs):
+            for k, (seg, a, _) in enumerate(outs):
                 part = flat[off:off + a.shape[0]]
                 off += a.shape[0]
                 start, length = seg[1], seg[2]
-                seg_carry_of[k] = seg_carry
+                seg_of[start:start + length] = k
                 if seg[0] == "serial":
                     choices[start:start + length] = part[:length]
                 else:
@@ -522,9 +519,19 @@ class Simulator:
                     # order; the (length - placed) unschedulable pods stay -1
                     assign = np.repeat(np.arange(counts.shape[0]), counts)
                     choices[start:start + placed] = assign[:placed]
-        seg_of = np.zeros(P, np.int32)
-        for k, (seg, _, _) in enumerate(outs):
-            seg_of[seg[1]:seg[1] + seg[2]] = k
+        # Carry snapshots for failure diagnosis against the state the pod
+        # actually failed under (the end of ITS segment) — much closer to the
+        # reference's mid-batch FitErrors than end-of-batch state. Retained
+        # ONLY for segments that contain a failure: holding every segment's
+        # carry would multiply peak device memory by the segment count.
+        fail_mask = choices[:P] < 0
+        if fail_mask.any():
+            seg_carry_of: Dict[int, object] = {
+                int(k): outs[int(k)][2] for k in np.unique(seg_of[fail_mask])
+            }
+        else:
+            seg_carry_of = {}
+        outs = None  # drop the per-segment carry references
         self._last_tables, self._last_carry = bt, final_carry
 
         progress = getattr(self, "_progress", None)

@@ -59,6 +59,101 @@ def test_unsupported_type_raises(canon_hash):
         canon_hash(object())
 
 
+# ------------------------------------------------------------------ pod_sig ---------
+
+
+ANNO_KEYS = ("simon/gpu-mem", "simon/gpu-count", "simon/gpu-index",
+             "simon/local-storage")
+
+
+def _sig_tuple(pod):
+    """The exact tuple scheduling_signature's native path used to build in Python
+    (simulator/encode.py) — pod_sig must be hash-identical to canon_hash over it."""
+    md = pod.get("metadata") or {}
+    spec = pod.get("spec") or {}
+    anns = md.get("annotations") or {}
+    return (
+        md.get("namespace") or "default",
+        md.get("labels"),
+        spec.get("nodeSelector"),
+        spec.get("affinity"),
+        spec.get("tolerations"),
+        spec.get("topologySpreadConstraints"),
+        spec.get("nodeName"),
+        spec.get("hostNetwork"),
+        spec.get("containers"),
+        spec.get("initContainers"),
+        spec.get("overhead"),
+        sorted({r.get("kind", "") for r in md.get("ownerReferences") or []}),
+        [anns.get(k) for k in ANNO_KEYS],
+    )
+
+
+@pytest.fixture(scope="module")
+def pod_sig():
+    from open_simulator_tpu.native import pod_sig_fn
+
+    fn = pod_sig_fn()
+    if fn is None:
+        pytest.skip("native extension unavailable (no compiler?)")
+    return fn
+
+
+def test_pod_sig_matches_tuple_hash(canon_hash, pod_sig):
+    pods = [
+        {},
+        {"metadata": {"name": "a"}},
+        {"metadata": {"namespace": "", "labels": {"a": "b", "c": "d"}}},
+        {"metadata": {"namespace": "x", "ownerReferences": [
+            {"kind": "ReplicaSet"}, {"kind": "Job"}, {"kind": "ReplicaSet"}]}},
+        {"metadata": {"annotations": {"simon/gpu-mem": "4Gi", "other": "1"}},
+         "spec": {"containers": [{"image": "nginx",
+                                  "resources": {"requests": {"cpu": "100m"}}}],
+                  "hostNetwork": True, "nodeName": "n1",
+                  "tolerations": [{"key": "k", "operator": "Exists"}]}},
+        {"spec": {"affinity": {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"labelSelector": {"matchLabels": {"app": "x"}},
+                 "topologyKey": "kubernetes.io/hostname"}]}},
+            "topologySpreadConstraints": [
+                {"maxSkew": 2, "whenUnsatisfiable": "DoNotSchedule"}]}},
+        {"metadata": None, "spec": None},
+        {"metadata": {"ownerReferences": []},
+         "spec": {"overhead": {"cpu": "10m"}, "initContainers": []}},
+    ]
+    for pod in pods:
+        assert pod_sig(pod, ANNO_KEYS) == canon_hash(_sig_tuple(pod))
+
+
+def test_pod_sig_distinguishes_scheduling_fields(pod_sig):
+    base = {"metadata": {"namespace": "d", "labels": {"app": "x"}},
+            "spec": {"containers": [{"image": "a",
+                                     "resources": {"requests": {"cpu": "1"}}}]}}
+    import copy
+
+    variants = []
+    for mutate in (
+        lambda p: p["metadata"].__setitem__("namespace", "other"),
+        lambda p: p["metadata"]["labels"].__setitem__("app", "y"),
+        lambda p: p["spec"].__setitem__("nodeSelector", {"k": "v"}),
+        lambda p: p["spec"].__setitem__("nodeName", "n7"),
+        lambda p: p["spec"]["containers"][0].__setitem__("image", "b"),
+        lambda p: p["spec"]["containers"][0]["resources"]["requests"].__setitem__("cpu", "2"),
+        lambda p: p["metadata"].setdefault("annotations", {}).__setitem__(
+            "simon/gpu-mem", "1Gi"),
+        lambda p: p["metadata"].__setitem__("ownerReferences", [{"kind": "DaemonSet"}]),
+    ):
+        p = copy.deepcopy(base)
+        mutate(p)
+        variants.append(pod_sig(p, ANNO_KEYS))
+    variants.append(pod_sig(base, ANNO_KEYS))
+    assert len(set(variants)) == len(variants)
+    # name/uid are NOT scheduling-relevant: same signature
+    named = copy.deepcopy(base)
+    named["metadata"]["name"] = "pod-123"
+    assert pod_sig(named, ANNO_KEYS) == pod_sig(base, ANNO_KEYS)
+
+
 # ------------------------------------------------------------------ memoization -----
 
 
