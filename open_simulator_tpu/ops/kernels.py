@@ -655,12 +655,28 @@ feasibility_jit = jax.jit(
 # step bottleneck that capped round 1 at ~15k pods/s (simulator.go:309-348 is
 # the serial loop being replaced at scale).
 
-WAVE_BLOCK = 64  # B: score-table depth = max copies per node per wave iteration
+WAVE_BLOCK = 64  # B: max score-table depth = max copies per node per wave iteration
+
+
+def wave_block_for(m: int, n: int) -> int:
+    """Static score-table depth for an m-pod wave over n nodes: a pow2 in
+    [8, WAVE_BLOCK] covering ~8× the mean per-node take, so a 1000-pod segment
+    over 5000 nodes sorts an [N, 8] table instead of [N, 64] (the sort is the
+    wave's dominant cost) while a 100k-pod headline still gets full depth.
+    Pow2 bucketing keeps the number of distinct compiled wave kernels small."""
+    b = 8
+    target = (8 * m + max(n, 1) - 1) // max(n, 1)
+    while b < min(WAVE_BLOCK, target):
+        b *= 2
+    return b
 
 
 def _wave_statics(tb: Tables, cry: Carry, g, w: ScoreWeights = DEFAULT_WEIGHTS):
     """Per-segment constants: ip_raw (counters can't change during the wave) and
-    the static score vectors, exactly as scores() computes them."""
+    the static score vectors, exactly as scores() computes them. The stacked
+    forms let _wave_norms run as TWO masked reductions instead of six — inside
+    the group-serial scan each reduction is a separate pass over [N], so this
+    is a per-scheduled-pod cost."""
     cnt_at = jnp.take_along_axis(cry.counter, tb.counter_dom, axis=1)
     carr_at = jnp.take_along_axis(cry.carrier, tb.carr_dom, axis=1)
     pref_ids = tb.pref_t[g]
@@ -670,36 +686,44 @@ def _wave_statics(tb: Tables, cry: Carry, g, w: ScoreWeights = DEFAULT_WEIGHTS):
     ip_raw = jnp.sum(jnp.where(pvalid[:, None], pw[:, None] * cnt_at[pidx], 0.0), axis=0)
     carr_w = (tb.carr_hard_w + tb.carr_pref_w) * tb.carr_sel_match_g[:, g]
     ip_raw = ip_raw + jnp.sum(carr_w[:, None] * carr_at, axis=0)
+    simon_s = _flr(100.0 * tb.simon_raw[g])
+    na_raw = tb.nodeaff_raw[g]
+    t_raw = tb.taint_raw[g]
     return {
         "ip_raw": ip_raw,
-        "simon_s": _flr(100.0 * tb.simon_raw[g]),
-        "na_raw": tb.nodeaff_raw[g],
-        "t_raw": tb.taint_raw[g],
+        "simon_s": simon_s,
+        "na_raw": na_raw,
+        "t_raw": t_raw,
+        "max_stack": jnp.stack([simon_s, na_raw, t_raw, ip_raw]),   # [4, N]
+        "min_stack": jnp.stack([simon_s, ip_raw]),                  # [2, N]
         "static": (w.avoid * tb.avoid_raw[g] + w.image * tb.image_raw[g]
                    + tb.extra_raw[g]),
     }
 
 
 def _wave_norms(st: dict, F):
-    """The feasible-set-dependent normalizer values (must match scores())."""
-    simon_hi = jnp.max(jnp.where(F, st["simon_s"], -jnp.inf))
-    simon_lo = jnp.min(jnp.where(F, st["simon_s"], jnp.inf))
-    na_max = jnp.maximum(jnp.max(jnp.where(F, st["na_raw"], -jnp.inf)), 0.0)
-    t_max = jnp.maximum(jnp.max(jnp.where(F, st["t_raw"], -jnp.inf)), 0.0)
-    ip_max = jnp.maximum(jnp.max(jnp.where(F, st["ip_raw"], -jnp.inf)), 0.0)
-    ip_min = jnp.minimum(jnp.min(jnp.where(F, st["ip_raw"], jnp.inf)), 0.0)
+    """The feasible-set-dependent normalizer values (must match scores() —
+    the stacked reductions produce the same floats as six separate ones)."""
+    maxes = jnp.max(jnp.where(F[None, :], st["max_stack"], -jnp.inf), axis=1)
+    mins = jnp.min(jnp.where(F[None, :], st["min_stack"], jnp.inf), axis=1)
+    simon_hi = maxes[0]
+    simon_lo = mins[0]
+    na_max = jnp.maximum(maxes[1], 0.0)
+    t_max = jnp.maximum(maxes[2], 0.0)
+    ip_max = jnp.maximum(maxes[3], 0.0)
+    ip_min = jnp.minimum(mins[1], 0.0)
     return (simon_hi, simon_lo, na_max, t_max, ip_max, ip_min)
 
 
 def _wave_score_table(tb: Tables, cry: Carry, st: dict, norms, g, j,
-                      w: ScoreWeights = DEFAULT_WEIGHTS):
-    """[N, B] score table: entry (n, k) = score of placing the (j_n+k+1)-th copy
+                      w: ScoreWeights = DEFAULT_WEIGHTS, block: int = WAVE_BLOCK):
+    """[N, B+1] score table: entry (n, k) = score of placing the (j_n+k+1)-th copy
     of group g on node n given current usage. Formulas mirror scores() term by
     term; the constant-on-F plugins (SelectorSpread=100, PodTopologySpread=100,
     OpenLocal=0) are dropped — a uniform shift never changes the ordering the
     wave consumes."""
     simon_hi, simon_lo, na_max, t_max, ip_max, ip_min = norms
-    B = WAVE_BLOCK + 1  # one extra column: the exact first-hidden-entry bound
+    B = block + 1  # one extra column: the exact first-hidden-entry bound
     copies = j.astype(_F32)[:, None, None] + jnp.arange(1, B + 1, dtype=_F32)[None, :, None]
     alloc_cm = tb.alloc[:, (CPU_I, MEM_I)]                            # [N, 2]
     used = cry.nonzero[:, None, :] + tb.grp_nonzero[g][None, None, :] * copies  # [N,B,2]
@@ -790,10 +814,11 @@ def _aggregate_commit(tb: Tables, cry: Carry, g, j, gpu_live: bool) -> Carry:
                  dev_used, cry.vg_req, cry.sdev_alloc)
 
 
-@partial(jax.jit, static_argnames=("gpu_live", "w", "filters"))
+@partial(jax.jit, static_argnames=("gpu_live", "w", "filters", "block"))
 def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
                   w: ScoreWeights = DEFAULT_WEIGHTS,
-                  filters: FilterFlags = DEFAULT_FILTERS):
+                  filters: FilterFlags = DEFAULT_FILTERS,
+                  block: int = WAVE_BLOCK):
     """Place up to m pods of wave-eligible group g, exactly reproducing m serial
     _step placements. Returns (new carry, per-node counts [N] i32, placed i32).
 
@@ -804,9 +829,14 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
     gpu_live (static): the group requests shared GPU memory (no pre-assigned
     gpu-index). Score inputs stay static (the Open-Gpu-Share score is Simon's
     formula); capacity and the device-ledger commit are exact — see
-    _gpu_capacity and _aggregate_commit."""
+    _gpu_capacity and _aggregate_commit.
+
+    block (static): score-table depth (wave_block_for). Correctness never
+    depends on it — entries past the depth are exactly what the
+    hidden-continuation guard defers to later iterations — only the
+    table/sort size vs iteration-count trade-off does."""
     N = tb.alloc.shape[0]
-    B = WAVE_BLOCK
+    B = block
     iota_n = jnp.arange(N, dtype=jnp.int32)
     base_feas, _ = feasibility(
         tb, cry, g, jnp.int32(-1), jnp.asarray(True),
@@ -824,7 +854,7 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
         avail = capacity - j                                   # copies left per node
         F = base_feas & (avail > 0)
         norms = _wave_norms(st, F)
-        table_ext = _wave_score_table(tb, cry, st, norms, g, j, w)  # [N, B+1]
+        table_ext = _wave_score_table(tb, cry, st, norms, g, j, w, B)  # [N, B+1]
         table = table_ext[:, :B]
         ks = jnp.arange(B, dtype=jnp.int32)[None, :]
         # usable entries: within remaining capacity, and monotone prefix only
@@ -960,6 +990,23 @@ def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
     alloc_cm = tb.alloc[:, (CPU_I, MEM_I)]                 # [N, 2]
     gnz = tb.grp_nonzero[g]
 
+    # Precompute the count-dependent score column OUTSIDE the scan: entry
+    # (n, k) = w.least*least + w.balanced*balanced for the (k+1)-th copy on
+    # node n — identical f32 expressions to the in-step math, so the gathered
+    # values are bit-equal. j_n < P always, so K = P covers every reachable
+    # count. Skipped (None) for pathological sizes where the [N, P] table
+    # would dominate memory; the step then computes the pair inline.
+    N_, P_ = tb.alloc.shape[0], valid.shape[0]
+    if N_ * P_ <= 64_000_000:
+        copies_k = jnp.arange(1, P_ + 1, dtype=_F32)                   # [P]
+        used_k = (cry.nonzero[:, None, :]
+                  + gnz[None, None, :] * copies_k[None, :, None])      # [N, P, 2]
+        lst, bal = least_balanced(used_k[:, :, 0], used_k[:, :, 1],
+                                  alloc_cm[:, None, 0], alloc_cm[:, None, 1])
+        lb_table = w.least * lst + w.balanced * bal                    # [N, P]
+    else:
+        lb_table = None
+
     def step(state, ok):
         j, cnt = state
         # live DoNotSchedule filter, mirroring feasibility() term for term
@@ -973,9 +1020,13 @@ def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
         # scores: least/balanced move with j; the rest normalize over F. The
         # candidate pod itself counts toward its own usage (scores() adds
         # grp_nonzero once), hence j + 1.
-        used = cry.nonzero + gnz[None, :] * (j + 1).astype(_F32)[:, None]  # [N, 2]
-        least, balanced = least_balanced(
-            used[:, 0], used[:, 1], alloc_cm[:, 0], alloc_cm[:, 1])
+        if lb_table is None:
+            used = cry.nonzero + gnz[None, :] * (j + 1).astype(_F32)[:, None]
+            least, balanced = least_balanced(
+                used[:, 0], used[:, 1], alloc_cm[:, 0], alloc_cm[:, 1])
+            lb = w.least * least + w.balanced * balanced
+        else:
+            lb = jnp.take_along_axis(lb_table, j[:, None], axis=1)[:, 0]
         simon_hi, simon_lo, na_max, t_max, ip_max, ip_min = _wave_norms(st, F)
         rng = simon_hi - simon_lo
         simon = jnp.where((rng > 0) & jnp.isfinite(rng),
@@ -985,8 +1036,7 @@ def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
         ip_rng = ip_max - ip_min
         interpod = jnp.where(ip_rng > 0,
                              _flr(100.0 * (st["ip_raw"] - ip_min) / ip_rng), 0.0)
-        score = (w.least * least + w.balanced * balanced
-                 + (w.simon + w.gpushare) * simon + w.nodeaff * nodeaff
+        score = (lb + (w.simon + w.gpushare) * simon + w.nodeaff * nodeaff
                  + w.taint * taint + w.interpod * interpod + st["static"])
         choice = jnp.argmax(jnp.where(F, score, -jnp.inf)).astype(jnp.int32)
         do = any_f.astype(jnp.int32)

@@ -498,6 +498,7 @@ class Simulator:
                     tables, carry, jnp.int32(g), jnp.int32(length),
                     jnp.asarray(cap1), gpu_live=gpu_live,
                     w=self.score_w, filters=self.filter_flags,
+                    block=kernels.wave_block_for(length, self.na.N),
                 )
                 outs.append((seg, counts, carry))
         final_carry = carry
@@ -637,6 +638,7 @@ class Simulator:
                     tables, carry, jnp.int32(g), jnp.int32(length),
                     jnp.asarray(cap1), gpu_live=gpu_live,
                     w=self.score_w, filters=self.filter_flags,
+                    block=kernels.wave_block_for(length, self.na.N),
                 )
                 placed_parts.append(placed)
         self._last_tables, self._last_carry = bt, carry
