@@ -94,6 +94,10 @@ def build_parser() -> argparse.ArgumentParser:
     p_server.add_argument("--kubeconfig", default="", help="path of the kubeconfig file")
     p_server.add_argument("--master", default="", help="URL of the kube-apiserver")
     p_server.add_argument("--port", type=int, default=8080, help="listen port")
+    p_server.add_argument(
+        "--grpc-port", type=int, default=0, metavar="PORT",
+        help="also serve the gRPC bridge (server/proto/simon.proto) on PORT "
+             "(0 = disabled)")
 
     sub.add_parser("version", help="Print the version of simon")
 
@@ -142,6 +146,15 @@ def cmd_server(args) -> int:
 
     try:
         server = Server(kubeconfig=args.kubeconfig, master=args.master)
+        if args.grpc_port:
+            # same Server object behind both surfaces: the TryLock busy
+            # semantics hold across REST and gRPC clients
+            from ..server.grpcbridge import GrpcBridge
+
+            bridge = GrpcBridge(server=server)
+            grpc_server, bound = bridge.build_grpc_server(args.grpc_port)
+            grpc_server.start()
+            print(f"simon grpc bridge listening on :{bound}")
         server.start(port=args.port)
     except KeyboardInterrupt:
         return 0

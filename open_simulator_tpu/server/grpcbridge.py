@@ -1,0 +1,211 @@
+"""gRPC bridge: the host-side RPC surface for non-Python clients.
+
+SURVEY.md §7 step 9 / §2.3 name a "Go↔Python gRPC bridge" as the distributed-
+communication counterpart of the reference's gin REST server
+(/root/reference/pkg/server/server.go:148-315): a Go CLI (or any gRPC client)
+drives this process, which owns the TPU scheduling service. The contract is
+proto/simon.proto; handlers delegate to the same `Server` the REST façade uses
+(http.py), so both surfaces stay behavior-identical — TryLock→busy, snapshot,
+simulate, response shaping.
+
+Wire format: the three message types are small (an int32 field and/or one bytes
+field), so this module encodes/decodes protobuf wire format directly — no
+generated stubs, no protoc at runtime; `tests/test_grpcbridge.py` cross-checks
+the codec against protoc-generated modules. Service dispatch uses
+grpc.method_handlers_generic_handler, which needs only (de)serializer callables.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Tuple
+
+from .http import Server
+
+SERVICE = "simon.v1.Simon"
+
+
+# ------------------------------------------------------------- wire codec ------
+#
+# Protobuf wire format (proto3):
+#   field 1, varint  -> tag 0x08 ; field 1, bytes -> tag 0x0A
+#   field 2, bytes   -> tag 0x12 ; varints are base-128 little-endian
+# Unknown fields are skipped (forward compatibility); default values are
+# omitted on encode, exactly like canonical protobuf serializers.
+
+
+def _encode_varint(n: int) -> bytes:
+    if n < 0:  # int32 negatives ride as 10-byte two's-complement varints
+        n += 1 << 64
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _decode_varint(data: bytes, i: int) -> Tuple[int, int]:
+    shift = 0
+    val = 0
+    while True:
+        if i >= len(data):
+            raise ValueError("truncated varint")
+        b = data[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def _skip_field(data: bytes, i: int, wire_type: int) -> int:
+    if wire_type == 0:
+        _, i = _decode_varint(data, i)
+        return i
+    if wire_type == 1:
+        return i + 8
+    if wire_type == 2:
+        n, i = _decode_varint(data, i)
+        return i + n
+    if wire_type == 5:
+        return i + 4
+    raise ValueError(f"unsupported wire type {wire_type}")
+
+
+def _fields(data: bytes):
+    """Yield (field_number, wire_type, value) — value is int for varint,
+    bytes for length-delimited; other types are skipped."""
+    i = 0
+    while i < len(data):
+        tag, i = _decode_varint(data, i)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            val, i = _decode_varint(data, i)
+            yield field, wt, val
+        elif wt == 2:
+            n, i = _decode_varint(data, i)
+            yield field, wt, bytes(data[i:i + n])
+            i += n
+        else:
+            i = _skip_field(data, i, wt)
+
+
+def encode_simulate_request(request_json: bytes) -> bytes:
+    return (b"\x0a" + _encode_varint(len(request_json)) + request_json
+            if request_json else b"")
+
+
+def decode_simulate_request(data: bytes) -> bytes:
+    payload = b""
+    for field, wt, val in _fields(data):
+        if field == 1 and wt == 2:
+            payload = val
+    return payload
+
+
+def encode_simulate_response(code: int, response_json: bytes) -> bytes:
+    out = b""
+    if code:
+        out += b"\x08" + _encode_varint(code)
+    if response_json:
+        out += b"\x12" + _encode_varint(len(response_json)) + response_json
+    return out
+
+
+def decode_simulate_response(data: bytes) -> Tuple[int, bytes]:
+    code, payload = 0, b""
+    for field, wt, val in _fields(data):
+        if field == 1 and wt == 0:
+            # int32: the canonical encoder sign-extends to 64 bits
+            code = val - (1 << 64) if val >= 1 << 63 else val
+        elif field == 2 and wt == 2:
+            payload = val
+    return code, payload
+
+
+def encode_health_response(message: str) -> bytes:
+    data = message.encode()
+    return b"\x0a" + _encode_varint(len(data)) + data if data else b""
+
+
+def decode_health_response(data: bytes) -> str:
+    for field, wt, val in _fields(data):
+        if field == 1 and wt == 2:
+            return val.decode()
+    return ""
+
+
+# -------------------------------------------------------------- service --------
+
+
+class GrpcBridge:
+    """gRPC façade over `Server` (http.py). Build with the same arguments —
+    or an injectable snapshot_fn for tests — then `serve(port)`."""
+
+    def __init__(self, server: Optional[Server] = None, **server_kwargs) -> None:
+        self.server = server if server is not None else Server(**server_kwargs)
+
+    # handlers: bytes-in/bytes-out via the wire codec
+
+    def _simulate(self, handler, request: bytes, context) -> bytes:
+        try:
+            req = json.loads(decode_simulate_request(request) or b"{}")
+        except ValueError as e:
+            # covers JSONDecodeError, invalid-UTF-8 UnicodeDecodeError, and
+            # malformed protobuf framing from the decoder — the contract
+            # keeps unmarshal errors in-band as code=400
+            code, body = 400, f"fail to unmarshal content: {e}"
+        else:
+            code, body = handler(req)
+        return encode_simulate_response(code, json.dumps(body).encode())
+
+    def _deploy(self, request: bytes, context) -> bytes:
+        return self._simulate(self.server.handle_deploy_apps, request, context)
+
+    def _scale(self, request: bytes, context) -> bytes:
+        return self._simulate(self.server.handle_scale_apps, request, context)
+
+    def _health(self, request: bytes, context) -> bytes:
+        return encode_health_response("ok")
+
+    def build_grpc_server(self, port: int, host: str = "[::]", max_workers: int = 4):
+        """Returns (grpc.Server, bound_port). Generic handlers keep the bytes
+        payloads opaque to grpc; the codec above is the (de)serializer."""
+        from concurrent import futures
+
+        import grpc
+
+        ident = lambda b: b  # noqa: E731 — payloads are already wire bytes
+        handlers = {
+            "DeployApps": grpc.unary_unary_rpc_method_handler(
+                self._deploy, request_deserializer=ident, response_serializer=ident),
+            "ScaleApps": grpc.unary_unary_rpc_method_handler(
+                self._scale, request_deserializer=ident, response_serializer=ident),
+            "Health": grpc.unary_unary_rpc_method_handler(
+                self._health, request_deserializer=ident, response_serializer=ident),
+        }
+        # no SO_REUSEPORT: a port collision must FAIL (bound == 0 below), not
+        # silently split traffic with whatever already holds the port
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
+                             options=(("grpc.so_reuseport", 0),))
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+        try:
+            bound = server.add_insecure_port(f"{host}:{port}")
+        except RuntimeError as e:  # newer grpc raises instead of returning 0
+            raise OSError(f"failed to bind grpc port {host}:{port}: {e}") from e
+        if bound == 0:  # older grpc signals bind failure by returning port 0
+            raise OSError(f"failed to bind grpc port {host}:{port}")
+        return server, bound
+
+    def serve(self, port: int, host: str = "[::]") -> None:
+        server, bound = self.build_grpc_server(port, host)
+        server.start()
+        print(f"simon grpc bridge listening on :{bound}")
+        server.wait_for_termination()
