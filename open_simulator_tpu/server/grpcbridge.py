@@ -69,13 +69,17 @@ def _skip_field(data: bytes, i: int, wire_type: int) -> int:
         _, i = _decode_varint(data, i)
         return i
     if wire_type == 1:
-        return i + 8
-    if wire_type == 2:
+        i += 8
+    elif wire_type == 2:
         n, i = _decode_varint(data, i)
-        return i + n
-    if wire_type == 5:
-        return i + 4
-    raise ValueError(f"unsupported wire type {wire_type}")
+        i += n
+    elif wire_type == 5:
+        i += 4
+    else:
+        raise ValueError(f"unsupported wire type {wire_type}")
+    if i > len(data):
+        raise ValueError("truncated field")
+    return i
 
 
 def _fields(data: bytes):
@@ -90,6 +94,8 @@ def _fields(data: bytes):
             yield field, wt, val
         elif wt == 2:
             n, i = _decode_varint(data, i)
+            if i + n > len(data):  # canonical parsers reject truncation
+                raise ValueError("truncated length-delimited field")
             yield field, wt, bytes(data[i:i + n])
             i += n
         else:

@@ -258,7 +258,7 @@ class Server:
                 raw = self.rfile.read(length)
                 try:
                     req = json.loads(raw or b"{}")
-                except json.JSONDecodeError as e:
+                except ValueError as e:  # JSONDecodeError + invalid-UTF-8
                     self._send(400, f"fail to unmarshal content: {e}")
                     return
                 if self.path == "/api/deploy-apps":

@@ -141,5 +141,12 @@ def test_http_round_trip():
         assert resp.status == 200
         body = json.loads(resp.read())
         assert sum(len(ns["pods"]) for ns in body["nodeStatus"]) == 2
+
+        # invalid UTF-8 body → in-band 400, identical to the gRPC bridge
+        conn.request("POST", "/api/deploy-apps", body=b"\x80abc",
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 400
+        assert "fail to unmarshal" in json.loads(resp.read())
     finally:
         httpd.shutdown()

@@ -123,9 +123,14 @@ def test_grpc_round_trip(grpc_mod):
             deploy(encode_simulate_request(b"{not json")))
         assert code == 400
 
-        # invalid UTF-8 payload also stays in-band as 400 (not a grpc error)
+        # invalid UTF-8 payload (UnicodeDecodeError, not JSONDecodeError)
+        # also stays in-band as 400 (not a grpc error)
         code, body = decode_simulate_response(
-            deploy(encode_simulate_request(b"\xff\xfe")))
+            deploy(encode_simulate_request(b"\x80abc")))
+        assert code == 400
+
+        # truncated protobuf framing (declared length > buffer) → in-band 400
+        code, body = decode_simulate_response(deploy(b"\x0a\x64{}"))
         assert code == 400
     finally:
         server.stop(0)

@@ -439,6 +439,86 @@ def test_gpu_wave_segments_are_waves():
     assert segs[0][5] is True  # gpu_live
 
 
+@pytest.mark.parametrize("seed", [7, 23, 101, 555])
+def test_wave_fuzz_mixed_workloads(seed):
+    """Randomized waves-vs-serial sweep: random node shapes (zones, taints,
+    GPU annotations, tight capacities) and random workload blocks cycling
+    plain / tolerating / self-anti-affinity / zone-spread / shared-GPU /
+    host-port pods, scheduled across two batches. Census + failure equality
+    must hold for every seed — this is the guard that the wave eligibility
+    split, the adaptive block depth, and the hidden-continuation logic stay
+    exact under shapes no hand-written case anticipated."""
+    import random
+
+    rng = random.Random(seed)
+    n_nodes = rng.randint(6, 14)
+    n_zones = rng.choice([0, 2, 3])
+    nodes = []
+    for i in range(n_nodes):
+        labels = {}
+        if n_zones:
+            labels["topology.kubernetes.io/zone"] = f"z{i % n_zones}"
+        taints = (
+            [{"key": "dedicated", "value": "batch", "effect": "NoSchedule"}]
+            if rng.random() < 0.25 else None
+        )
+        annotations = None
+        if rng.random() < 0.4:
+            annotations = {}
+        node = make_node(
+            f"fz{i}",
+            cpu=f"{rng.randint(2000, 9000)}m",
+            memory=str(rng.randint(4, 12) << 30),
+            pods=str(rng.randint(8, 40)),
+            labels=labels,
+            taints=taints,
+            annotations=annotations,
+        )
+        if rng.random() < 0.35:  # GPU node (gpushare extended resource)
+            for sect in ("capacity", "allocatable"):
+                node["status"][sect]["alibabacloud.com/gpu-count"] = "2"
+                node["status"][sect]["alibabacloud.com/gpu-mem"] = str(2 * 8 << 30)
+        nodes.append(node)
+
+    def block(bi, kind, n):
+        app = f"fz-app{bi}"
+        pods = []
+        for i in range(n):
+            kw = dict(labels={"app": app},
+                      cpu=f"{rng.randint(50, 800)}m",
+                      memory=str(rng.randint(64, 1024) << 20))
+            if kind == 1:
+                kw["tolerations"] = [{"key": "dedicated", "operator": "Exists",
+                                      "effect": "NoSchedule"}]
+            elif kind == 2:
+                kw["affinity"] = anti_affinity(app)
+            elif kind == 3 and n_zones:
+                pass  # spread added below
+            elif kind == 4:
+                kw["annotations"] = {"alibabacloud.com/gpu-mem": str(4 << 30),
+                                     "alibabacloud.com/gpu-count": "1"}
+            elif kind == 5:
+                kw["host_ports"] = [30000 + bi]
+            p = make_pod(f"{app}-{i}", **kw)
+            if kind == 3 and n_zones:
+                p["spec"]["topologySpreadConstraints"] = [{
+                    "maxSkew": rng.choice([1, 2]),
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": app}},
+                }]
+            pods.append(p)
+        return pods
+
+    all_pods = []
+    for bi in range(rng.randint(4, 8)):
+        all_pods.extend(block(bi, rng.randint(0, 5), rng.randint(2, 30)))
+    cut = rng.randint(0, len(all_pods))
+    wc, sc, wf, sf = run_both(nodes, [all_pods[:cut], all_pods[cut:]])
+    assert wc == sc
+    assert wf == sf
+
+
 def test_wave_f32_ulp_stress():
     # odd capacities and request sizes drive cumulative f32 rounding close to
     # ULP boundaries; the wave score table multiplies (j * req) where serial
