@@ -175,7 +175,39 @@ print(json.dumps({{"rate": {n_pods} / best, "match": census(single) == mesh_cens
         return 0.0, False, f"{type(e).__name__}: {e}"
 
 
+def _ensure_live_backend(probe_timeout: float = 180.0) -> str:
+    """Probe the default JAX backend in a SUBPROCESS before this process
+    touches it: a wedged accelerator tunnel hangs backend init holding a
+    global lock, which would turn the whole bench into a silent timeout.
+    On probe failure, force the CPU backend (config route — the env-var
+    override can itself hang at import under injected plugins) so the bench
+    still emits its JSON lines. Returns the backend label used."""
+    import subprocess
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=probe_timeout,
+        )
+        if probe.returncode == 0:
+            return "default"
+    except subprocess.TimeoutExpired:
+        pass
+    os.environ.pop("JAX_PLATFORMS", None)
+    print(json.dumps({"warning": "default backend unreachable; benching on CPU"}),
+          file=sys.stderr, flush=True)
+    try:
+        from open_simulator_tpu.utils.devices import force_cpu_platform
+
+        force_cpu_platform()
+    except Exception as e:  # even a broken jax install shouldn't kill the warning
+        print(json.dumps({"warning": f"cpu fallback failed: {e}"}),
+              file=sys.stderr, flush=True)
+    return "cpu-fallback"
+
+
 def main() -> None:
+    backend = _ensure_live_backend()
     results = []
 
     # ---- headline: north star ------------------------------------------------
@@ -185,6 +217,7 @@ def main() -> None:
         "value": round(rate, 1),
         "unit": "pods/s",
         "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4),
+        **({"backend": backend} if backend != "default" else {}),
     }
     results.append(dict(headline, wall_s=round(dt, 3), scheduled=placed, total=total))
     print(json.dumps(headline), flush=True)
@@ -238,6 +271,11 @@ def main() -> None:
         "search_exhausted": added is None,
     })
 
+    if backend != "default":
+        # every in-process config ran on the fallback backend, not just the
+        # headline — label them all so records stay backend-comparable
+        for r in results:
+            r.setdefault("backend", backend)
     for r in results[1:]:
         print(json.dumps(r), file=sys.stderr, flush=True)
     with open("BENCH_DETAIL.json", "w") as f:
