@@ -183,18 +183,36 @@ def _ensure_live_backend(probe_timeout: float = 180.0) -> str:
     override can itself hang at import under injected plugins) so the bench
     still emits its JSON lines. Returns the backend label used."""
     import subprocess
+    import time as _time
 
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            capture_output=True, timeout=probe_timeout,
-        )
-        if probe.returncode == 0:
+    detail = ""
+    # Popen + poll, NOT subprocess.run: run's timeout path blocks in wait()
+    # after SIGKILL, which never returns for a child wedged in a D-state
+    # driver ioctl — the exact failure mode being probed for.
+    probe = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        start_new_session=True,
+    )
+    deadline = _time.time() + probe_timeout
+    while _time.time() < deadline:
+        rc = probe.poll()
+        if rc == 0:
             return "default"
-    except subprocess.TimeoutExpired:
-        pass
+        if rc is not None:
+            try:
+                detail = (probe.stderr.read() or b"")[-400:].decode("utf-8", "replace")
+            except Exception:
+                pass
+            detail = f"probe exited rc={rc}: {detail.strip()}"
+            break
+        _time.sleep(0.5)
+    else:
+        probe.kill()  # best effort; do not wait() — the child may be unkillable
+        detail = f"probe timed out after {probe_timeout:.0f}s"
     os.environ.pop("JAX_PLATFORMS", None)
-    print(json.dumps({"warning": "default backend unreachable; benching on CPU"}),
+    print(json.dumps({"warning": "default backend unreachable; benching on CPU",
+                      "detail": detail}),
           file=sys.stderr, flush=True)
     try:
         from open_simulator_tpu.utils.devices import force_cpu_platform

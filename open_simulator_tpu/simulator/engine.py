@@ -145,6 +145,11 @@ class Simulator:
         # capacity planner's probes) must never see a previous run's mutations.
         nodes = copy.deepcopy(nodes)
         from ..api.schedconfig import DEFAULT_SCHEDULER_CONFIG, KERNEL_FILTERS
+        from ..utils.devices import enable_compilation_cache
+
+        # persistent XLA cache: fresh processes (CLI runs, server workers)
+        # reuse compiled scan kernels instead of re-paying 15-40s per shape
+        enable_compilation_cache()
 
         self.sched_config = sched_config or DEFAULT_SCHEDULER_CONFIG
         self.score_w = kernels.ScoreWeights(**self.sched_config.weight_kwargs())

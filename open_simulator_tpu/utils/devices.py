@@ -36,6 +36,48 @@ def force_cpu_platform() -> None:
         pass
 
 
+_cache_enabled = False
+
+
+def enable_compilation_cache() -> None:
+    """Turn on JAX's persistent compilation cache (idempotent). The engine's
+    kernels take ~15-40s to compile (CPU/TPU); every fresh process — each CLI
+    run, each server worker, every capacity-probe shape bucket — used to pay
+    that again. The cache keys on backend + jaxlib version + HLO, so entries
+    persist across runs and machines sharing the directory.
+
+    Opt-out / redirect via OPEN_SIMULATOR_COMPILE_CACHE: "0"/"off" disables,
+    any other non-empty value is the cache directory (default
+    ~/.cache/open-simulator-tpu/xla)."""
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    _cache_enabled = True  # one attempt per process, success or not
+    setting = os.environ.get("OPEN_SIMULATOR_COMPILE_CACHE", "")
+    if setting.lower() in ("0", "off", "false", "no"):
+        return
+    if setting.lower() in ("1", "on", "true", "yes"):
+        setting = ""  # plain enable → default directory
+    cache_dir = setting or os.path.join(
+        os.environ.get("XDG_CACHE_HOME")
+        or os.path.join(os.path.expanduser("~"), ".cache"),
+        "open-simulator-tpu", "xla")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # JAX's default gates apply: entries are persisted for programs past
+        # jax_persistent_cache_min_compile_time_secs (1s) — every engine scan
+        # kernel clears that by an order of magnitude
+    except Exception as e:  # cache is an optimization; never fail the caller
+        import logging
+
+        logging.getLogger("open_simulator_tpu").warning(
+            "persistent compilation cache unavailable (%s); "
+            "kernels will recompile per process", e)
+
+
 def cpu_devices(n: int):
     """Best-effort list of ≥ n devices, preferring the default platform and falling
     back to virtual CPU devices. May return fewer if the CPU backend already
