@@ -85,6 +85,48 @@ def bench_gpushare(n_nodes=1_000, n_pods=5_000, repeats=2):
     return placed / dt, placed, total, dt
 
 
+def bench_placement_agreement(n_nodes=1_000, n_pods=10_000):
+    """BASELINE's second metric: placement agreement vs the serial scheduler.
+    The serial scan IS this framework's kube-scheduler semantics (one
+    filter+score+commit cycle per pod; score parity unit-tested per plugin in
+    tests/test_scores.py); the batched wave path must agree >=99%. Pods within
+    one scheduling group are interchangeable (the reference tie-breaks
+    randomly, generic_scheduler.go:188), so agreement compares per-(node,
+    group) placement censuses on the hard-predicate workload."""
+    import copy
+
+    from open_simulator_tpu.simulator.encode import scheduling_signature
+    from open_simulator_tpu.simulator.engine import Simulator
+    from open_simulator_tpu.utils.synth import synth_cluster
+
+    nodes, pods = synth_cluster(n_nodes, n_pods, hard_predicates=True)
+
+    def census(use_waves):
+        sim = Simulator(copy.deepcopy(nodes))
+        sim.use_waves = use_waves
+        failed = sim.schedule_pods(copy.deepcopy(pods))
+        placed = {}
+        for i, node_pods in enumerate(sim.pods_on_node):
+            for p in node_pods:
+                # true interchangeability key: the scheduling signature, NOT a
+                # label — synth blocks mix constraint-distinct pods under one
+                # app label, which must count as disagreements when swapped
+                key = (i, scheduling_signature(p))
+                placed[key] = placed.get(key, 0) + 1
+        fails = {}
+        for u in failed:
+            sig = scheduling_signature(u.pod)
+            fails[sig] = fails.get(sig, 0) + 1
+        return placed, fails
+
+    wave_c, wave_f = census(True)
+    serial_c, serial_f = census(False)
+    total = sum(serial_c.values()) + sum(serial_f.values())
+    agree = sum(min(c, wave_c.get(k, 0)) for k, c in serial_c.items())
+    agree += sum(min(c, wave_f.get(s, 0)) for s, c in serial_f.items())
+    return (agree / total if total else 1.0), total
+
+
 def bench_capacity_plan(n_pods=100_000, repeats=1):
     """Config 5: add-node auto search — find the minimal simon-node count that
     schedules all pods within a 60% MaxCPU envelope, timing the whole search.
@@ -185,31 +227,37 @@ def _ensure_live_backend(probe_timeout: float = 180.0) -> str:
     import subprocess
     import time as _time
 
+    import tempfile
+
     detail = ""
     # Popen + poll, NOT subprocess.run: run's timeout path blocks in wait()
     # after SIGKILL, which never returns for a child wedged in a D-state
-    # driver ioctl — the exact failure mode being probed for.
-    probe = subprocess.Popen(
-        [sys.executable, "-c", "import jax; jax.devices()"],
-        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
-        start_new_session=True,
-    )
-    deadline = _time.time() + probe_timeout
-    while _time.time() < deadline:
-        rc = probe.poll()
-        if rc == 0:
-            return "default"
-        if rc is not None:
-            try:
-                detail = (probe.stderr.read() or b"")[-400:].decode("utf-8", "replace")
-            except Exception:
-                pass
-            detail = f"probe exited rc={rc}: {detail.strip()}"
-            break
-        _time.sleep(0.5)
-    else:
-        probe.kill()  # best effort; do not wait() — the child may be unkillable
-        detail = f"probe timed out after {probe_timeout:.0f}s"
+    # driver ioctl — the exact failure mode being probed for. stderr goes to a
+    # FILE, not a pipe: a chatty plugin writing >64KB to an undrained pipe
+    # would wedge an otherwise-healthy probe into a phantom timeout.
+    with tempfile.TemporaryFile() as errf:
+        probe = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL, stderr=errf,
+            start_new_session=True,
+        )
+        deadline = _time.time() + probe_timeout
+        while _time.time() < deadline:
+            rc = probe.poll()
+            if rc == 0:
+                return "default"
+            if rc is not None:
+                try:
+                    errf.seek(0)
+                    tail = errf.read()[-400:].decode("utf-8", "replace")
+                except Exception:
+                    tail = ""
+                detail = f"probe exited rc={rc}: {tail.strip()}"
+                break
+            _time.sleep(0.5)
+        else:
+            probe.kill()  # best effort; no wait() — the child may be unkillable
+            detail = f"probe timed out after {probe_timeout:.0f}s"
     os.environ.pop("JAX_PLATFORMS", None)
     print(json.dumps({"warning": "default backend unreachable; benching on CPU",
                       "detail": detail}),
@@ -265,6 +313,15 @@ def main() -> None:
         "value": round(rate, 1), "unit": "pods/s",
         "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4),
         "wall_s": round(dt, 3), "scheduled": placed, "total": total,
+    })
+
+    # ---- placement agreement vs the serial scheduler -------------------------
+    rate, total = bench_placement_agreement()
+    results.append({
+        "metric": "placement_agreement_waves_vs_serial_10k_hard",
+        "value": round(rate, 6), "unit": "fraction",
+        "vs_baseline": round(rate / 0.99, 4),  # target: >=99% agreement
+        "pods": total,
     })
 
     # ---- mesh: sharded product path on a virtual CPU mesh --------------------
