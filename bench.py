@@ -102,7 +102,7 @@ def bench_placement_agreement(n_nodes=1_000, n_pods=10_000):
     nodes, pods = synth_cluster(n_nodes, n_pods, hard_predicates=True)
 
     def census(use_waves):
-        sim = Simulator(copy.deepcopy(nodes))
+        sim = Simulator(nodes)  # the engine deep-copies its node objects
         sim.use_waves = use_waves
         failed = sim.schedule_pods(copy.deepcopy(pods))
         placed = {}

@@ -423,25 +423,12 @@ def scores(
     used = cry.nonzero + tb.grp_nonzero[g][None, :]
     least, balanced = least_balanced(used[:, 0], used[:, 1], alloc_cm[:, 0], alloc_cm[:, 1])
 
-    # Simon max-share + min-max normalize (plugin/simon.go:45-101)
     simon_s = _flr(100.0 * tb.simon_raw[g])
-    hi = jnp.max(jnp.where(F, simon_s, -jnp.inf))
-    lo = jnp.min(jnp.where(F, simon_s, jnp.inf))
-    rng = hi - lo
-    simon = jnp.where((rng > 0) & jnp.isfinite(rng), _flr((simon_s - lo) * 100.0 / rng), 0.0)
-
-    # NodeAffinity preferred (helper.DefaultNormalizeScore, reverse=false)
     na_raw = tb.nodeaff_raw[g]
-    na_max = jnp.maximum(jnp.max(jnp.where(F, na_raw, -jnp.inf)), 0.0)
-    nodeaff = jnp.where(na_max > 0, _flr(na_raw * 100.0 / na_max), 0.0)
-
-    # TaintToleration (DefaultNormalizeScore reverse=true: all-100 when max==0)
     t_raw = tb.taint_raw[g]
-    t_max = jnp.maximum(jnp.max(jnp.where(F, t_raw, -jnp.inf)), 0.0)
-    taint = jnp.where(t_max > 0, 100.0 - _flr(t_raw * 100.0 / t_max), 100.0)
 
-    # InterPodAffinity score (scoring.go): incoming preferred terms + existing pods'
-    # required (HardPodAffinityWeight=1) and preferred terms; zero-initialized min/max.
+    # InterPodAffinity raw (scoring.go): incoming preferred terms + existing pods'
+    # required (HardPodAffinityWeight=1) and preferred terms.
     cnt_at = jnp.take_along_axis(cry.counter, tb.counter_dom, axis=1)
     carr_at = jnp.take_along_axis(cry.carrier, tb.carr_dom, axis=1)
     pref_ids = tb.pref_t[g]
@@ -451,16 +438,40 @@ def scores(
     ip_raw = jnp.sum(jnp.where(pvalid[:, None], pw[:, None] * cnt_at[pidx], 0.0), axis=0)
     carr_w = (tb.carr_hard_w + tb.carr_pref_w) * tb.carr_sel_match_g[:, g]
     ip_raw = ip_raw + jnp.sum(carr_w[:, None] * carr_at, axis=0)
-    ip_max = jnp.maximum(jnp.max(jnp.where(F, ip_raw, -jnp.inf)), 0.0)
-    ip_min = jnp.minimum(jnp.min(jnp.where(F, ip_raw, jnp.inf)), 0.0)
+
+    ss_id = tb.ss_t[g]
+    has_ss = ss_id >= 0
+    pernode = cnt_at[jnp.maximum(ss_id, 0)]
+
+    # All F-masked normalizer extrema in TWO stacked reductions (each reduction
+    # is a separate pass per scan step; floats identical to separate reductions)
+    maxes = jnp.max(jnp.where(F[None, :],
+                              jnp.stack([simon_s, na_raw, t_raw, ip_raw, pernode]),
+                              -jnp.inf), axis=1)
+    mins = jnp.min(jnp.where(F[None, :], jnp.stack([simon_s, ip_raw]), jnp.inf),
+                   axis=1)
+
+    # Simon max-share + min-max normalize (plugin/simon.go:45-101)
+    hi, lo = maxes[0], mins[0]
+    rng = hi - lo
+    simon = jnp.where((rng > 0) & jnp.isfinite(rng), _flr((simon_s - lo) * 100.0 / rng), 0.0)
+
+    # NodeAffinity preferred (helper.DefaultNormalizeScore, reverse=false)
+    na_max = jnp.maximum(maxes[1], 0.0)
+    nodeaff = jnp.where(na_max > 0, _flr(na_raw * 100.0 / na_max), 0.0)
+
+    # TaintToleration (DefaultNormalizeScore reverse=true: all-100 when max==0)
+    t_max = jnp.maximum(maxes[2], 0.0)
+    taint = jnp.where(t_max > 0, 100.0 - _flr(t_raw * 100.0 / t_max), 100.0)
+
+    # InterPodAffinity normalize: zero-initialized min/max (scoring.go)
+    ip_max = jnp.maximum(maxes[3], 0.0)
+    ip_min = jnp.minimum(mins[1], 0.0)
     ip_rng = ip_max - ip_min
     interpod = jnp.where(ip_rng > 0, _flr(100.0 * (ip_raw - ip_min) / ip_rng), 0.0)
 
     # SelectorSpread (selector_spread.go:104-160): per-node count + 2/3 zone blending
-    ss_id = tb.ss_t[g]
-    has_ss = ss_id >= 0
-    pernode = cnt_at[jnp.maximum(ss_id, 0)]
-    maxN = jnp.maximum(jnp.max(jnp.where(F, pernode, -jnp.inf)), 0.0)
+    maxN = jnp.maximum(maxes[4], 0.0)
     node_score = jnp.where(maxN > 0, 100.0 * (maxN - pernode) / maxN, 100.0)
     # zone sums over feasible nodes only (NormalizeScore iterates scored nodes)
     nz_count = jnp.where(F, pernode, 0.0)

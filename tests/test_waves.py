@@ -21,10 +21,14 @@ from fixtures import make_node, make_pod, master_taint, master_toleration
 
 
 def census_of(sim: Simulator):
+    # keyed by scheduling signature, not app label: constraint-distinct pods
+    # sharing one label must count as disagreements when the paths swap them
+    from open_simulator_tpu.simulator.encode import scheduling_signature
+
     out = {}
     for i, pods in enumerate(sim.pods_on_node):
         for p in pods:
-            key = (i, labels_of(p).get("app") or name_of(p))
+            key = (i, scheduling_signature(p))
             out[key] = out.get(key, 0) + 1
     return out
 
