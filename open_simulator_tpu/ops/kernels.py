@@ -631,11 +631,12 @@ feasibility_jit = jax.jit(
 # ------------------------------------------------------------------ wave kernel -------
 #
 # A run of identical pods (one scheduling group) whose only self-interaction is
-# capacity — no host ports, no gpu/storage state, no spread terms, no
-# selector-spread, and no affinity/anti-affinity term matching the group itself
-# (hostname-topology self-anti-affinity allowed: it is exactly a per-node
-# capacity-1 clamp) — can be committed in *waves* while reproducing the serial
-# one-pod-per-step process bit-for-bit. The engine proves eligibility on the host
+# capacity — no storage state, no spread terms, no selector-spread, and no
+# affinity/anti-affinity term matching the group itself (hostname-topology
+# self-anti-affinity and host ports allowed: each is exactly a per-node
+# capacity-1 clamp, with the aggregate commit claiming the port bits) — can be
+# committed in *waves* while reproducing the serial one-pod-per-step process
+# bit-for-bit. The engine proves eligibility on the host
 # (Simulator._wave_eligibility); this kernel proves each wave equals that many
 # serial argmax picks:
 #
@@ -796,6 +797,13 @@ def _aggregate_commit(tb: Tables, cry: Carry, g, j, gpu_live: bool) -> Carry:
     D = cry.counter.shape[1] - 1
     requested = cry.requested + tb.grp_requests[g][None, :] * jf[:, None]
     nonzero = cry.nonzero + tb.grp_nonzero[g][None, :] * jf[:, None]
+    # host ports: a placed copy claims the group's port ids on its node (the
+    # serial commit's port_used writes). With NodePorts enabled, ports groups
+    # ride cap1 so j <= 1; with it disabled j may exceed 1 and the bits —
+    # idempotent — are never read.
+    pids = tb.grp_ports[g]
+    port_used = cry.port_used.at[:, pids].max(
+        ((pids > 0)[None, :]) & (j > 0)[:, None])
     cinc = tb.counter_sel_match_g[:, g, None].astype(_F32) * (tb.counter_dom < D) * jf[None, :]
     counter = cry.counter.at[jnp.arange(T)[:, None], tb.counter_dom].add(cinc)
     rinc = tb.grp_carries[g][:, None] * (tb.carr_dom < D) * jf[None, :]
@@ -821,7 +829,7 @@ def _aggregate_commit(tb: Tables, cry: Carry, g, j, gpu_live: bool) -> Carry:
         dev_used, _ = jax.lax.while_loop(
             lambda s: jnp.any(s[1] > 0), gpu_step,
             (dev_used, jnp.where(gmem > 0, j, 0)))
-    return Carry(requested, nonzero, cry.port_used, counter, carrier,
+    return Carry(requested, nonzero, port_used, counter, carrier,
                  dev_used, cry.vg_req, cry.sdev_alloc)
 
 
@@ -856,7 +864,9 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
     st = _wave_statics(tb, cry, g, w)
     capacity = jnp.where(base_feas, _wave_capacity(tb, cry, g, cap1), 0)
     if not filters.fit:
+        # resources unbounded, but cap1 (ports / self-anti-affinity) survives
         capacity = jnp.where(base_feas, 2_147_483_000, 0)
+        capacity = jnp.where(cap1, jnp.minimum(capacity, 1), capacity)
     if gpu_live:
         capacity = _gpu_capacity(tb, cry, g, capacity)
 
@@ -985,7 +995,9 @@ def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
     st = _wave_statics(tb, cry, g, w)
     capacity = jnp.where(base_feas, _wave_capacity(tb, cry, g, cap1), 0)
     if not filters.fit:
+        # resources unbounded, but cap1 (ports / self-anti-affinity) survives
         capacity = jnp.where(base_feas, 2_147_483_000, 0)
+        capacity = jnp.where(cap1, jnp.minimum(capacity, 1), capacity)
 
     dids_raw = tb.dns_t[g]                                 # [Sd]
     dvalid = dids_raw >= 0

@@ -359,17 +359,18 @@ class Simulator:
         """(eligible, cap1, spread_live, gpu_live) for group gi — see
         ops/kernels.py schedule_wave / schedule_group_serial. A group is
         batch-eligible when its placements cannot change any predicate or score
-        input that it reads itself: no host ports, no storage state, no
-        ScheduleAnyway spread terms (they feed the score), no SelectorSpread
-        counter (the default spread selector always matches the pod itself),
-        and no affinity term whose selector matches the group's own pods —
-        except hostname-topology required anti-affinity, which is exactly a
-        per-node capacity-1 clamp (cap1). Two self-interactions have dedicated
-        kernels: shared-GPU requests (gpu_live → unit-countable wave) and
-        self-matching DoNotSchedule spread terms (spread_live → fused
-        group-serial scan); a group with both stays on the general serial path.
-        Non-self-matching DoNotSchedule terms are static during the run and
-        ride the plain wave."""
+        input that it reads itself: no storage state, no ScheduleAnyway spread
+        terms (they feed the score), no SelectorSpread counter (the default
+        spread selector always matches the pod itself), and no affinity term
+        whose selector matches the group's own pods. Two self-interactions are
+        exactly per-node capacity-1 clamps (cap1): hostname-topology required
+        self-anti-affinity, and host ports while NodePorts is enabled (the
+        first copy claims the port; the aggregate commit writes the bits).
+        Two more have dedicated kernels: shared-GPU requests (gpu_live →
+        unit-countable wave) and self-matching DoNotSchedule spread terms
+        (spread_live → fused group-serial scan); a group with both stays on
+        the general serial path. Non-self-matching DoNotSchedule terms are
+        static during the run and ride the plain wave."""
         got = self._wave_elig_cache.get(gi)
         if got is not None:
             return got
@@ -384,10 +385,15 @@ class Simulator:
         # shared-GPU groups are unit-countable (kernels.schedule_wave gpu_live)
         # unless they carry a pre-assigned gpu-index (host-driven path → serial)
         gpu_live = g.gpu_mem > 0 and g.gpu_pre_ids is None
-        ok = not (g.ports or (g.gpu_mem > 0 and not gpu_live)
+        ok = not ((g.gpu_mem > 0 and not gpu_live)
                   or (gpu_live and spread_live)
                   or g.lvm_sizes or g.sdev_sizes
                   or g.spread_sa or g.ss_counter >= 0)
+        # host-port groups: the first copy claims the port, so the group is
+        # exactly a capacity-1-per-node wave (conflicts vs other pods are in
+        # the carry's port table; _aggregate_commit writes the claimed bits)
+        if ok and g.ports and self.filter_flags.ports:
+            cap1 = True
         if ok:
             for cid in list(g.req_aff) + [c for c, _ in g.pref]:
                 if enc.counter_list[cid].matches_pod(tmpl):

@@ -443,6 +443,93 @@ def test_gpu_wave_segments_are_waves():
     assert segs[0][5] is True  # gpu_live
 
 
+def test_wave_host_ports_cap1():
+    # a run of identical host-port pods is a capacity-1-per-node wave: first
+    # copy claims the port, placements spread one per node, surplus fails
+    nodes = [make_node(f"hp{i}") for i in range(6)]
+    pods = replicas("hp", 9, cpu="100m", memory="128Mi", host_ports=[8080])
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+    assert sum(wc.values()) == 6 and wf == {"hp": 3}
+
+
+def test_wave_host_ports_block_later_groups():
+    # the wave's aggregate commit must write the port bits: a later group
+    # wanting the same port only fits nodes the first group left free
+    def app_census(sim):
+        out = {}
+        for pods in sim.pods_on_node:
+            for p in pods:
+                app = labels_of(p).get("app")
+                out[app] = out.get(app, 0) + 1
+        return out
+
+    # first group length >= WAVE_MIN so it truly runs as a WAVE segment: this
+    # is the test that the wave's aggregate commit writes the port bits the
+    # second group's filter then reads
+    nodes = [make_node(f"hpx{i}") for i in range(12)]
+    first = replicas("first", 8, cpu="100m", memory="128Mi", host_ports=[9090])
+    second = replicas("second", 8, cpu="100m", memory="128Mi", host_ports=[9090])
+    wc, sc, wf, sf, wapps, sapps = run_both(nodes, [first + second],
+                                            extract=app_census)
+    assert wc == sc and wf == sf
+    assert wapps == sapps == {"first": 8, "second": 4}
+    assert wf == {"second": 4}
+
+
+def test_wave_host_ports_disabled_filter_unbounded(tmp_path):
+    # with the NodePorts plugin disabled, host ports are inert: no cap1, no
+    # conflicts — every pod schedules (and waves must agree with serial)
+    import yaml
+
+    from open_simulator_tpu.api.schedconfig import parse_scheduler_config
+
+    cfg_path = tmp_path / "sched.yaml"
+    cfg_path.write_text(yaml.safe_dump({
+        "apiVersion": "kubescheduler.config.k8s.io/v1beta1",
+        "kind": "KubeSchedulerConfiguration",
+        "profiles": [{"plugins": {"filter": {"disabled": [{"name": "NodePorts"}]}}}],
+    }))
+    cfg = parse_scheduler_config(str(cfg_path))
+    nodes = [make_node(f"hpd{i}") for i in range(3)]
+    pods = replicas("hpd", 9, cpu="100m", memory="128Mi", host_ports=[7070])
+    results = []
+    for waves in (True, False):
+        sim = Simulator(copy.deepcopy(nodes), sched_config=cfg)
+        sim.use_waves = waves
+        failed = sim.schedule_pods(copy.deepcopy(pods))
+        results.append((census_of(sim), len(failed)))
+    assert results[0] == results[1]
+    assert results[0][1] == 0 and sum(results[0][0].values()) == 9
+
+
+def test_wave_host_ports_cap1_survives_fit_disabled(tmp_path):
+    # NodeResourcesFit disabled + NodePorts enabled: capacity is unbounded but
+    # the port clamp must survive — waves may not stack same-port copies
+    import yaml
+
+    from open_simulator_tpu.api.schedconfig import parse_scheduler_config
+
+    cfg_path = tmp_path / "sched.yaml"
+    cfg_path.write_text(yaml.safe_dump({
+        "apiVersion": "kubescheduler.config.k8s.io/v1beta1",
+        "kind": "KubeSchedulerConfiguration",
+        "profiles": [{"plugins": {
+            "filter": {"disabled": [{"name": "NodeResourcesFit"}]}}}],
+    }))
+    cfg = parse_scheduler_config(str(cfg_path))
+    nodes = [make_node(f"hpf{i}") for i in range(6)]
+    pods = replicas("hpf", 9, cpu="100m", memory="128Mi", host_ports=[8081])
+    results = []
+    for waves in (True, False):
+        sim = Simulator(copy.deepcopy(nodes), sched_config=cfg)
+        sim.use_waves = waves
+        failed = sim.schedule_pods(copy.deepcopy(pods))
+        results.append((census_of(sim), len(failed)))
+    assert results[0] == results[1]
+    assert results[0][1] == 3 and sum(results[0][0].values()) == 6
+
+
 @pytest.mark.parametrize("seed", [7, 23, 101, 555])
 def test_wave_fuzz_mixed_workloads(seed):
     """Randomized waves-vs-serial sweep: random node shapes (zones, taints,
