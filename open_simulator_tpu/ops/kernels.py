@@ -965,27 +965,37 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
     return _aggregate_commit(tb, cry, g, j, gpu_live), j, placed
 
 
-@partial(jax.jit, static_argnames=("w", "filters"))
+@partial(jax.jit, static_argnames=("w", "filters", "ss_live", "n_zones"))
 def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
                           w: ScoreWeights = DEFAULT_WEIGHTS,
-                          filters: FilterFlags = DEFAULT_FILTERS):
-    """Serial scheduling of one group with self-interacting DoNotSchedule
-    topology-spread constraints, as a FUSED scan: exactly the reference's
+                          filters: FilterFlags = DEFAULT_FILTERS,
+                          ss_live: bool = False, n_zones: int = 2):
+    """Serial scheduling of one group whose placements feed back into its own
+    scoring/filtering through per-node copy counts — self-matching
+    DoNotSchedule topology-spread constraints and/or a live SelectorSpread
+    counter (a service-backed workload spreading against itself: the most
+    common real-cluster app shape) — as a FUSED scan: exactly the reference's
     one-pod-per-cycle process (same per-step feasible set and scores as
     _step/scores()), but each step is specialized to what can actually change
-    within a single-group run — per-node copy counts and the group's own spread
-    counters. Everything else (taints, affinity counters, carriers, normalizer
-    *inputs*, static score vectors) is provably constant and hoisted out, so a
-    step costs a few [N]-wide ops + an [Sd, D+1] reduce instead of the general
-    scan step's [T, N] gathers and [T, D+1] scatters (the reason spread-heavy
-    workloads crawled at ~400 pods/s before this kernel).
+    within a single-group run — per-node copy counts and the group's own
+    spread/selector counters. Everything else (taints, affinity counters,
+    carriers, normalizer *inputs*, static score vectors) is provably constant
+    and hoisted out, so a step costs a few [N]-wide ops + an [Sd, D+1] reduce
+    instead of the general scan step's [T, N] gathers and [T, D+1] scatters
+    (the reason spread-heavy workloads crawled at ~400 pods/s before this
+    kernel).
 
     `valid` is a [P] bool mask (padded scan length); returns
     (new carry, per-node counts [N] i32, placed i32).
 
+    ss_live (static): compute the SelectorSpread score live — per-node count
+    plus 2/3-zone blending (selector_spread.go:104-160) over base counts + j.
+    n_zones (static): zone-table size for the blend, as in scores().
+
     Dropped-constant notes (argmax-invariant, same as _wave_score_table):
-    SelectorSpread (ss_skip => 0 for spread pods), PodTopologySpread score
-    (no ScheduleAnyway terms by eligibility => 100 on F), OpenLocal (0)."""
+    SelectorSpread when NOT ss_live (ss_skip => 0 for explicit-constraint
+    pods), PodTopologySpread score (no ScheduleAnyway terms by eligibility =>
+    100 on F), OpenLocal (0)."""
     N = tb.alloc.shape[0]
     D = cry.counter.shape[1] - 1
     base_feas, _ = feasibility(
@@ -1012,6 +1022,15 @@ def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
     Sd = dids.shape[0]
     alloc_cm = tb.alloc[:, (CPU_I, MEM_I)]                 # [N, 2]
     gnz = tb.grp_nonzero[g]
+    if ss_live:
+        # SelectorSpread live state: the group's own counter is hostname-
+        # topology (encode.py ss_counter), so per-node counts are exactly
+        # base counts + j; zone sums re-aggregate per step over current F
+        ss_id = jnp.maximum(tb.ss_t[g], 0)
+        # one row's gather, not the [T, N] cnt_at scores() needs for interpod
+        base_pernode = cry.counter[ss_id][tb.counter_dom[ss_id]]       # [N]
+        zones = tb.node_zone
+        Z = max(2, n_zones)
 
     # Precompute the count-dependent score column OUTSIDE the scan: entry
     # (n, k) = w.least*least + w.balanced*balanced for the (k+1)-th copy on
@@ -1061,6 +1080,22 @@ def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
                              _flr(100.0 * (st["ip_raw"] - ip_min) / ip_rng), 0.0)
         score = (lb + (w.simon + w.gpushare) * simon + w.nodeaff * nodeaff
                  + w.taint * taint + w.interpod * interpod + st["static"])
+        if ss_live:
+            # SelectorSpread (selector_spread.go:104-160), formulas as in
+            # scores() with pernode = base + j
+            pernode = base_pernode + j.astype(_F32)
+            maxN = jnp.maximum(jnp.max(jnp.where(F, pernode, -jnp.inf)), 0.0)
+            node_score = jnp.where(maxN > 0, 100.0 * (maxN - pernode) / maxN, 100.0)
+            nz_count = jnp.where(F, pernode, 0.0)
+            zone_sums = jnp.zeros((Z,), _F32).at[zones].add(nz_count)
+            maxZ = jnp.max(zone_sums.at[0].set(0.0))
+            have_zones = jnp.any(F & (zones > 0))
+            zscore = jnp.where(maxZ > 0, 100.0 * (maxZ - zone_sums[zones]) / maxZ,
+                               100.0)
+            blended = jnp.where(have_zones & (zones > 0),
+                                node_score * (1.0 / 3.0) + zscore * (2.0 / 3.0),
+                                node_score)
+            score = score + w.ss * _flr(blended)
         choice = jnp.argmax(jnp.where(F, score, -jnp.inf)).astype(jnp.int32)
         do = any_f.astype(jnp.int32)
         j = j.at[choice].add(do)

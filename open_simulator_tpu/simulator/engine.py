@@ -185,7 +185,7 @@ class Simulator:
         self.use_waves = True
         self.use_mesh = use_mesh
         self._mesh = _UNSET
-        self._wave_elig_cache: Dict[int, Tuple[bool, bool, bool, bool]] = {}
+        self._wave_elig_cache: Dict[int, Tuple[bool, bool, bool, bool, bool]] = {}
 
     # ------------------------------------------------------------- state ----------
 
@@ -355,8 +355,8 @@ class Simulator:
         # cache warm across probes. Phantom nodes are infeasible by construction.
         return pad_batch_tables(bt, bucket_capped(self.na.N, 1024))
 
-    def _wave_eligibility(self, gi: int) -> Tuple[bool, bool, bool, bool]:
-        """(eligible, cap1, spread_live, gpu_live) for group gi — see
+    def _wave_eligibility(self, gi: int) -> Tuple[bool, bool, bool, bool, bool]:
+        """(eligible, cap1, spread_live, gpu_live, ss_live) for group gi — see
         ops/kernels.py schedule_wave / schedule_group_serial. A group is
         batch-eligible when its placements cannot change any predicate or score
         input that it reads itself: no storage state, no ScheduleAnyway spread
@@ -385,10 +385,15 @@ class Simulator:
         # shared-GPU groups are unit-countable (kernels.schedule_wave gpu_live)
         # unless they carry a pre-assigned gpu-index (host-driven path → serial)
         gpu_live = g.gpu_mem > 0 and g.gpu_pre_ids is None
+        # live SelectorSpread: the default spread selector always matches the
+        # group's own pods, so the score moves with every placement — the
+        # fused group-serial kernel computes it live. A zero SelectorSpread
+        # weight makes the term inert and the group plain-wave eligible.
+        ss_live = g.ss_counter >= 0 and self.score_w.ss != 0
         ok = not ((g.gpu_mem > 0 and not gpu_live)
-                  or (gpu_live and spread_live)
+                  or (gpu_live and (spread_live or ss_live))
                   or g.lvm_sizes or g.sdev_sizes
-                  or g.spread_sa or g.ss_counter >= 0)
+                  or g.spread_sa)
         # host-port groups: the first copy claims the port, so the group is
         # exactly a capacity-1-per-node wave (conflicts vs other pods are in
         # the carry's port table; _aggregate_commit writes the claimed bits)
@@ -415,15 +420,15 @@ class Simulator:
                     else:
                         ok = False
                         break
-        got = (ok, cap1, ok and spread_live, ok and gpu_live)
+        got = (ok, cap1, ok and spread_live, ok and gpu_live, ok and ss_live)
         self._wave_elig_cache[gi] = got
         return got
 
     def _segments(self, bt: BatchTables, P: int) -> List[tuple]:
         """Split the batch into maximal runs of one (group, forced) pair; eligible
         runs of >= WAVE_MIN become ('wave', start, len, g, cap1, gpu_live) or
-        ('spread', start, len, g, cap1) segments, the rest coalesce into
-        ('serial', start, len) chunks."""
+        ('spread', start, len, g, cap1, ss_live) segments, the rest coalesce
+        into ('serial', start, len) chunks."""
         pg = np.asarray(bt.pod_group[:P])
         fn = np.asarray(bt.forced_node[:P])
         # vectorized run boundaries: one np.diff pass instead of a per-pod loop
@@ -435,14 +440,15 @@ class Simulator:
         for i, j in zip(starts.tolist(), ends.tolist()):
             g, f = int(pg[i]), int(fn[i])
             run = j - i
-            elig, cap1, spread_live, gpu_live = (
-                self._wave_eligibility(g) if f < 0 else (False, False, False, False))
+            elig, cap1, spread_live, gpu_live, ss_live = (
+                self._wave_eligibility(g) if f < 0
+                else (False, False, False, False, False))
             if elig and run >= WAVE_MIN:
                 if ser_start is not None:
                     segs.append(("serial", ser_start, i - ser_start))
                     ser_start = None
-                if spread_live:
-                    segs.append(("spread", i, run, g, cap1))
+                if spread_live or ss_live:
+                    segs.append(("spread", i, run, g, cap1, ss_live))
                 else:
                     segs.append(("wave", i, run, g, cap1, gpu_live))
             elif ser_start is None:
@@ -494,13 +500,14 @@ class Simulator:
                 )
                 outs.append((seg, ch, carry))
             elif seg[0] == "spread":
-                _, start, length, g, cap1 = seg
+                _, start, length, g, cap1, ss_live = seg
                 pad = bucket_capped(length, 2048)
                 vd = np.zeros(pad, bool)
                 vd[:length] = True
                 carry, counts, _ = kernels.schedule_group_serial(
                     tables, carry, jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1),
                     w=self.score_w, filters=self.filter_flags,
+                    ss_live=ss_live, n_zones=bt.n_zones,
                 )
                 outs.append((seg, counts, carry))
             else:
@@ -634,13 +641,14 @@ class Simulator:
                 )
                 placed_parts.append(jnp.sum((ch >= 0).astype(jnp.int32)))
             elif seg[0] == "spread":
-                _, start, length, g, cap1 = seg
+                _, start, length, g, cap1, ss_live = seg
                 pad = bucket_capped(length, 2048)
                 vd = np.zeros(pad, bool)
                 vd[:length] = True
                 carry, _, placed = kernels.schedule_group_serial(
                     tables, carry, jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1),
                     w=self.score_w, filters=self.filter_flags,
+                    ss_live=ss_live, n_zones=bt.n_zones,
                 )
                 placed_parts.append(placed)
             else:

@@ -33,7 +33,7 @@ def census_of(sim: Simulator):
     return out
 
 
-def run_both(nodes, batches, extract=None):
+def run_both(nodes, batches, extract=None, services=None):
     """batches: list of pod lists scheduled via consecutive schedule_pods calls.
     Returns (wave_census, serial_census, wave_failed, serial_failed) plus, when
     `extract` is given, its per-sim result appended for each path."""
@@ -41,6 +41,11 @@ def run_both(nodes, batches, extract=None):
     for waves in (True, False):
         sim = Simulator(copy.deepcopy(nodes))
         sim.use_waves = waves
+        if services:
+            from open_simulator_tpu.core.types import ResourceTypes
+
+            sim.register_cluster_objects(
+                ResourceTypes(services=copy.deepcopy(services)))
         failed = []
         for batch in batches:
             failed.extend(sim.schedule_pods(copy.deepcopy(batch)))
@@ -503,6 +508,91 @@ def test_wave_host_ports_disabled_filter_unbounded(tmp_path):
     assert results[0][1] == 0 and sum(results[0][0].values()) == 9
 
 
+def _service(name, selector, namespace="default"):
+    return {"apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {"selector": dict(selector)}}
+
+
+def _run_both_with_services(nodes, services, batches):
+    wc, sc, wf, sf, wn, sn = run_both(
+        nodes, batches, services=services,
+        extract=lambda sim: [len(p) for p in sim.pods_on_node])
+    return wc, sc, sum(wf.values()), sum(sf.values()), wn, sn
+
+
+def test_ss_live_service_backed_deployment_waves():
+    # a service-backed workload spreads against its own per-node/zone counts
+    # (live SelectorSpread) — routed through the fused group-serial kernel,
+    # which must match the pure serial scan placement for placement
+    nodes = [make_node(f"ss{i}", labels={"topology.kubernetes.io/zone": f"z{i % 3}"})
+             for i in range(9)]
+    svc = _service("web-svc", {"app": "web"})
+    pods = replicas("web", 24, cpu="200m", memory="256Mi",
+                    labels={"app": "web"})
+    wc, sc, wf, sf, wn, sn = _run_both_with_services(nodes, [svc], [pods])
+    assert wc == sc and wf == sf
+    assert sum(wn) == 24 and wf == 0
+    # SelectorSpread actually spreads: per-node counts stay near-balanced
+    assert max(wn) - min(wn) <= 2
+
+
+def test_ss_live_seeded_counts_respected():
+    # pods of the same service already placed (earlier batch) must seed the
+    # live per-node counts: the second batch avoids the loaded nodes first
+    nodes = [make_node(f"ssb{i}") for i in range(4)]
+    svc = _service("api-svc", {"app": "api"})
+    first = replicas("api", 4, cpu="100m", memory="128Mi", labels={"app": "api"})
+    second = replicas("api", 8, start=4, cpu="100m", memory="128Mi",
+                      labels={"app": "api"})
+    wc, sc, wf, sf, wn, sn = _run_both_with_services(nodes, [svc], [first, second])
+    assert wc == sc and wf == sf
+    assert sum(wn) == 12 and max(wn) == 3 and min(wn) == 3
+
+
+def test_ss_live_zero_weight_rides_plain_wave(tmp_path):
+    # SelectorSpread weight 0 via scheduler config makes the term inert: the
+    # group becomes plain-wave eligible and must still match serial
+    import yaml
+
+    from open_simulator_tpu.api.schedconfig import parse_scheduler_config
+    from open_simulator_tpu.core.types import ResourceTypes
+
+    cfg_path = tmp_path / "sched.yaml"
+    cfg_path.write_text(yaml.safe_dump({
+        "apiVersion": "kubescheduler.config.k8s.io/v1beta1",
+        "kind": "KubeSchedulerConfiguration",
+        "profiles": [{"plugins": {"score": {"disabled": [{"name": "SelectorSpread"}]}}}],
+    }))
+    cfg = parse_scheduler_config(str(cfg_path))
+    nodes = [make_node(f"ssz{i}") for i in range(4)]
+    svc = _service("z-svc", {"app": "z"})
+    pods = replicas("z", 12, cpu="100m", memory="128Mi", labels={"app": "z"})
+    results = []
+    for waves in (True, False):
+        sim = Simulator(copy.deepcopy(nodes), sched_config=cfg)
+        sim.use_waves = waves
+        sim.register_cluster_objects(ResourceTypes(services=[copy.deepcopy(svc)]))
+        failed = sim.schedule_pods(copy.deepcopy(pods))
+        results.append((census_of(sim), len(failed)))
+        if waves:
+            # eligibility: plain wave (not the spread kernel), ss_live False
+            segs = {s[0] for s in sim._segments(sim._last_tables, 12)}
+            assert segs == {"wave"}
+    assert results[0] == results[1]
+
+
+def test_ss_live_with_self_anti_affinity_cap1():
+    # service + hostname self-anti-affinity: live SelectorSpread AND cap1
+    nodes = [make_node(f"ssa{i}") for i in range(6)]
+    svc = _service("a-svc", {"app": "a"})
+    pods = replicas("a", 9, cpu="100m", memory="128Mi",
+                    labels={"app": "a"}, affinity=anti_affinity("a"))
+    wc, sc, wf, sf, wn, sn = _run_both_with_services(nodes, [svc], [pods])
+    assert wc == sc and wf == sf
+    assert sum(wn) == 6 and wf == 3 and max(wn) == 1
+
+
 def test_wave_host_ports_cap1_survives_fit_disabled(tmp_path):
     # NodeResourcesFit disabled + NodePorts enabled: capacity is unbounded but
     # the port clamp must survive — waves may not stack same-port copies
@@ -602,10 +692,16 @@ def test_wave_fuzz_mixed_workloads(seed):
         return pods
 
     all_pods = []
+    services = []
     for bi in range(rng.randint(4, 8)):
-        all_pods.extend(block(bi, rng.randint(0, 5), rng.randint(2, 30)))
+        kind = rng.randint(0, 5)
+        all_pods.extend(block(bi, kind, rng.randint(2, 30)))
+        # ~1/3 of blocks are service-backed → live SelectorSpread coverage
+        if kind != 3 and rng.random() < 0.35:
+            services.append(_service(f"svc-{bi}", {"app": f"fz-app{bi}"}))
     cut = rng.randint(0, len(all_pods))
-    wc, sc, wf, sf = run_both(nodes, [all_pods[:cut], all_pods[cut:]])
+    wc, sc, wf, sf = run_both(nodes, [all_pods[:cut], all_pods[cut:]],
+                              services=services)
     assert wc == sc
     assert wf == sf
 
