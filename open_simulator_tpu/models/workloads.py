@@ -148,12 +148,33 @@ def _stamp_sig_memo(pods: List[dict]) -> List[dict]:
 
 
 def _pods_from_template(owner: dict, kind: str, replicas: int, template: dict) -> List[dict]:
-    pods = []
-    for _ in range(replicas):
-        pod = {"metadata": _object_meta_from(owner, template, kind), "spec": copy.deepcopy(template.get("spec") or {})}
-        pod = make_valid_pod(pod)
-        _add_workload_info(pod, kind, name_of(owner), namespace_of(owner))
-        pods.append(pod)
+    """Replicas of one template differ only in metadata.name/uid (generated
+    here), so defaulting + sanitization + validation run ONCE on a prototype
+    and the remaining replicas are byte-copies with fresh name/uid — the
+    reference fans this out over goroutines instead
+    (pkg/simulator/utils.go:77-115); one validated prototype is both faster
+    and equally exact, since make_valid_pod is deterministic and never reads
+    the generated name."""
+    if replicas <= 0:
+        return _stamp_sig_memo([])
+    proto = {
+        "metadata": _object_meta_from(owner, template, kind),
+        "spec": copy.deepcopy(template.get("spec") or {}),
+    }
+    proto = make_valid_pod(proto)
+    _add_workload_info(proto, kind, name_of(owner), namespace_of(owner))
+    pods = [proto]
+    if replicas > 1:
+        import pickle
+
+        owner_name = name_of(owner)
+        blob = pickle.dumps(proto, -1)  # ~3x faster than deepcopy for dicts
+        for _ in range(replicas - 1):
+            pod = pickle.loads(blob)
+            md = pod["metadata"]
+            md["name"] = f"{owner_name}-{_suffix()}"
+            md["uid"] = _uid()
+            pods.append(pod)
     return _stamp_sig_memo(pods)
 
 

@@ -153,6 +153,25 @@ def _flr(x):
     return jnp.floor(x)
 
 
+def selector_spread_score(pernode, F, zones, Z: int, maxN=None):
+    """SelectorSpread (selector_spread.go:104-160): per-node count score with
+    2/3 zone blending, over the feasible set F. THE single source of this
+    formula — scores() and the ss_live fused scan must stay bit-identical,
+    since wave==serial parity rides on it. Returns the unfloored blend; the
+    caller applies skip/has_ss gating and _flr. `maxN` lets scores() reuse
+    its stacked-reduction maximum (same float by construction)."""
+    if maxN is None:
+        maxN = jnp.maximum(jnp.max(jnp.where(F, pernode, -jnp.inf)), 0.0)
+    node_score = jnp.where(maxN > 0, 100.0 * (maxN - pernode) / maxN, 100.0)
+    nz_count = jnp.where(F, pernode, 0.0)
+    zone_sums = jnp.zeros((Z,), _F32).at[zones].add(nz_count)
+    maxZ = jnp.max(zone_sums.at[0].set(0.0))
+    have_zones = jnp.any(F & (zones > 0))
+    zscore = jnp.where(maxZ > 0, 100.0 * (maxZ - zone_sums[zones]) / maxZ, 100.0)
+    return jnp.where(have_zones & (zones > 0),
+                     node_score * (1.0 / 3.0) + zscore * (2.0 / 3.0), node_score)
+
+
 def least_balanced(used_c, used_m, a_c, a_m):
     """NodeResourcesLeastAllocated (least_allocated.go:93-115, integer divisions
     floored) + NodeResourcesBalancedAllocation (balanced_allocation.go:96-120)
@@ -470,18 +489,10 @@ def scores(
     ip_rng = ip_max - ip_min
     interpod = jnp.where(ip_rng > 0, _flr(100.0 * (ip_raw - ip_min) / ip_rng), 0.0)
 
-    # SelectorSpread (selector_spread.go:104-160): per-node count + 2/3 zone blending
-    maxN = jnp.maximum(maxes[4], 0.0)
-    node_score = jnp.where(maxN > 0, 100.0 * (maxN - pernode) / maxN, 100.0)
-    # zone sums over feasible nodes only (NormalizeScore iterates scored nodes)
-    nz_count = jnp.where(F, pernode, 0.0)
-    zones = tb.node_zone
-    zone_sums = jnp.zeros((max(2, n_zones),), _F32).at[zones].add(nz_count)
-    maxZ = jnp.max(zone_sums.at[0].set(0.0))
-    have_zones = jnp.any(F & (zones > 0))
-    zscore = jnp.where(maxZ > 0, 100.0 * (maxZ - zone_sums[zones]) / maxZ, 100.0)
-    blended = jnp.where(have_zones & (zones > 0),
-                        node_score * (1.0 / 3.0) + zscore * (2.0 / 3.0), node_score)
+    # SelectorSpread: shared single-source formula (zone sums over feasible
+    # nodes only — NormalizeScore iterates scored nodes)
+    blended = selector_spread_score(pernode, F, tb.node_zone, max(2, n_zones),
+                                    maxN=jnp.maximum(maxes[4], 0.0))
     selector_spread = jnp.where(
         tb.ss_skip[g], 0.0, jnp.where(has_ss, _flr(blended), 100.0)
     )
@@ -1081,21 +1092,10 @@ def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
         score = (lb + (w.simon + w.gpushare) * simon + w.nodeaff * nodeaff
                  + w.taint * taint + w.interpod * interpod + st["static"])
         if ss_live:
-            # SelectorSpread (selector_spread.go:104-160), formulas as in
-            # scores() with pernode = base + j
+            # live SelectorSpread: shared formula with pernode = base + j
             pernode = base_pernode + j.astype(_F32)
-            maxN = jnp.maximum(jnp.max(jnp.where(F, pernode, -jnp.inf)), 0.0)
-            node_score = jnp.where(maxN > 0, 100.0 * (maxN - pernode) / maxN, 100.0)
-            nz_count = jnp.where(F, pernode, 0.0)
-            zone_sums = jnp.zeros((Z,), _F32).at[zones].add(nz_count)
-            maxZ = jnp.max(zone_sums.at[0].set(0.0))
-            have_zones = jnp.any(F & (zones > 0))
-            zscore = jnp.where(maxZ > 0, 100.0 * (maxZ - zone_sums[zones]) / maxZ,
-                               100.0)
-            blended = jnp.where(have_zones & (zones > 0),
-                                node_score * (1.0 / 3.0) + zscore * (2.0 / 3.0),
-                                node_score)
-            score = score + w.ss * _flr(blended)
+            score = score + w.ss * _flr(
+                selector_spread_score(pernode, F, zones, Z))
         choice = jnp.argmax(jnp.where(F, score, -jnp.inf)).astype(jnp.int32)
         do = any_f.astype(jnp.int32)
         j = j.at[choice].add(do)

@@ -507,7 +507,9 @@ class Simulator:
                 carry, counts, _ = kernels.schedule_group_serial(
                     tables, carry, jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1),
                     w=self.score_w, filters=self.filter_flags,
-                    ss_live=ss_live, n_zones=bt.n_zones,
+                    # n_zones only shapes the ss_live zone table; pin it for
+                    # DNS-only segments so new zone labels don't recompile them
+                    ss_live=ss_live, n_zones=bt.n_zones if ss_live else 2,
                 )
                 outs.append((seg, counts, carry))
             else:
@@ -648,7 +650,9 @@ class Simulator:
                 carry, _, placed = kernels.schedule_group_serial(
                     tables, carry, jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1),
                     w=self.score_w, filters=self.filter_flags,
-                    ss_live=ss_live, n_zones=bt.n_zones,
+                    # n_zones only shapes the ss_live zone table; pin it for
+                    # DNS-only segments so new zone labels don't recompile them
+                    ss_live=ss_live, n_zones=bt.n_zones if ss_live else 2,
                 )
                 placed_parts.append(placed)
             else:
