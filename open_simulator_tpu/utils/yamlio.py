@@ -37,11 +37,16 @@ def read_yaml_files(directory: str) -> List[str]:
     return contents
 
 
+# libyaml-backed loader when present: 5-10x faster parsing, which matters for
+# multi-thousand-node cluster dumps; semantics identical to SafeLoader
+_YAML_LOADER = getattr(yaml, "CSafeLoader", yaml.SafeLoader)
+
+
 def decode_yaml_content(contents: Iterable[str]) -> List[dict]:
     """Split multi-doc YAML strings into object dicts, skipping empty docs."""
     objs = []
     for content in contents:
-        for doc in yaml.safe_load_all(content):
+        for doc in yaml.load_all(content, Loader=_YAML_LOADER):
             if isinstance(doc, dict) and doc:
                 objs.append(doc)
     return objs
