@@ -172,6 +172,30 @@ def selector_spread_score(pernode, F, zones, Z: int, maxN=None):
                      node_score * (1.0 / 3.0) + zscore * (2.0 / 3.0), node_score)
 
 
+def schedule_anyway_score(cnt_sa, relevantF, dom_rows, svalid, maxskew, D: int):
+    """PodTopologySpread ScheduleAnyway scoring (scoring.go:108-200) from the
+    per-term per-node counts: ln(topology size + 2) weights, maxSkew - 1
+    offsets, integer floor, then the plugin's (max + min - raw) * 100 / max
+    normalization over the relevant feasible set. THE single source of this
+    formula — scores() and the sa_live fused scan must stay bit-identical."""
+    Ss = dom_rows.shape[0]
+    marks = jnp.zeros((Ss, D + 1), _F32).at[
+        jnp.arange(Ss)[:, None], dom_rows
+    ].max(jnp.broadcast_to(relevantF.astype(_F32), dom_rows.shape))
+    topo_size = jnp.sum(marks[:, :D], axis=1)
+    tpw = jnp.log(topo_size + 2.0)
+    contrib = cnt_sa * tpw[:, None] + (maxskew[:, None] - 1.0)
+    sa_raw = _flr(jnp.sum(jnp.where(svalid[:, None], contrib, 0.0), axis=0))
+    sa_max = jnp.maximum(jnp.max(jnp.where(relevantF, sa_raw, -jnp.inf)), 0.0)
+    sa_min_raw = jnp.min(jnp.where(relevantF, sa_raw, jnp.inf))
+    sa_min = jnp.where(jnp.isfinite(sa_min_raw), sa_min_raw, 0.0)
+    return jnp.where(
+        ~relevantF,
+        0.0,
+        jnp.where(sa_max > 0, _flr((sa_max + sa_min - sa_raw) * 100.0 / sa_max), 100.0),
+    )
+
+
 def least_balanced(used_c, used_m, a_c, a_m):
     """NodeResourcesLeastAllocated (least_allocated.go:93-115, integer divisions
     floored) + NodeResourcesBalancedAllocation (balanced_allocation.go:96-120)
@@ -497,7 +521,7 @@ def scores(
         tb.ss_skip[g], 0.0, jnp.where(has_ss, _flr(blended), 100.0)
     )
 
-    # PodTopologySpread ScheduleAnyway scoring (scoring.go:108-200)
+    # PodTopologySpread ScheduleAnyway scoring: shared single-source formula
     D = cry.counter.shape[1] - 1
     sa_ids = tb.sa_t[g]
     svalid = sa_ids >= 0
@@ -505,23 +529,8 @@ def scores(
     key_present = tb.counter_dom < D
     ignored = jnp.any(svalid[:, None] & ~key_present[sidx], axis=0)
     relevantF = F & ~ignored
-    Ss = sa_ids.shape[0]
-    dom_rows = tb.counter_dom[sidx]                                        # [Ss, N]
-    marks = jnp.zeros((Ss, D + 1), _F32).at[
-        jnp.arange(Ss)[:, None], dom_rows
-    ].max(jnp.broadcast_to(relevantF.astype(_F32), dom_rows.shape))
-    topo_size = jnp.sum(marks[:, :D], axis=1)
-    tpw = jnp.log(topo_size + 2.0)
-    contrib = cnt_at[sidx] * tpw[:, None] + (tb.sa_maxskew[g][:, None] - 1.0)
-    sa_raw = _flr(jnp.sum(jnp.where(svalid[:, None], contrib, 0.0), axis=0))
-    sa_max = jnp.maximum(jnp.max(jnp.where(relevantF, sa_raw, -jnp.inf)), 0.0)
-    sa_min_raw = jnp.min(jnp.where(relevantF, sa_raw, jnp.inf))
-    sa_min = jnp.where(jnp.isfinite(sa_min_raw), sa_min_raw, 0.0)
-    pts = jnp.where(
-        ~relevantF,
-        0.0,
-        jnp.where(sa_max > 0, _flr((sa_max + sa_min - sa_raw) * 100.0 / sa_max), 100.0),
-    )
+    pts = schedule_anyway_score(cnt_at[sidx], relevantF, tb.counter_dom[sidx],
+                                svalid, tb.sa_maxskew[g], D)
 
     # Open-Local Score (open-local.go:94-172): Binpack LVM + device ints, then the
     # plugin's own min-max NormalizeScore. Pods without volumes raw-score 0 on
@@ -976,11 +985,12 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
     return _aggregate_commit(tb, cry, g, j, gpu_live), j, placed
 
 
-@partial(jax.jit, static_argnames=("w", "filters", "ss_live", "n_zones"))
+@partial(jax.jit, static_argnames=("w", "filters", "ss_live", "sa_live", "n_zones"))
 def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
                           w: ScoreWeights = DEFAULT_WEIGHTS,
                           filters: FilterFlags = DEFAULT_FILTERS,
-                          ss_live: bool = False, n_zones: int = 2):
+                          ss_live: bool = False, sa_live: bool = False,
+                          n_zones: int = 2):
     """Serial scheduling of one group whose placements feed back into its own
     scoring/filtering through per-node copy counts — self-matching
     DoNotSchedule topology-spread constraints and/or a live SelectorSpread
@@ -1002,11 +1012,14 @@ def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
     ss_live (static): compute the SelectorSpread score live — per-node count
     plus 2/3-zone blending (selector_spread.go:104-160) over base counts + j.
     n_zones (static): zone-table size for the blend, as in scores().
+    sa_live (static): compute the PodTopologySpread ScheduleAnyway score live
+    — the group carries soft spread terms, whose counters (for self-matching
+    selectors) and relevant-set normalizers move with every placement.
 
     Dropped-constant notes (argmax-invariant, same as _wave_score_table):
     SelectorSpread when NOT ss_live (ss_skip => 0 for explicit-constraint
-    pods), PodTopologySpread score (no ScheduleAnyway terms by eligibility =>
-    100 on F), OpenLocal (0)."""
+    pods), PodTopologySpread score when NOT sa_live (no ScheduleAnyway terms
+    => 100 on F), OpenLocal (0)."""
     N = tb.alloc.shape[0]
     D = cry.counter.shape[1] - 1
     base_feas, _ = feasibility(
@@ -1042,6 +1055,20 @@ def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
         base_pernode = cry.counter[ss_id][tb.counter_dom[ss_id]]       # [N]
         zones = tb.node_zone
         Z = max(2, n_zones)
+    if sa_live:
+        # ScheduleAnyway live state: per-term counter rows; counts move for
+        # self-matching selectors, the relevant-set normalizers move with F
+        sa_ids = tb.sa_t[g]                                # [Ss]
+        svalid = sa_ids >= 0
+        sidx = jnp.maximum(sa_ids, 0)
+        sa_dom_rows = tb.counter_dom[sidx]                 # [Ss, N]
+        sa_ignored = jnp.any(svalid[:, None] & (sa_dom_rows >= D), axis=0)
+        sa_match = (tb.counter_sel_match_g[sidx, g] & svalid).astype(_F32)
+        sa_maxskew = tb.sa_maxskew[g]
+        cnt_sa0 = cry.counter[sidx]                        # [Ss, D+1]
+        Ss = sidx.shape[0]
+    else:
+        cnt_sa0 = jnp.zeros((1, D + 1), _F32)              # inert carry slot
 
     # Precompute the count-dependent score column OUTSIDE the scan: entry
     # (n, k) = w.least*least + w.balanced*balanced for the (k+1)-th copy on
@@ -1061,7 +1088,7 @@ def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
         lb_table = None
 
     def step(state, ok):
-        j, cnt = state
+        j, cnt, cnt_sa = state
         # live DoNotSchedule filter, mirroring feasibility() term for term
         cnt_at = jnp.take_along_axis(cnt, dom_rows, axis=1)           # [Sd, N]
         min_c = jnp.min(jnp.where(edom, cnt, jnp.inf), axis=1)
@@ -1096,13 +1123,25 @@ def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
             pernode = base_pernode + j.astype(_F32)
             score = score + w.ss * _flr(
                 selector_spread_score(pernode, F, zones, Z))
+        if sa_live:
+            # live ScheduleAnyway: shared formula over current counts + F
+            cnt_at_sa = jnp.take_along_axis(cnt_sa, sa_dom_rows, axis=1)
+            score = score + w.pts * schedule_anyway_score(
+                cnt_at_sa, F & ~sa_ignored, sa_dom_rows, svalid, sa_maxskew, D)
         choice = jnp.argmax(jnp.where(F, score, -jnp.inf)).astype(jnp.int32)
         do = any_f.astype(jnp.int32)
         j = j.at[choice].add(do)
         cnt = cnt.at[jnp.arange(Sd), dom_rows[:, choice]].add(dmatch * do)
-        return (j, cnt), do
+        if sa_live:
+            # sentinel-masked like commit(): a pod may land on a node missing
+            # the SA topology key (score-only plugin, unlike the DNS filter)
+            sa_dom_c = sa_dom_rows[:, choice]
+            cnt_sa = cnt_sa.at[jnp.arange(Ss), sa_dom_c].add(
+                sa_match * (sa_dom_c < D) * do)
+        return (j, cnt, cnt_sa), do
 
-    (j, _), dos = jax.lax.scan(step, (jnp.zeros(N, jnp.int32), cnt0), valid)
+    (j, _, _), dos = jax.lax.scan(
+        step, (jnp.zeros(N, jnp.int32), cnt0, cnt_sa0), valid)
     placed = jnp.sum(dos)
     return _aggregate_commit(tb, cry, g, j, False), j, placed
 

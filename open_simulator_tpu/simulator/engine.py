@@ -185,7 +185,7 @@ class Simulator:
         self.use_waves = True
         self.use_mesh = use_mesh
         self._mesh = _UNSET
-        self._wave_elig_cache: Dict[int, Tuple[bool, bool, bool, bool, bool]] = {}
+        self._wave_elig_cache: Dict[int, Tuple[bool, ...]] = {}
 
     # ------------------------------------------------------------- state ----------
 
@@ -355,22 +355,23 @@ class Simulator:
         # cache warm across probes. Phantom nodes are infeasible by construction.
         return pad_batch_tables(bt, bucket_capped(self.na.N, 1024))
 
-    def _wave_eligibility(self, gi: int) -> Tuple[bool, bool, bool, bool, bool]:
-        """(eligible, cap1, spread_live, gpu_live, ss_live) for group gi — see
+    def _wave_eligibility(self, gi: int) -> Tuple[bool, ...]:
+        """(eligible, cap1, spread_live, gpu_live, ss_live, sa_live) for group
+        gi — see
         ops/kernels.py schedule_wave / schedule_group_serial. A group is
         batch-eligible when its placements cannot change any predicate or score
-        input that it reads itself: no storage state, no ScheduleAnyway spread
-        terms (they feed the score), no SelectorSpread counter (the default
-        spread selector always matches the pod itself), and no affinity term
+        input that it reads itself: no storage state and no affinity term
         whose selector matches the group's own pods. Two self-interactions are
         exactly per-node capacity-1 clamps (cap1): hostname-topology required
         self-anti-affinity, and host ports while NodePorts is enabled (the
         first copy claims the port; the aggregate commit writes the bits).
-        Two more have dedicated kernels: shared-GPU requests (gpu_live →
-        unit-countable wave) and self-matching DoNotSchedule spread terms
-        (spread_live → fused group-serial scan); a group with both stays on
-        the general serial path. Non-self-matching DoNotSchedule terms are
-        static during the run and ride the plain wave."""
+        More self-interactions have dedicated kernels: shared-GPU requests
+        (gpu_live → unit-countable wave); self-matching DoNotSchedule spread
+        terms (spread_live), a live SelectorSpread counter (ss_live), and
+        ScheduleAnyway soft spread terms (sa_live) — those three via the
+        fused group-serial scan. A gpu_live group that is also counter-live
+        stays on the general serial path. Non-self-matching DoNotSchedule
+        terms are static during the run and ride the plain wave."""
         got = self._wave_elig_cache.get(gi)
         if got is not None:
             return got
@@ -390,10 +391,13 @@ class Simulator:
         # fused group-serial kernel computes it live. A zero SelectorSpread
         # weight makes the term inert and the group plain-wave eligible.
         ss_live = g.ss_counter >= 0 and self.score_w.ss != 0
+        # soft (ScheduleAnyway) spread terms: counters and relevant-set
+        # normalizers move with every placement — live in the fused kernel.
+        # Weight 0 makes the term inert and the group plain-wave eligible.
+        sa_live = bool(g.spread_sa) and self.score_w.pts != 0
         ok = not ((g.gpu_mem > 0 and not gpu_live)
-                  or (gpu_live and (spread_live or ss_live))
-                  or g.lvm_sizes or g.sdev_sizes
-                  or g.spread_sa)
+                  or (gpu_live and (spread_live or ss_live or sa_live))
+                  or g.lvm_sizes or g.sdev_sizes)
         # host-port groups: the first copy claims the port, so the group is
         # exactly a capacity-1-per-node wave (conflicts vs other pods are in
         # the carry's port table; _aggregate_commit writes the claimed bits)
@@ -420,14 +424,16 @@ class Simulator:
                     else:
                         ok = False
                         break
-        got = (ok, cap1, ok and spread_live, ok and gpu_live, ok and ss_live)
+        got = (ok, cap1, ok and spread_live, ok and gpu_live, ok and ss_live,
+               ok and sa_live)
         self._wave_elig_cache[gi] = got
         return got
 
     def _segments(self, bt: BatchTables, P: int) -> List[tuple]:
         """Split the batch into maximal runs of one (group, forced) pair; eligible
         runs of >= WAVE_MIN become ('wave', start, len, g, cap1, gpu_live) or
-        ('spread', start, len, g, cap1, ss_live) segments, the rest coalesce
+        ('spread', start, len, g, cap1, ss_live, sa_live) segments, the rest
+        coalesce
         into ('serial', start, len) chunks."""
         pg = np.asarray(bt.pod_group[:P])
         fn = np.asarray(bt.forced_node[:P])
@@ -440,15 +446,15 @@ class Simulator:
         for i, j in zip(starts.tolist(), ends.tolist()):
             g, f = int(pg[i]), int(fn[i])
             run = j - i
-            elig, cap1, spread_live, gpu_live, ss_live = (
+            elig, cap1, spread_live, gpu_live, ss_live, sa_live = (
                 self._wave_eligibility(g) if f < 0
-                else (False, False, False, False, False))
+                else (False,) * 6)
             if elig and run >= WAVE_MIN:
                 if ser_start is not None:
                     segs.append(("serial", ser_start, i - ser_start))
                     ser_start = None
-                if spread_live or ss_live:
-                    segs.append(("spread", i, run, g, cap1, ss_live))
+                if spread_live or ss_live or sa_live:
+                    segs.append(("spread", i, run, g, cap1, ss_live, sa_live))
                 else:
                     segs.append(("wave", i, run, g, cap1, gpu_live))
             elif ser_start is None:
@@ -500,7 +506,7 @@ class Simulator:
                 )
                 outs.append((seg, ch, carry))
             elif seg[0] == "spread":
-                _, start, length, g, cap1, ss_live = seg
+                _, start, length, g, cap1, ss_live, sa_live = seg
                 pad = bucket_capped(length, 2048)
                 vd = np.zeros(pad, bool)
                 vd[:length] = True
@@ -509,7 +515,8 @@ class Simulator:
                     w=self.score_w, filters=self.filter_flags,
                     # n_zones only shapes the ss_live zone table; pin it for
                     # DNS-only segments so new zone labels don't recompile them
-                    ss_live=ss_live, n_zones=bt.n_zones if ss_live else 2,
+                    ss_live=ss_live, sa_live=sa_live,
+                    n_zones=bt.n_zones if ss_live else 2,
                 )
                 outs.append((seg, counts, carry))
             else:
@@ -643,7 +650,7 @@ class Simulator:
                 )
                 placed_parts.append(jnp.sum((ch >= 0).astype(jnp.int32)))
             elif seg[0] == "spread":
-                _, start, length, g, cap1, ss_live = seg
+                _, start, length, g, cap1, ss_live, sa_live = seg
                 pad = bucket_capped(length, 2048)
                 vd = np.zeros(pad, bool)
                 vd[:length] = True
@@ -652,7 +659,8 @@ class Simulator:
                     w=self.score_w, filters=self.filter_flags,
                     # n_zones only shapes the ss_live zone table; pin it for
                     # DNS-only segments so new zone labels don't recompile them
-                    ss_live=ss_live, n_zones=bt.n_zones if ss_live else 2,
+                    ss_live=ss_live, sa_live=sa_live,
+                    n_zones=bt.n_zones if ss_live else 2,
                 )
                 placed_parts.append(placed)
             else:

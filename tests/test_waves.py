@@ -593,6 +593,54 @@ def test_ss_live_with_self_anti_affinity_cap1():
     assert sum(wn) == 6 and wf == 3 and max(wn) == 1
 
 
+def _sa_constraint(app, max_skew=1, topo="topology.kubernetes.io/zone"):
+    return {"maxSkew": max_skew, "topologyKey": topo,
+            "whenUnsatisfiable": "ScheduleAnyway",
+            "labelSelector": {"matchLabels": {"app": app}}}
+
+
+def test_sa_live_soft_spread_waves():
+    # ScheduleAnyway soft spread: score-only, counters move with placements —
+    # routed through the fused kernel, must match the pure serial scan
+    nodes = [make_node(f"sa{i}", labels={"topology.kubernetes.io/zone": f"z{i % 3}"})
+             for i in range(9)]
+    pods = replicas("soft", 21, cpu="300m", memory="256Mi", labels={"app": "soft"})
+    for p in pods:
+        p["spec"]["topologySpreadConstraints"] = [_sa_constraint("soft")]
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+
+
+def test_sa_live_nodes_missing_topology_key():
+    # nodes without the topology key are score-ignored (pts=0) but remain
+    # schedulable — the sentinel-masked counter update must keep parity
+    nodes = [make_node(f"sam{i}", labels={"topology.kubernetes.io/zone": f"z{i % 2}"})
+             for i in range(4)]
+    nodes += [make_node(f"sam-nokey{i}") for i in range(2)]
+    pods = replicas("softm", 18, cpu="500m", memory="512Mi", labels={"app": "softm"})
+    for p in pods:
+        p["spec"]["topologySpreadConstraints"] = [_sa_constraint("softm", max_skew=2)]
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+
+
+def test_sa_live_mixed_with_dns_constraint():
+    # one soft + one hard constraint on the same pods: dns filter state and
+    # sa score state both live in the fused kernel
+    nodes = [make_node(f"sad{i}", labels={"topology.kubernetes.io/zone": f"z{i % 3}"})
+             for i in range(6)]
+    pods = replicas("mix", 15, cpu="300m", memory="256Mi", labels={"app": "mix"})
+    for p in pods:
+        p["spec"]["topologySpreadConstraints"] = [
+            _sa_constraint("mix", max_skew=2),
+            {"maxSkew": 3, "topologyKey": "topology.kubernetes.io/zone",
+             "whenUnsatisfiable": "DoNotSchedule",
+             "labelSelector": {"matchLabels": {"app": "mix"}}},
+        ]
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+
+
 def test_wave_host_ports_cap1_survives_fit_disabled(tmp_path):
     # NodeResourcesFit disabled + NodePorts enabled: capacity is unbounded but
     # the port clamp must survive — waves may not stack same-port copies
@@ -663,6 +711,10 @@ def test_wave_fuzz_mixed_workloads(seed):
 
     def block(bi, kind, n):
         app = f"fz-app{bi}"
+        # one constraint flavor per block, so replicas stay one group (runs
+        # >= WAVE_MIN actually reach the batched kernels)
+        when = rng.choice(["DoNotSchedule", "ScheduleAnyway"]) if kind == 3 else None
+        skew = rng.choice([1, 2])
         pods = []
         for i in range(n):
             kw = dict(labels={"app": app},
@@ -683,9 +735,9 @@ def test_wave_fuzz_mixed_workloads(seed):
             p = make_pod(f"{app}-{i}", **kw)
             if kind == 3 and n_zones:
                 p["spec"]["topologySpreadConstraints"] = [{
-                    "maxSkew": rng.choice([1, 2]),
+                    "maxSkew": skew,
                     "topologyKey": "topology.kubernetes.io/zone",
-                    "whenUnsatisfiable": "DoNotSchedule",
+                    "whenUnsatisfiable": when,
                     "labelSelector": {"matchLabels": {"app": app}},
                 }]
             pods.append(p)
