@@ -853,6 +853,63 @@ def _aggregate_commit(tb: Tables, cry: Carry, g, j, gpu_live: bool) -> Carry:
                  dev_used, cry.vg_req, cry.sdev_alloc)
 
 
+
+def _wave_candidates(tb: Tables, cry: Carry, st: dict, g, j, avail, F,
+                     w: ScoreWeights, B: int, iota_n):
+    """Shared wave-iteration front half: normalizers for the current feasible
+    set, the [N, B+1] score table, the usable-entry mask (capacity, monotone
+    prefix, hidden-continuation guard — see schedule_wave's body comments for
+    the exactness argument), and the flattened stable sort. Single source for
+    schedule_wave and schedule_spread_wave; the callers differ only in how
+    much of the sorted order they may take. Returns
+    (norms, table, idx_srt, ex_srt, flat_s)."""
+    N = tb.alloc.shape[0]
+    norms = _wave_norms(st, F)
+    table_ext = _wave_score_table(tb, cry, st, norms, g, j, w, B)  # [N, B+1]
+    table = table_ext[:, :B]
+    ks = jnp.arange(B, dtype=jnp.int32)[None, :]
+    in_cap = ks < avail[:, None]
+    mono = jnp.cumprod(
+        jnp.concatenate(
+            [jnp.ones((N, 1), jnp.int32),
+             (table[:, 1:] <= table[:, :-1]).astype(jnp.int32)], axis=1),
+        axis=1) > 0
+    usable = in_cap & mono & F[:, None]
+
+    # hidden-continuation guard: an entry is takeable only if its key
+    # (score desc, index asc) strictly beats every OTHER node's first hidden
+    # entry (beyond depth B or past a monotonicity break)
+    first_bad = jnp.min(jnp.where(mono, B, ks), axis=1)
+    k_hid = jnp.minimum(first_bad, B)
+    has_hidden = (k_hid < avail) & F
+    bound = jnp.where(
+        has_hidden,
+        jnp.take_along_axis(table_ext, k_hid[:, None], axis=1)[:, 0],
+        -jnp.inf,
+    )
+    b1 = jnp.max(bound)
+    i1 = jnp.argmax(bound)  # first max = lowest index among score ties
+    bound2 = bound.at[i1].set(-jnp.inf)
+    b2 = jnp.max(bound2)
+    i2 = jnp.argmax(bound2)
+    cut_s = jnp.where(iota_n == i1, b2, b1)
+    cut_i = jnp.where(iota_n == i1, i2, i1).astype(jnp.int32)
+    beats = (table > cut_s[:, None]) | (
+        (table == cut_s[:, None]) & (iota_n[:, None] < cut_i[:, None])
+    )
+    usable &= beats
+
+    flat_s = jnp.where(usable, table, -jnp.inf).reshape(-1)
+    flat_idx = jnp.broadcast_to(iota_n[:, None], (N, B)).reshape(-1)
+    exhaust = (ks == (avail[:, None] - 1)) & usable        # entry that empties n
+    flat_ex = exhaust.reshape(-1)
+    neg_s_srt, idx_srt, ex_srt = jax.lax.sort(
+        (-flat_s, flat_idx, flat_ex.astype(jnp.int32)), num_keys=2,
+        is_stable=True,
+    )
+    return norms, table, idx_srt, ex_srt, flat_s
+
+
 @partial(jax.jit, static_argnames=("gpu_live", "w", "filters", "block"))
 def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
                   w: ScoreWeights = DEFAULT_WEIGHTS,
@@ -894,57 +951,8 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
         j, placed, _ = state
         avail = capacity - j                                   # copies left per node
         F = base_feas & (avail > 0)
-        norms = _wave_norms(st, F)
-        table_ext = _wave_score_table(tb, cry, st, norms, g, j, w, B)  # [N, B+1]
-        table = table_ext[:, :B]
-        ks = jnp.arange(B, dtype=jnp.int32)[None, :]
-        # usable entries: within remaining capacity, and monotone prefix only
-        in_cap = ks < avail[:, None]
-        mono = jnp.cumprod(
-            jnp.concatenate(
-                [jnp.ones((N, 1), jnp.int32),
-                 (table[:, 1:] <= table[:, :-1]).astype(jnp.int32)], axis=1),
-            axis=1) > 0
-        usable = in_cap & mono & F[:, None]
-
-        # Hidden-continuation guard: serial would keep consuming a node's column
-        # past what this wave exposes (beyond depth B, or past a monotonicity
-        # break). Each node's FIRST hidden entry is exactly table_ext[n, k_hid]
-        # where k_hid = min(first break, B); it exists iff k_hid < avail. An
-        # entry may be taken this wave only if its key (score desc, index asc)
-        # strictly beats every OTHER node's hidden bound — otherwise serial
-        # might interleave that hidden entry first. Own-node hidden entries are
-        # no constraint: a node's column is consumed strictly in order.
-        first_bad = jnp.min(jnp.where(mono, B, ks), axis=1)    # [N]: B = no break
-        k_hid = jnp.minimum(first_bad, B)
-        has_hidden = (k_hid < avail) & F
-        bound = jnp.where(
-            has_hidden,
-            jnp.take_along_axis(table_ext, k_hid[:, None], axis=1)[:, 0],
-            -jnp.inf,
-        )
-        # top-2 hidden bounds under (score desc, index asc) so each node can
-        # compare against the max over the OTHERS
-        b1 = jnp.max(bound)
-        i1 = jnp.argmax(bound)  # first max = lowest index among score ties
-        bound2 = bound.at[i1].set(-jnp.inf)
-        b2 = jnp.max(bound2)
-        i2 = jnp.argmax(bound2)
-        cut_s = jnp.where(iota_n == i1, b2, b1)                # [N]
-        cut_i = jnp.where(iota_n == i1, i2, i1).astype(jnp.int32)
-        beats = (table > cut_s[:, None]) | (
-            (table == cut_s[:, None]) & (iota_n[:, None] < cut_i[:, None])
-        )
-        usable &= beats
-
-        flat_s = jnp.where(usable, table, -jnp.inf).reshape(-1)
-        flat_idx = jnp.broadcast_to(iota_n[:, None], (N, B)).reshape(-1)
-        exhaust = (ks == (avail[:, None] - 1)) & usable        # entry that empties n
-        flat_ex = exhaust.reshape(-1)
-
-        neg_s_srt, idx_srt, ex_srt = jax.lax.sort(
-            (-flat_s, flat_idx, flat_ex.astype(jnp.int32)), num_keys=2, is_stable=True
-        )
+        norms, table, idx_srt, ex_srt, flat_s = _wave_candidates(
+            tb, cry, st, g, j, avail, F, w, B, iota_n)
         pos = jnp.arange(N * B, dtype=jnp.int32)
         n_finite = jnp.sum(jnp.isfinite(flat_s).astype(jnp.int32))
         m_rem = (m - placed).astype(jnp.int32)
@@ -983,6 +991,212 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
     j0 = jnp.zeros(N, jnp.int32)
     j, placed, _ = jax.lax.while_loop(cond, body, (j0, jnp.int32(0), jnp.int32(1)))
     return _aggregate_commit(tb, cry, g, j, gpu_live), j, placed
+
+
+@partial(jax.jit, static_argnames=("w", "filters", "block"))
+def schedule_spread_wave(tb: Tables, cry: Carry, g, m, cap1,
+                         w: ScoreWeights = DEFAULT_WEIGHTS,
+                         filters: FilterFlags = DEFAULT_FILTERS,
+                         block: int = WAVE_BLOCK):
+    """Epoch-batched wave for groups whose ONLY live self-interaction is
+    DoNotSchedule topology spread (no SelectorSpread counter, no
+    ScheduleAnyway terms, no GPU/storage) — the serial process in far fewer
+    device iterations than one-pod-per-scan-step.
+
+    Exactness argument, extending schedule_wave's: between F-changing events,
+    the feasible set and every normalizer are constant, so serial's picks are
+    exactly the sorted score-table prefix (per-node columns consumed in
+    order). The DNS filter adds three event kinds beyond node-capacity
+    exhaustion, each with a closed-form position in the sorted order under a
+    min frozen at epoch start (filtering.go:200-241 semantics):
+
+      * A SELF-matching term's domain d admits q = maxSkew - 1 + min - cnt[d]
+        + 1 more placements before cnt[d] + 1 - min exceeds maxSkew; the
+        entry consuming the q-th is the last allowed — the epoch cuts AFTER
+        it (the domain then blocks, shrinking F). Non-self terms' counters
+        never move during the run, so they contribute only the static q >= 1
+        feasibility gate, never budget consumption.
+      * min rises the moment every min-count eligible domain has gained a
+        placement; the entry completing that is exact to take, and the epoch
+        cuts AFTER it (budgets and blocked domains must be recomputed).
+      * node capacity exhaustion cuts after the exhausting entry, as in
+        schedule_wave (without the norm-invariance extension).
+
+    Each epoch therefore takes min(candidates, first-event cut) pods — with
+    Z eligible domains typically ~Z placements per iteration instead of 1 —
+    and the head fallback guarantees progress when the guard masks
+    everything. Returns (new carry, per-node counts [N] i32, placed i32)."""
+    N = tb.alloc.shape[0]
+    B = block
+    D = cry.counter.shape[1] - 1
+    iota_n = jnp.arange(N, dtype=jnp.int32)
+    INF_P = jnp.int32(N * B + 1)
+    base_feas, _ = feasibility(
+        tb, cry, g, jnp.int32(-1), jnp.asarray(True),
+        enable_gpu=False, enable_storage=False, include_dns=False, filters=filters,
+    )
+    st = _wave_statics(tb, cry, g, w)
+    capacity = jnp.where(base_feas, _wave_capacity(tb, cry, g, cap1), 0)
+    if not filters.fit:
+        capacity = jnp.where(base_feas, 2_147_483_000, 0)
+        capacity = jnp.where(cap1, jnp.minimum(capacity, 1), capacity)
+
+    dids_raw = tb.dns_t[g]                                 # [Sd]
+    dvalid = dids_raw >= 0
+    dids = jnp.maximum(dids_raw, 0)
+    dom_rows = tb.counter_dom[dids]                        # [Sd, N]
+    key_present = dom_rows < D
+    edom = tb.dns_edom[g]                                  # [Sd, D+1]
+    dself = tb.dns_self[g]                                 # [Sd] f32 (1.0 = self)
+    dskew = tb.dns_maxskew[g]                              # [Sd]
+    live = dvalid & (tb.counter_sel_match_g[dids, g]) & (dself > 0)  # [Sd]
+    cnt0 = cry.counter[dids]                               # [Sd, D+1]
+    Sd = dids.shape[0]
+
+    if not filters.spread:
+        # DNS filter disabled by scheduler config: plain-wave semantics
+        live = jnp.zeros_like(live)
+        dvalid = jnp.zeros_like(dvalid)
+
+    def body(state):
+        j, cnt, placed, _ = state
+        avail = capacity - j
+        # frozen-min budgets: q[s, d] = remaining placements domain d admits
+        min_c = jnp.min(jnp.where(edom, cnt, jnp.inf), axis=1)
+        min_c = jnp.where(jnp.isfinite(min_c), min_c, 0.0)     # [Sd]
+        q = dskew[:, None] - dself[:, None] + min_c[:, None] - cnt + 1.0
+        q = jnp.maximum(q, 0.0)                                # [Sd, D+1]
+        # per-node DNS feasibility: every valid term has key + budget >= 1
+        q_at = jnp.take_along_axis(q, dom_rows, axis=1)        # [Sd, N]
+        dns_ok = jnp.all((key_present & (q_at >= 1.0)) | ~dvalid[:, None], axis=0)
+        F = base_feas & (avail > 0) & dns_ok
+        norms, table, idx_srt, ex_srt, flat_s = _wave_candidates(
+            tb, cry, st, g, j, avail, F, w, B, iota_n)
+        pos = jnp.arange(N * B, dtype=jnp.int32)
+        n_finite = jnp.sum(jnp.isfinite(flat_s).astype(jnp.int32))
+        m_rem = (m - placed).astype(jnp.int32)
+        m_cand = jnp.minimum(m_rem, n_finite)
+        valid_pos = pos < m_cand
+
+        # node-capacity cut: after the first exhausting entry
+        p_ex = jnp.min(jnp.where((ex_srt > 0) & valid_pos, pos, INF_P))
+
+        # Per-SELF-term domain bookkeeping along the sorted order. Everything
+        # here is LINEAR in NB and D — no [NB, D] one-hot, because hostname
+        # topologies have D ~ N and this kernel is routed exactly to
+        # high-cardinality topologies.
+        dom_srt = dom_rows[:, idx_srt]                          # [Sd, NB]
+        NB = N * B
+        p_dom_ex = INF_P
+        p_viol = INF_P
+        p_rise = INF_P
+        at_min = edom & (cnt == min_c[:, None])                 # [Sd, D+1]
+        within_budget = jnp.ones(N * B, bool)
+        for s in range(Sd):
+            dom_row = dom_srt[s]
+            dkey = jnp.where(valid_pos, dom_row, D)             # invalid → sentinel
+            # occ_before: rank of each entry among same-domain entries in
+            # score order, via one (domain, position) sort + run ranking
+            d2, p2 = jax.lax.sort((dkey, pos), num_keys=2, is_stable=True)
+            run_start = jnp.concatenate(
+                [jnp.ones((1,), bool), d2[1:] != d2[:-1]])
+            seg_start = jax.lax.associative_scan(
+                jnp.maximum, jnp.where(run_start, pos, 0))
+            occ = jnp.zeros(NB, _F32).at[p2].set((pos - seg_start).astype(_F32))
+            q_row = q[s][dom_row]                               # [NB]
+            act = live[s] & valid_pos
+            within_budget &= jnp.where(act, occ + 1.0 <= q_row, True)
+            # the q-th take exhausts its domain → cut after; a q+1-th entry is
+            # a violation (possible when another term still had budget) → cut
+            # before
+            p_dom_ex = jnp.minimum(p_dom_ex, jnp.min(
+                jnp.where(act & (occ + 1.0 == q_row), pos, INF_P)))
+            p_viol = jnp.minimum(p_viol, jnp.min(
+                jnp.where(act & (occ + 1.0 > q_row), pos, INF_P)))
+            # min-rise cut: the position where the LAST min-count eligible
+            # domain receives its first placement (INF if any never does)
+            first_occ = jnp.full((D + 1,), INF_P).at[dkey].min(
+                jnp.where(valid_pos, pos, INF_P))
+            rise = jnp.max(jnp.where(at_min[s], first_occ, -1))
+            unreached = jnp.any(at_min[s] & (first_occ >= INF_P))
+            p_rise = jnp.minimum(p_rise, jnp.where(
+                live[s] & ~unreached & (rise >= 0), rise, INF_P))
+
+        # Conservative epoch: stop at the first F-changing event.
+        m_take_cons = jnp.minimum(m_cand, jnp.minimum(p_ex + 1, p_viol))
+        m_take_cons = jnp.minimum(m_take_cons,
+                                  jnp.minimum(p_dom_ex + 1, p_rise + 1))
+        counts_cons = jnp.zeros(N, jnp.int32).at[idx_srt].add(
+            (pos < m_take_cons).astype(jnp.int32))
+
+        # Skipping epoch: with min frozen and every normalizer INVARIANT,
+        # serial just skips over-budget / capacity-exhausted entries and keeps
+        # consuming the same order — so take the first m_rem in-cap,
+        # within-budget entries up to the min-rise cut. Valid only when
+        # removing every node that leaves F during the prefix (capacity
+        # exhausted or domain blocked) provably changes no normalizer —
+        # checked on the end state exactly like schedule_wave's check.
+        # Only positions whose budgets were evaluated (valid_pos = pos <
+        # m_cand) may be taken — tail entries past m_cand have UNCHECKED
+        # budgets and must wait for the next epoch's accounting.
+        takeable = valid_pos & within_budget & (pos <= p_rise)
+        take_rank = jax.lax.associative_scan(
+            jnp.add, takeable.astype(jnp.int32))                # 1-based
+        taken = takeable & (take_rank <= m_rem)
+        m_take_skip = jnp.minimum(m_rem, take_rank[-1])
+        counts_skip = jnp.zeros(N, jnp.int32).at[idx_srt].add(
+            taken.astype(jnp.int32))
+
+        leaves_cap = counts_skip >= jnp.maximum(avail, 1)
+        # nodes whose any live term's domain budget is fully consumed
+        used_budget = jnp.zeros((Sd, D + 1), _F32).at[
+            jnp.arange(Sd)[:, None], dom_srt
+        ].add(taken.astype(_F32)[None, :] * live[:, None].astype(_F32))
+        dom_blocked = used_budget >= q                          # [Sd, D+1]
+        node_blocked = jnp.any(
+            jnp.take_along_axis(dom_blocked, dom_rows, axis=1)
+            & live[:, None], axis=0)                            # [N]
+        F_end = F & ~leaves_cap & ~node_blocked
+        norms_end = _wave_norms(st, F_end)
+        same = jnp.array(True)
+        for a, b in zip(norms, norms_end):
+            same &= a == b
+
+        # The skip path's per-term occ counts every same-domain entry, taken
+        # or not; with TWO+ live terms an entry skipped for term A still
+        # consumes term B's occ, under-estimating B's real remaining budget —
+        # serial would not consume it. One live term has no such interaction
+        # (its own over-budget entries are exactly the ones serial skips,
+        # consuming nothing), so the skip path is sound only there.
+        use_skip = same & (jnp.sum(live.astype(jnp.int32)) <= 1)
+        m_take = jnp.where(use_skip, m_take_skip, m_take_cons)
+        counts = jnp.where(use_skip, counts_skip, counts_cons)
+
+        # head fallback: serial's single next pick is always exact
+        heads = jnp.where(F, table[:, 0], -jnp.inf)
+        any_head = jnp.any(F)
+        head_pick = jnp.zeros(N, jnp.int32).at[jnp.argmax(heads)].set(1)
+        use_head = (m_take == 0) & any_head & (m_rem > 0)
+        counts = jnp.where(use_head, head_pick, counts)
+        m_take = jnp.where(use_head, jnp.int32(1), m_take)
+
+        # fold the taken placements into the live terms' counters
+        inc = jnp.zeros((Sd, D + 1), _F32)
+        inc = inc.at[jnp.arange(Sd)[:, None], dom_rows].add(
+            counts.astype(_F32)[None, :] * live[:, None])
+        # sentinel column never counts (commit() masks dom >= D)
+        inc = inc * (jnp.arange(D + 1)[None, :] < D)
+        cnt = cnt + inc
+        return (j + counts, cnt, placed + m_take, m_take)
+
+    def cond(state):
+        _, _, placed, last = state
+        return (last > 0) & (placed < m)
+
+    j0 = jnp.zeros(N, jnp.int32)
+    j, _, placed, _ = jax.lax.while_loop(
+        cond, body, (j0, cnt0, jnp.int32(0), jnp.int32(1)))
+    return _aggregate_commit(tb, cry, g, j, False), j, placed
 
 
 @partial(jax.jit, static_argnames=("w", "filters", "ss_live", "sa_live", "n_zones"))

@@ -1139,9 +1139,7 @@ def build_batch_tables(
         for si, (cid, _, _) in enumerate(g.spread_dns):
             dom = na.domain_of(enc.counter_list[cid].topo_key)
             elig = g.dns_elig if g.dns_elig is not None else np.ones(N, bool)
-            for n in range(N):
-                if elig[n] and dom[n] >= 0:
-                    dns_edom[gi, si, dom[n]] = True
+            dns_edom[gi, si, dom[elig & (dom >= 0)]] = True
 
     carr_sel_match_g = np.zeros((Tc, G), bool)
     for t, cs in enumerate(enc.carrier_list):

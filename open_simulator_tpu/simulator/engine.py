@@ -186,6 +186,7 @@ class Simulator:
         self.use_mesh = use_mesh
         self._mesh = _UNSET
         self._wave_elig_cache: Dict[int, Tuple[bool, ...]] = {}
+        self._domain_count_cache: Dict[str, int] = {}  # topo key → #domains
 
     # ------------------------------------------------------------- state ----------
 
@@ -356,8 +357,8 @@ class Simulator:
         return pad_batch_tables(bt, bucket_capped(self.na.N, 1024))
 
     def _wave_eligibility(self, gi: int) -> Tuple[bool, ...]:
-        """(eligible, cap1, spread_live, gpu_live, ss_live, sa_live) for group
-        gi — see
+        """(eligible, cap1, spread_live, gpu_live, ss_live, sa_live,
+        spread_wave) for group gi — see
         ops/kernels.py schedule_wave / schedule_group_serial. A group is
         batch-eligible when its placements cannot change any predicate or score
         input that it reads itself: no storage state and no affinity term
@@ -383,6 +384,14 @@ class Simulator:
         cap1 = False
         spread_live = (any(selfm for _, _, selfm in g.spread_dns)
                        and self.filter_flags.spread)
+        # DNS-only groups can take the epoch-batched spread wave, but it only
+        # pays when each epoch moves many pods — one per eligible min-count
+        # domain — so require every live term's topology to be high-cardinality
+        # (hostname-level spread: ~N domains); few-zone spread stays on the
+        # fused serial scan whose per-step cost is far below an epoch's
+        spread_wave = spread_live and all(
+            not selfm or self._domain_count(cid) >= 64
+            for cid, _, selfm in g.spread_dns)
         # shared-GPU groups are unit-countable (kernels.schedule_wave gpu_live)
         # unless they carry a pre-assigned gpu-index (host-driven path → serial)
         gpu_live = g.gpu_mem > 0 and g.gpu_pre_ids is None
@@ -425,15 +434,25 @@ class Simulator:
                         ok = False
                         break
         got = (ok, cap1, ok and spread_live, ok and gpu_live, ok and ss_live,
-               ok and sa_live)
+               ok and sa_live, ok and spread_wave)
         self._wave_elig_cache[gi] = got
+        return got
+
+    def _domain_count(self, cid: int) -> int:
+        """Number of distinct domains a counter's topology key has on this
+        cluster (cached per topology key) — the epoch-wave routing signal."""
+        key = self.encoder.counter_list[cid].topo_key
+        got = self._domain_count_cache.get(key)
+        if got is None:
+            dom = self.na.domain_of(key)
+            got = self._domain_count_cache[key] = int(len(np.unique(dom[dom >= 0])))
         return got
 
     def _segments(self, bt: BatchTables, P: int) -> List[tuple]:
         """Split the batch into maximal runs of one (group, forced) pair; eligible
         runs of >= WAVE_MIN become ('wave', start, len, g, cap1, gpu_live) or
-        ('spread', start, len, g, cap1, ss_live, sa_live) segments, the rest
-        coalesce
+        ('spread', start, len, g, cap1, ss_live, sa_live, spread_wave)
+        segments, the rest coalesce
         into ('serial', start, len) chunks."""
         pg = np.asarray(bt.pod_group[:P])
         fn = np.asarray(bt.forced_node[:P])
@@ -446,15 +465,16 @@ class Simulator:
         for i, j in zip(starts.tolist(), ends.tolist()):
             g, f = int(pg[i]), int(fn[i])
             run = j - i
-            elig, cap1, spread_live, gpu_live, ss_live, sa_live = (
+            elig, cap1, spread_live, gpu_live, ss_live, sa_live, spread_wave = (
                 self._wave_eligibility(g) if f < 0
-                else (False,) * 6)
+                else (False,) * 7)
             if elig and run >= WAVE_MIN:
                 if ser_start is not None:
                     segs.append(("serial", ser_start, i - ser_start))
                     ser_start = None
                 if spread_live or ss_live or sa_live:
-                    segs.append(("spread", i, run, g, cap1, ss_live, sa_live))
+                    segs.append(("spread", i, run, g, cap1, ss_live, sa_live,
+                                 spread_wave))
                 else:
                     segs.append(("wave", i, run, g, cap1, gpu_live))
             elif ser_start is None:
@@ -506,7 +526,18 @@ class Simulator:
                 )
                 outs.append((seg, ch, carry))
             elif seg[0] == "spread":
-                _, start, length, g, cap1, ss_live, sa_live = seg
+                _, start, length, g, cap1, ss_live, sa_live, spread_wave = seg
+                if spread_wave and not ss_live and not sa_live:
+                    # DNS-only live spread: epoch-batched wave (many pods per
+                    # device iteration) instead of one-pod-per-scan-step
+                    carry, counts, _ = kernels.schedule_spread_wave(
+                        tables, carry, jnp.int32(g), jnp.int32(length),
+                        jnp.asarray(cap1), w=self.score_w,
+                        filters=self.filter_flags,
+                        block=kernels.wave_block_for(length, self.na.N),
+                    )
+                    outs.append((seg, counts, carry))
+                    continue
                 pad = bucket_capped(length, 2048)
                 vd = np.zeros(pad, bool)
                 vd[:length] = True
@@ -650,7 +681,16 @@ class Simulator:
                 )
                 placed_parts.append(jnp.sum((ch >= 0).astype(jnp.int32)))
             elif seg[0] == "spread":
-                _, start, length, g, cap1, ss_live, sa_live = seg
+                _, start, length, g, cap1, ss_live, sa_live, spread_wave = seg
+                if spread_wave and not ss_live and not sa_live:
+                    carry, _, placed = kernels.schedule_spread_wave(
+                        tables, carry, jnp.int32(g), jnp.int32(length),
+                        jnp.asarray(cap1), w=self.score_w,
+                        filters=self.filter_flags,
+                        block=kernels.wave_block_for(length, self.na.N),
+                    )
+                    placed_parts.append(placed)
+                    continue
                 pad = bucket_capped(length, 2048)
                 vd = np.zeros(pad, bool)
                 vd[:length] = True
