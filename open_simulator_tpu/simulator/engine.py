@@ -187,6 +187,10 @@ class Simulator:
         self._mesh = _UNSET
         self._wave_elig_cache: Dict[int, Tuple[bool, ...]] = {}
         self._domain_count_cache: Dict[str, int] = {}  # topo key → #domains
+        import os as _os
+
+        self._spread_wave_min_domains = int(
+            _os.environ.get("OPEN_SIMULATOR_SPREAD_WAVE_MIN_DOMAINS", "64"))
 
     # ------------------------------------------------------------- state ----------
 
@@ -389,8 +393,14 @@ class Simulator:
         # domain — so require every live term's topology to be high-cardinality
         # (hostname-level spread: ~N domains); few-zone spread stays on the
         # fused serial scan whose per-step cost is far below an epoch's
+        # OPEN_SIMULATOR_SPREAD_WAVE_MIN_DOMAINS tunes the break-even point
+        # per backend (placements are exact on either path, so routing is
+        # purely a performance choice): epochs move ~#domains pods each, so
+        # they win once the per-iteration cost amortizes — measured at ≥64
+        # domains on the CPU backend; accelerators with launch-bound scan
+        # steps may profit from a lower threshold.
         spread_wave = spread_live and all(
-            not selfm or self._domain_count(cid) >= 64
+            not selfm or self._domain_count(cid) >= self._spread_wave_min_domains
             for cid, _, selfm in g.spread_dns)
         # shared-GPU groups are unit-countable (kernels.schedule_wave gpu_live)
         # unless they carry a pre-assigned gpu-index (host-driven path → serial)

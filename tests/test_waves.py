@@ -702,7 +702,7 @@ def test_wave_host_ports_cap1_survives_fit_disabled(tmp_path):
     assert results[0][1] == 3 and sum(results[0][0].values()) == 6
 
 
-@pytest.mark.parametrize("seed", [7, 23, 101, 555])
+@pytest.mark.parametrize("seed", [7, 23, 101, 555, 1234, 9999])
 def test_wave_fuzz_mixed_workloads(seed):
     """Randomized waves-vs-serial sweep: random node shapes (zones, taints,
     GPU annotations, tight capacities) and random workload blocks cycling
