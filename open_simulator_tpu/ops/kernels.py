@@ -196,6 +196,17 @@ def schedule_anyway_score(cnt_sa, relevantF, dom_rows, svalid, maxskew, D: int):
     )
 
 
+def counter_rows_at(tb: Tables, cry: Carry, ids):
+    """Selectively gather counter rows by static slot indices: returns
+    (rows [k, D+1], per-node values [k, N], key_present [k, N]). THE shared
+    idiom for every plugin that reads a handful of counters — never gather
+    the full [T, N] table; T grows with every service/affinity selector."""
+    rows = cry.counter[ids]                             # [k, D+1]
+    dom = tb.counter_dom[ids]                           # [k, N]
+    D = cry.counter.shape[1] - 1
+    return rows, jnp.take_along_axis(rows, dom, axis=1), dom < D
+
+
 def least_balanced(used_c, used_m, a_c, a_m):
     """NodeResourcesLeastAllocated (least_allocated.go:93-115, integer divisions
     floored) + NodeResourcesBalancedAllocation (balanced_allocation.go:96-120)
@@ -355,20 +366,22 @@ def feasibility(
     else:
         conflict = jnp.zeros(N, bool)
 
-    # counter gathers shared by inter-pod affinity and topology spread
-    cnt_at = jnp.take_along_axis(cry.counter, tb.counter_dom, axis=1)      # [T, N]
-    key_present = tb.counter_dom < D
-    totals = jnp.sum(cry.counter[:, :D], axis=1)                           # [T]
-
+    # Counter rows are gathered SELECTIVELY by the static slot indices each
+    # plugin carries ([A]/[B]/[Sd] small), never as the full [T, N] table —
+    # T grows with every service/affinity selector in the cluster, and a
+    # serial step paying T×N gathers for a handful of rows was the dominant
+    # cost on service-heavy workloads.
     # InterPodAffinity: required affinity (filtering.go satisfyPodAffinity)
     if filters.interpod:
         aff_ids = tb.req_aff_t[g]
         avalid = aff_ids >= 0
         aids = jnp.maximum(aff_ids, 0)
-        sat = (key_present[aids] & (cnt_at[aids] > 0)) | ~avalid[:, None]
+        aff_rows, aff_at, aff_key = counter_rows_at(tb, cry, aids)
+        sat = (aff_key & (aff_at > 0)) | ~avalid[:, None]
         aff_all = jnp.all(sat, axis=0)
         has_aff = jnp.any(avalid)
-        total_aff = jnp.sum(jnp.where(avalid, totals[aids], 0.0))
+        totals_aff = jnp.sum(aff_rows[:, :D], axis=1)                      # [A]
+        total_aff = jnp.sum(jnp.where(avalid, totals_aff, 0.0))
         bootstrap = has_aff & (total_aff == 0.0) & tb.grp_aff_self[g]
         aff_ok = jnp.where(bootstrap, jnp.ones_like(aff_all), aff_all)
 
@@ -376,7 +389,8 @@ def feasibility(
         anti_ids = tb.req_anti_t[g]
         bvalid = anti_ids >= 0
         bids = jnp.maximum(anti_ids, 0)
-        blocked_in = jnp.any((cnt_at[bids] > 0) & bvalid[:, None], axis=0)
+        _, anti_at, _ = counter_rows_at(tb, cry, bids)
+        blocked_in = jnp.any((anti_at > 0) & bvalid[:, None], axis=0)
 
         # existing pods' required anti-affinity (satisfyExistingPodsAntiAffinity)
         carr_at = jnp.take_along_axis(cry.carrier, tb.carr_dom, axis=1)    # [Tc, N]
@@ -396,8 +410,10 @@ def feasibility(
         cdom = cry.counter[dids]
         min_cnt = jnp.min(jnp.where(edom, cdom, jnp.inf), axis=1)
         min_cnt = jnp.where(jnp.isfinite(min_cnt), min_cnt, 0.0)
-        skew = cnt_at[dids] + tb.dns_self[g][:, None] - min_cnt[:, None]
-        dns_ok_each = key_present[dids] & (skew <= tb.dns_maxskew[g][:, None])
+        dns_dom = tb.counter_dom[dids]
+        dns_at = jnp.take_along_axis(cdom, dns_dom, axis=1)
+        skew = dns_at + tb.dns_self[g][:, None] - min_cnt[:, None]
+        dns_ok_each = (dns_dom < D) & (skew <= tb.dns_maxskew[g][:, None])
         dns_ok = jnp.all(dns_ok_each | ~dvalid[:, None], axis=0)
     else:
         dns_ok = jnp.ones(N, bool)
@@ -471,20 +487,24 @@ def scores(
     t_raw = tb.taint_raw[g]
 
     # InterPodAffinity raw (scoring.go): incoming preferred terms + existing pods'
-    # required (HardPodAffinityWeight=1) and preferred terms.
-    cnt_at = jnp.take_along_axis(cry.counter, tb.counter_dom, axis=1)
+    # required (HardPodAffinityWeight=1) and preferred terms. Counter rows
+    # are gathered selectively by slot index (see feasibility()); the carrier
+    # table has no per-group static slots (relevance is a data mask), so it
+    # stays a full [Tc, N] gather.
     carr_at = jnp.take_along_axis(cry.carrier, tb.carr_dom, axis=1)
     pref_ids = tb.pref_t[g]
     pvalid = pref_ids >= 0
     pidx = jnp.maximum(pref_ids, 0)
     pw = tb.pref_w[g]
-    ip_raw = jnp.sum(jnp.where(pvalid[:, None], pw[:, None] * cnt_at[pidx], 0.0), axis=0)
+    _, pref_at, _ = counter_rows_at(tb, cry, pidx)
+    ip_raw = jnp.sum(jnp.where(pvalid[:, None], pw[:, None] * pref_at, 0.0), axis=0)
     carr_w = (tb.carr_hard_w + tb.carr_pref_w) * tb.carr_sel_match_g[:, g]
     ip_raw = ip_raw + jnp.sum(carr_w[:, None] * carr_at, axis=0)
 
     ss_id = tb.ss_t[g]
     has_ss = ss_id >= 0
-    pernode = cnt_at[jnp.maximum(ss_id, 0)]
+    ss_idx = jnp.maximum(ss_id, 0)
+    pernode = counter_rows_at(tb, cry, ss_idx[None])[1][0]
 
     # All F-masked normalizer extrema in TWO stacked reductions (each reduction
     # is a separate pass per scan step; floats identical to separate reductions)
@@ -526,10 +546,11 @@ def scores(
     sa_ids = tb.sa_t[g]
     svalid = sa_ids >= 0
     sidx = jnp.maximum(sa_ids, 0)
-    key_present = tb.counter_dom < D
-    ignored = jnp.any(svalid[:, None] & ~key_present[sidx], axis=0)
+    sa_dom = tb.counter_dom[sidx]
+    _, sa_at, sa_key = counter_rows_at(tb, cry, sidx)
+    ignored = jnp.any(svalid[:, None] & ~sa_key, axis=0)
     relevantF = F & ~ignored
-    pts = schedule_anyway_score(cnt_at[sidx], relevantF, tb.counter_dom[sidx],
+    pts = schedule_anyway_score(sa_at, relevantF, sa_dom,
                                 svalid, tb.sa_maxskew[g], D)
 
     # Open-Local Score (open-local.go:94-172): Binpack LVM + device ints, then the

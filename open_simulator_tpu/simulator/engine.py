@@ -189,8 +189,11 @@ class Simulator:
         self._domain_count_cache: Dict[str, int] = {}  # topo key → #domains
         import os as _os
 
-        self._spread_wave_min_domains = int(
-            _os.environ.get("OPEN_SIMULATOR_SPREAD_WAVE_MIN_DOMAINS", "64"))
+        try:
+            self._spread_wave_min_domains = int(
+                _os.environ.get("OPEN_SIMULATOR_SPREAD_WAVE_MIN_DOMAINS", "64"))
+        except ValueError:  # pure-performance knob: fall back, don't crash
+            self._spread_wave_min_domains = 64
 
     # ------------------------------------------------------------- state ----------
 

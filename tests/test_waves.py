@@ -827,3 +827,38 @@ def test_spread_epoch_wave_preloaded_nodes_budget_checked():
     # maxSkew=1 must hold: no (node, signature) census bucket exceeds 1 pod —
     # seeds are bound one per node and spread pods may not stack either
     assert all(c <= 1 for c in wc.values())
+
+
+def test_spread_wave_threshold_env_knob(monkeypatch):
+    """OPEN_SIMULATOR_SPREAD_WAVE_MIN_DOMAINS reroutes few-domain spread
+    groups onto the epoch wave — placements must not change (routing is
+    purely a performance choice), and malformed values fall back silently."""
+    nodes = [make_node(f"kn{i}", labels={"topology.kubernetes.io/zone": f"z{i % 3}"})
+             for i in range(9)]
+    pods = replicas("kn", 18, cpu="200m", memory="256Mi", labels={"app": "kn"})
+    for p in pods:
+        p["spec"]["topologySpreadConstraints"] = [{
+            "maxSkew": 2, "topologyKey": "topology.kubernetes.io/zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "kn"}},
+        }]
+
+    def run(env):
+        if env is not None:
+            monkeypatch.setenv("OPEN_SIMULATOR_SPREAD_WAVE_MIN_DOMAINS", env)
+        else:
+            monkeypatch.delenv("OPEN_SIMULATOR_SPREAD_WAVE_MIN_DOMAINS",
+                               raising=False)
+        sim = Simulator(copy.deepcopy(nodes))
+        failed = sim.schedule_pods(copy.deepcopy(pods))
+        elig = sim._wave_eligibility(0)
+        return census_of(sim), len(failed), elig[-1]  # spread_wave flag
+
+    default_c, default_f, default_route = run(None)
+    assert default_route is False  # 3 zones < 64: fused scan
+    low_c, low_f, low_route = run("2")
+    assert low_route is True       # forced onto the epoch wave
+    assert (low_c, low_f) == (default_c, default_f)  # placements identical
+    bad_c, bad_f, bad_route = run("not-a-number")
+    assert bad_route is False      # malformed → default threshold
+    assert (bad_c, bad_f) == (default_c, default_f)
