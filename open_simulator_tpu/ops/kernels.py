@@ -114,10 +114,9 @@ class Tables(NamedTuple):
     ss_t: jax.Array
     ss_skip: jax.Array
     carr_dom: jax.Array
-    carr_use_anti: jax.Array
-    carr_hard_w: jax.Array
-    carr_pref_w: jax.Array
-    carr_sel_match_g: jax.Array
+    carr_anti_t: jax.Array  # [G, Ca] i32: anti-use carrier ids matching g (-1 pad)
+    carr_w_t: jax.Array     # [G, Cw] i32: carrier ids with interpod weight for g
+    carr_w_w: jax.Array     # [G, Cw] f32: those weights (hard=1 / signed pref)
     grp_carries: jax.Array
     # GPU-share (open-gpu-share.go Filter; per-device ledger in the carry)
     grp_gpu_mem: jax.Array   # [G] f32: per-GPU memory request (0 = no GPU)
@@ -196,15 +195,40 @@ def schedule_anyway_score(cnt_sa, relevantF, dom_rows, svalid, maxskew, D: int):
     )
 
 
+def carrier_rows_at(tb: Tables, cry: Carry, ids):
+    """Selective carrier-row gather by static per-group slot ids (same idiom
+    as counter_rows_at): returns per-node values [k, N]."""
+    return jnp.take_along_axis(cry.carrier[ids], tb.carr_dom[ids], axis=1)
+
+
 def counter_rows_at(tb: Tables, cry: Carry, ids):
     """Selectively gather counter rows by static slot indices: returns
-    (rows [k, D+1], per-node values [k, N], key_present [k, N]). THE shared
-    idiom for every plugin that reads a handful of counters — never gather
-    the full [T, N] table; T grows with every service/affinity selector."""
+    (rows [k, D+1], per-node values [k, N], key_present [k, N], dom [k, N]).
+    THE shared idiom for every plugin that reads a handful of counters —
+    never gather the full [T, N] table; T grows with every service/affinity
+    selector."""
     rows = cry.counter[ids]                             # [k, D+1]
     dom = tb.counter_dom[ids]                           # [k, N]
     D = cry.counter.shape[1] - 1
-    return rows, jnp.take_along_axis(rows, dom, axis=1), dom < D
+    return rows, jnp.take_along_axis(rows, dom, axis=1), dom < D, dom
+
+
+def interpod_raw(tb: Tables, cry: Carry, g):
+    """InterPodAffinity raw score (scoring.go): incoming preferred terms plus
+    existing pods' required (HardPodAffinityWeight=1) and preferred terms,
+    via selective slot gathers. Single source for scores() and
+    _wave_statics() — their serial-equality contract needs identical ip_raw
+    floats."""
+    pref_ids = tb.pref_t[g]
+    pvalid = pref_ids >= 0
+    pw = tb.pref_w[g]
+    _, pref_at, _, _ = counter_rows_at(tb, cry, jnp.maximum(pref_ids, 0))
+    ip_raw = jnp.sum(jnp.where(pvalid[:, None], pw[:, None] * pref_at, 0.0), axis=0)
+    cw_ids = tb.carr_w_t[g]
+    cw_valid = cw_ids >= 0
+    cw_at = carrier_rows_at(tb, cry, jnp.maximum(cw_ids, 0))
+    return ip_raw + jnp.sum(
+        jnp.where(cw_valid[:, None], tb.carr_w_w[g][:, None] * cw_at, 0.0), axis=0)
 
 
 def least_balanced(used_c, used_m, a_c, a_m):
@@ -376,7 +400,7 @@ def feasibility(
         aff_ids = tb.req_aff_t[g]
         avalid = aff_ids >= 0
         aids = jnp.maximum(aff_ids, 0)
-        aff_rows, aff_at, aff_key = counter_rows_at(tb, cry, aids)
+        aff_rows, aff_at, aff_key, _ = counter_rows_at(tb, cry, aids)
         sat = (aff_key & (aff_at > 0)) | ~avalid[:, None]
         aff_all = jnp.all(sat, axis=0)
         has_aff = jnp.any(avalid)
@@ -389,13 +413,14 @@ def feasibility(
         anti_ids = tb.req_anti_t[g]
         bvalid = anti_ids >= 0
         bids = jnp.maximum(anti_ids, 0)
-        _, anti_at, _ = counter_rows_at(tb, cry, bids)
+        _, anti_at, _, _ = counter_rows_at(tb, cry, bids)
         blocked_in = jnp.any((anti_at > 0) & bvalid[:, None], axis=0)
 
         # existing pods' required anti-affinity (satisfyExistingPodsAntiAffinity)
-        carr_at = jnp.take_along_axis(cry.carrier, tb.carr_dom, axis=1)    # [Tc, N]
-        relevant = tb.carr_use_anti & tb.carr_sel_match_g[:, g]
-        blocked_ex = jnp.any((carr_at > 0) & relevant[:, None], axis=0)
+        ca_ids = tb.carr_anti_t[g]
+        ca_valid = ca_ids >= 0
+        ca_at = carrier_rows_at(tb, cry, jnp.maximum(ca_ids, 0))
+        blocked_ex = jnp.any((ca_at > 0) & ca_valid[:, None], axis=0)
     else:
         aff_ok = jnp.ones(N, bool)
         blocked_in = jnp.zeros(N, bool)
@@ -407,13 +432,11 @@ def feasibility(
         dvalid = dns_ids >= 0
         dids = jnp.maximum(dns_ids, 0)
         edom = tb.dns_edom[g]                                              # [Sd, D+1]
-        cdom = cry.counter[dids]
+        cdom, dns_at, dns_key, _ = counter_rows_at(tb, cry, dids)
         min_cnt = jnp.min(jnp.where(edom, cdom, jnp.inf), axis=1)
         min_cnt = jnp.where(jnp.isfinite(min_cnt), min_cnt, 0.0)
-        dns_dom = tb.counter_dom[dids]
-        dns_at = jnp.take_along_axis(cdom, dns_dom, axis=1)
         skew = dns_at + tb.dns_self[g][:, None] - min_cnt[:, None]
-        dns_ok_each = (dns_dom < D) & (skew <= tb.dns_maxskew[g][:, None])
+        dns_ok_each = dns_key & (skew <= tb.dns_maxskew[g][:, None])
         dns_ok = jnp.all(dns_ok_each | ~dvalid[:, None], axis=0)
     else:
         dns_ok = jnp.ones(N, bool)
@@ -487,19 +510,9 @@ def scores(
     t_raw = tb.taint_raw[g]
 
     # InterPodAffinity raw (scoring.go): incoming preferred terms + existing pods'
-    # required (HardPodAffinityWeight=1) and preferred terms. Counter rows
-    # are gathered selectively by slot index (see feasibility()); the carrier
-    # table has no per-group static slots (relevance is a data mask), so it
-    # stays a full [Tc, N] gather.
-    carr_at = jnp.take_along_axis(cry.carrier, tb.carr_dom, axis=1)
-    pref_ids = tb.pref_t[g]
-    pvalid = pref_ids >= 0
-    pidx = jnp.maximum(pref_ids, 0)
-    pw = tb.pref_w[g]
-    _, pref_at, _ = counter_rows_at(tb, cry, pidx)
-    ip_raw = jnp.sum(jnp.where(pvalid[:, None], pw[:, None] * pref_at, 0.0), axis=0)
-    carr_w = (tb.carr_hard_w + tb.carr_pref_w) * tb.carr_sel_match_g[:, g]
-    ip_raw = ip_raw + jnp.sum(carr_w[:, None] * carr_at, axis=0)
+    # required (HardPodAffinityWeight=1) and preferred terms. Counter AND
+    # carrier rows are gathered selectively by per-group static slot indices.
+    ip_raw = interpod_raw(tb, cry, g)
 
     ss_id = tb.ss_t[g]
     has_ss = ss_id >= 0
@@ -546,8 +559,7 @@ def scores(
     sa_ids = tb.sa_t[g]
     svalid = sa_ids >= 0
     sidx = jnp.maximum(sa_ids, 0)
-    sa_dom = tb.counter_dom[sidx]
-    _, sa_at, sa_key = counter_rows_at(tb, cry, sidx)
+    _, sa_at, sa_key, sa_dom = counter_rows_at(tb, cry, sidx)
     ignored = jnp.any(svalid[:, None] & ~sa_key, axis=0)
     relevantF = F & ~ignored
     pts = schedule_anyway_score(sa_at, relevantF, sa_dom,
@@ -730,15 +742,7 @@ def _wave_statics(tb: Tables, cry: Carry, g, w: ScoreWeights = DEFAULT_WEIGHTS):
     forms let _wave_norms run as TWO masked reductions instead of six — inside
     the group-serial scan each reduction is a separate pass over [N], so this
     is a per-scheduled-pod cost."""
-    cnt_at = jnp.take_along_axis(cry.counter, tb.counter_dom, axis=1)
-    carr_at = jnp.take_along_axis(cry.carrier, tb.carr_dom, axis=1)
-    pref_ids = tb.pref_t[g]
-    pvalid = pref_ids >= 0
-    pidx = jnp.maximum(pref_ids, 0)
-    pw = tb.pref_w[g]
-    ip_raw = jnp.sum(jnp.where(pvalid[:, None], pw[:, None] * cnt_at[pidx], 0.0), axis=0)
-    carr_w = (tb.carr_hard_w + tb.carr_pref_w) * tb.carr_sel_match_g[:, g]
-    ip_raw = ip_raw + jnp.sum(carr_w[:, None] * carr_at, axis=0)
+    ip_raw = interpod_raw(tb, cry, g)
     simon_s = _flr(100.0 * tb.simon_raw[g])
     na_raw = tb.nodeaff_raw[g]
     t_raw = tb.taint_raw[g]

@@ -81,8 +81,8 @@ def table_shardings(mesh: Mesh) -> kernels.Tables:
         dns_t=s(r), dns_maxskew=s(r), dns_self=s(r), dns_edom=s(r),
         sa_t=s(r), sa_maxskew=s(r), sa_self=s(r),
         ss_t=s(r), ss_skip=s(r),
-        carr_dom=s(n), carr_use_anti=s(r), carr_hard_w=s(r), carr_pref_w=s(r),
-        carr_sel_match_g=s(r), grp_carries=s(r),
+        carr_dom=s(n), carr_anti_t=s(r), carr_w_t=s(r), carr_w_w=s(r),
+        grp_carries=s(r),
         grp_gpu_mem=s(r), grp_gpu_num=s(r), grp_gpu_pre=s(r), grp_gpu_take=s(r),
         dev_total=s(P(NODE_AXIS, None)),
         grp_lvm_size=s(r), grp_lvm_vg=s(r), grp_sdev_size=s(r), grp_sdev_media=s(r),

@@ -860,9 +860,9 @@ class BatchTables:
     ss_skip: np.ndarray          # [G] bool (explicit constraints → plugin skipped)
     # carriers
     carr_dom: np.ndarray         # [Tc, N] i32
-    carr_use_anti: np.ndarray    # [Tc] bool
-    carr_hard_w: np.ndarray      # [Tc] f32
-    carr_pref_w: np.ndarray      # [Tc] f32
+    carr_anti_t: np.ndarray      # [G, Ca] i32: anti carrier ids matching g (-1 pad)
+    carr_w_t: np.ndarray         # [G, Cw] i32: weighted carrier ids for g (-1 pad)
+    carr_w_w: np.ndarray         # [G, Cw] f32: those weights
     carr_sel_match_g: np.ndarray  # [Tc, G] bool
     grp_carries: np.ndarray      # [G, Tc] f32
     # gpu-share
@@ -1058,6 +1058,9 @@ def pad_encoder_axes(bt: "BatchTables") -> "BatchTables":
         dns_edom=pad_counter_width(
             pad_axis(pad_axis(bt.dns_edom, 0, Gp, False), 1, _bucket(bt.dns_edom.shape[1]), False)
         ),
+        carr_anti_t=pad_axis(pad_axis(bt.carr_anti_t, 0, Gp, -1), 1, _bucket(max(1, bt.carr_anti_t.shape[1])), -1),
+        carr_w_t=pad_axis(pad_axis(bt.carr_w_t, 0, Gp, -1), 1, _bucket(max(1, bt.carr_w_t.shape[1])), -1),
+        carr_w_w=pad_axis(pad_axis(bt.carr_w_w, 0, Gp, 0.0), 1, _bucket(max(1, bt.carr_w_w.shape[1])), 0.0),
         sa_t=pad_axis(pad_axis(bt.sa_t, 0, Gp, -1), 1, _bucket(bt.sa_t.shape[1]), -1),
         sa_maxskew=pad_axis(pad_axis(bt.sa_maxskew, 0, Gp, 1.0), 1, _bucket(bt.sa_maxskew.shape[1]), 1.0),
         sa_self=pad_axis(pad_axis(bt.sa_self, 0, Gp, 0.0), 1, _bucket(bt.sa_self.shape[1]), 0.0),
@@ -1067,9 +1070,6 @@ def pad_encoder_axes(bt: "BatchTables") -> "BatchTables":
         seed_counter=pad_axis(pad_counter_width(bt.seed_counter), 0, Tp, 0.0),
         # Tc axis
         carr_dom=pad_axis(pad_dom(bt.carr_dom), 0, Tcp, Dp),
-        carr_use_anti=pad_axis(bt.carr_use_anti, 0, Tcp, False),
-        carr_hard_w=pad_axis(bt.carr_hard_w, 0, Tcp, 0.0),
-        carr_pref_w=pad_axis(bt.carr_pref_w, 0, Tcp, 0.0),
         carr_sel_match_g=pad_axis(pad_axis(bt.carr_sel_match_g, 0, Tcp, False), 1, Gp, False),
         seed_carrier=pad_axis(pad_counter_width(bt.seed_carrier), 0, Tcp, 0.0),
         # PORT axis
@@ -1145,6 +1145,32 @@ def build_batch_tables(
     for t, cs in enumerate(enc.carrier_list):
         for gi, g in enumerate(groups):
             carr_sel_match_g[t, gi] = cs.matches_pod(g.template)
+    # per-group carrier SLOTS: the kernels gather only these rows instead of
+    # the full [Tc, N] table (Tc grows with every affinity-carrying pod)
+    carr_anti_lists: List[List[int]] = []
+    carr_w_lists: List[List[int]] = []
+    carr_w_vals: List[List[float]] = []
+    for gi in range(len(groups)):
+        al: List[int] = []
+        wl: List[int] = []
+        wv: List[float] = []
+        for t, cs in enumerate(enc.carrier_list):
+            if not carr_sel_match_g[t, gi]:
+                continue
+            if cs.use == "anti":
+                al.append(t)
+            wgt = 1.0 if cs.use == "hard" else (cs.weight if cs.use == "pref" else 0.0)
+            if wgt != 0.0:
+                wl.append(t)
+                wv.append(wgt)
+        carr_anti_lists.append(al)
+        carr_w_lists.append(wl)
+        carr_w_vals.append(wv)
+    Ca = max((len(a) for a in carr_anti_lists), default=0)
+    Cw = max((len(a) for a in carr_w_lists), default=0)
+    carr_anti_t = _pad_slots(carr_anti_lists or [[]], Ca, -1, np.int32)
+    carr_w_t = _pad_slots(carr_w_lists or [[]], Cw, -1, np.int32)
+    carr_w_w = _pad_slots(carr_w_vals or [[]], Cw, 0.0, np.float32)
     counter_sel_match_g = np.zeros((T, G), bool)
     for t, cs in enumerate(enc.counter_list):
         for gi, g in enumerate(groups):
@@ -1275,17 +1301,10 @@ def build_batch_tables(
         ss_t=np.array([g.ss_counter for g in groups] or [-1], np.int32),
         ss_skip=np.array([g.ss_skip for g in groups] or [False], bool),
         carr_dom=carr_dom,
-        carr_use_anti=np.array(
-            [cs.use == "anti" for cs in enc.carrier_list] or [False], bool
-        ),
-        carr_hard_w=np.array(
-            [1.0 if cs.use == "hard" else 0.0 for cs in enc.carrier_list] or [0.0], np.float32
-        ),
-        carr_pref_w=np.array(
-            [cs.weight if cs.use == "pref" else 0.0 for cs in enc.carrier_list] or [0.0],
-            np.float32,
-        ),
         carr_sel_match_g=carr_sel_match_g,
+        carr_anti_t=carr_anti_t,
+        carr_w_t=carr_w_t,
+        carr_w_w=carr_w_w,
         grp_carries=grp_carries,
         grp_gpu_mem=np.array([g.gpu_mem for g in groups] or [0.0], np.float32),
         grp_gpu_num=np.array([g.gpu_num for g in groups] or [0.0], np.float32),
