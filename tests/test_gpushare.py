@@ -183,3 +183,54 @@ def test_reference_gpushare_example():
     assert gpu_placed, "expected annotated gpu pods to be placed"
     for p in gpu_placed:
         assert p["metadata"]["annotations"].get("alibabacloud.com/gpu-index")
+
+
+def test_distilled_gpushare_example_pinned_outcome():
+    """The in-repo distilled gpushare scenario (examples/, always present —
+    unlike the mounted-reference variant above) with the full outcome pinned:
+    annotation parsing, node-total + per-device filter, tightest-fit
+    single-GPU and in-order multi-GPU allocation, gpu-index writeback, and
+    the node ledger's per-device usage."""
+    import json
+    import os
+
+    from open_simulator_tpu.core import constants as C
+    from open_simulator_tpu.core.types import AppResource
+    from open_simulator_tpu.utils.objutil import annotations_of, name_of
+    from open_simulator_tpu.utils.yamlio import load_cluster_from_directory, \
+        load_resources_from_directory
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cluster = load_cluster_from_directory(
+        os.path.join(repo, "examples/cluster/gpushare"))
+    app = AppResource(name="pai_gpu", resource=load_resources_from_directory(
+        os.path.join(repo, "examples/application/gpushare")))
+    result = simulate(cluster, [app])
+    assert result.unscheduled_pods == []
+
+    placed = {name_of(p): name_of(ns.node)
+              for ns in result.node_status for p in ns.pods}
+    assert len(placed) == 9  # 3 raw pods + 6 ReplicaSet replicas
+    # exactly the two annotated GPU pods receive gpu-index writeback; the
+    # tightest-fit allocator packs both onto pai-node-00 — the SMALLER GPU
+    # node (2 devices vs pai-node-01's 4) — device 0 for the 1Gi pod,
+    # spanning 0-1 for the 2x10Gi pod
+    gpu_idx = {}
+    for ns in result.node_status:
+        for p in ns.pods:
+            anno = annotations_of(p).get(C.AnnoGpuIndex)
+            if anno:
+                gpu_idx[name_of(p)] = (name_of(ns.node), anno)
+    assert gpu_idx == {"gpu-pod-00": ("pai-node-00", "0"),
+                       "gpu-pod-02": ("pai-node-00", "0-1")}
+    counts = {name_of(ns.node): len(ns.pods)
+              for ns in result.node_status if ns.pods}
+    assert counts == {"pai-node-00": 4, "pai-node-01": 5}
+    # the ledger records actual per-device usage, not just static capacity
+    node0 = next(ns.node for ns in result.node_status
+                 if name_of(ns.node) == "pai-node-00")
+    ledger = json.loads(annotations_of(node0)[C.AnnoNodeGpuShare])
+    assert ledger["GpuCount"] == 2
+    briefs = {str(k): v for k, v in (ledger.get("DevsBrief") or {}).items()}
+    used = {d: briefs[d].get("GpuUsedMemory") for d in ("0", "1")}
+    assert all(used.values()), f"per-device usage missing: {ledger}"

@@ -111,3 +111,4 @@ def test_parity_cli(tmp_path):
     save_dump(worse, str(b))
     assert cli_main(["parity", str(a), str(a)]) == 0
     assert cli_main(["parity", str(a), str(b), "--threshold", "0.999"]) == 1
+
