@@ -147,10 +147,13 @@ def test_global_mesh_axes_and_scenarios():
     assert np.asarray(choices).shape[0] == S
 
 
-def test_engine_mesh_epoch_spread_wave_matches_single_device():
+def test_engine_mesh_epoch_spread_wave_matches_single_device(monkeypatch):
     """The epoch-batched spread wave (high-cardinality hostname spread) under
     the 8-way node mesh must place identically to single-device."""
     import copy
+
+    # pin the routing threshold so an ambient tuning can't skip the epoch wave
+    monkeypatch.delenv("OPEN_SIMULATOR_SPREAD_WAVE_MIN_DOMAINS", raising=False)
 
     from open_simulator_tpu.simulator.encode import scheduling_signature
     from fixtures import make_node, make_pod
