@@ -1,0 +1,101 @@
+"""Opt-in extended differential soak: OPEN_SIMULATOR_SOAK=1 pytest tests/test_soak.py
+
+Wide randomized waves-vs-serial sweeps beyond the CI fuzz — the harness that
+validated the wave/epoch kernels during development, preserved so future
+kernel work can re-run it. Each seed builds a random cluster/workload and
+asserts per-(node, scheduling-signature) census and failure equality between
+the batched paths and the pure serial scan.
+"""
+
+import copy
+import os
+import random
+
+import pytest
+
+from open_simulator_tpu.simulator.engine import Simulator
+from open_simulator_tpu.simulator.encode import scheduling_signature
+
+from fixtures import make_node, make_pod
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("OPEN_SIMULATOR_SOAK"),
+    reason="extended soak; set OPEN_SIMULATOR_SOAK=1",
+)
+
+
+def _census(sim):
+    out = {}
+    for i, nps in enumerate(sim.pods_on_node):
+        for p in nps:
+            k = (i, scheduling_signature(p))
+            out[k] = out.get(k, 0) + 1
+    return out
+
+
+def _run(nodes, pods, waves):
+    sim = Simulator(copy.deepcopy(nodes))
+    sim.use_waves = waves
+    failed = sim.schedule_pods(copy.deepcopy(pods))
+    return _census(sim), len(failed)
+
+
+@pytest.mark.parametrize("seed", range(200, 230))
+def test_soak_zone_spread(seed):
+    rng = random.Random(seed)
+    nz = rng.choice([2, 3, 5, 8])
+    nodes = []
+    for i in range(rng.randint(4, 20)):
+        labels = {}
+        if rng.random() < 0.85:
+            labels["topology.kubernetes.io/zone"] = f"z{i % nz}"
+        nodes.append(make_node(f"n{i}", cpu=f"{rng.randint(1500, 6000)}m",
+                               memory=str(rng.randint(3, 10) << 30),
+                               pods=str(rng.randint(4, 30)), labels=labels))
+    pods = []
+    for b in range(rng.randint(1, 3)):
+        app = f"sp{b}"
+        skew = rng.choice([1, 1, 2, 3])
+        for _ in range(rng.randint(8, 60)):
+            p = make_pod(f"{app}-{len(pods)}", cpu=f"{rng.randint(80, 600)}m",
+                         memory=str(rng.randint(64, 768) << 20),
+                         labels={"app": app})
+            p["spec"]["topologySpreadConstraints"] = [{
+                "maxSkew": skew, "topologyKey": "topology.kubernetes.io/zone",
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": app}},
+            }]
+            pods.append(p)
+    assert _run(nodes, pods, True) == _run(nodes, pods, False)
+
+
+@pytest.mark.parametrize("seed", range(400, 430))
+def test_soak_epoch_wave_forced(seed, monkeypatch):
+    # force the epoch wave even at low domain cardinality: the routing is a
+    # performance choice, so the math must stay exact everywhere
+    monkeypatch.setenv("OPEN_SIMULATOR_SPREAD_WAVE_MIN_DOMAINS", "1")
+    rng = random.Random(seed)
+    topo = rng.choice(["kubernetes.io/hostname", "topology.kubernetes.io/zone"])
+    nz = rng.choice([2, 4, 7])
+    nodes = []
+    for i in range(rng.randint(64, 120)):
+        labels = {}
+        if rng.random() < 0.9:
+            labels["topology.kubernetes.io/zone"] = f"z{i % nz}"
+        nodes.append(make_node(f"n{i}", cpu=f"{rng.randint(1000, 4000)}m",
+                               memory=str(rng.randint(2, 8) << 30),
+                               pods=str(rng.randint(2, 12)), labels=labels))
+    pods = []
+    for b in range(rng.randint(1, 3)):
+        app = f"hp{b}"
+        skew = rng.choice([1, 1, 2, 4])
+        for _ in range(rng.randint(10, 80)):
+            p = make_pod(f"{app}-{len(pods)}", cpu=f"{rng.randint(50, 400)}m",
+                         memory=str(rng.randint(32, 512) << 20),
+                         labels={"app": app})
+            p["spec"]["topologySpreadConstraints"] = [{
+                "maxSkew": skew, "topologyKey": topo,
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": app}}}]
+            pods.append(p)
+    assert _run(nodes, pods, True) == _run(nodes, pods, False)
