@@ -48,6 +48,21 @@ def test_cli_apply_runs_example(tmp_path, monkeypatch):
     assert "Simulation success!" in out.read_text()
 
 
+def test_cli_apply_profile_writes_device_trace(tmp_path, monkeypatch):
+    # --profile DIR wraps the run in jax.profiler.trace and must leave a
+    # trace artifact behind (the pprof/debug surfaces are tested in
+    # test_trace.py; this covers the CLI flag wiring end to end)
+    monkeypatch.chdir(REPO)
+    trace_dir = tmp_path / "trace"
+    rc = cli_main([
+        "apply", "-f", "examples/simon-smoke-config.yaml",
+        "--profile", str(trace_dir),
+    ])
+    assert rc == 0
+    dumped = [p for p in trace_dir.rglob("*") if p.is_file()]
+    assert dumped, "expected jax.profiler trace files under --profile DIR"
+
+
 def test_cli_apply_missing_config(capsys):
     assert cli_main(["apply", "-f", "/nonexistent.yaml"]) == 1
     assert "apply error" in capsys.readouterr().err
