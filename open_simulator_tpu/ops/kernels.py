@@ -1291,7 +1291,7 @@ def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
         # base counts + j; zone sums re-aggregate per step over current F
         ss_id = jnp.maximum(tb.ss_t[g], 0)
         # one row's gather, not the [T, N] cnt_at scores() needs for interpod
-        base_pernode = cry.counter[ss_id][tb.counter_dom[ss_id]]       # [N]
+        base_pernode = counter_rows_at(tb, cry, ss_id[None])[1][0]     # [N]
         zones = tb.node_zone
         Z = max(2, n_zones)
     if sa_live:
