@@ -15,6 +15,19 @@ BENCH_DETAIL.json:
     CPU mesh, with a placements-match check against single-device
   - capacity_plan_100k:  config 5, add-node auto-search until 100k pods fit
 
+Wedge resilience: the accelerator tunnel can hang backend init forever (an
+uninterruptible block inside jax.devices()), so this process NEVER initializes
+JAX itself. It probes the default backend in a subprocess with a deadline,
+runs every metric in its own subprocess (default backend if the probe
+succeeded, CPU otherwise), and RE-PROBES before each metric whenever the
+backend was last seen down — a tunnel that recovers mid-run still yields
+partial on-chip rows. Every probe attempt is recorded (timestamps + outcome)
+in BENCH_DETAIL.json's "probe_log" and appended to TPU_PROBE_LOG.jsonl. A
+metric subprocess that wedges on the default backend is killed, marked, and
+re-run on CPU. `.tpu_lock` is held for the duration so the background probe
+logger (tools/probe_tpu.py) never pokes the chip concurrently — two clients
+at once is the suspected wedge trigger.
+
 All runs preserve the reference's serial placement semantics
 (/root/reference/pkg/simulator/simulator.go:309-348 schedules one pod per
 channel handshake; here wave segments provably reproduce consecutive serial
@@ -26,11 +39,22 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 BASELINE_PODS_PER_SEC = 50_000.0
+REPO = os.path.dirname(os.path.abspath(__file__))
+LOCK = os.path.join(REPO, ".tpu_lock")
+PROBE_LOG_FILE = os.path.join(REPO, "TPU_PROBE_LOG.jsonl")
 
+INITIAL_PROBE_TIMEOUT = 120.0
+RETRY_PROBE_TIMEOUT = 60.0
+
+
+# --------------------------------------------------------------------------
+# metric workers (run in subprocesses; the only code here that imports jax)
+# --------------------------------------------------------------------------
 
 def _schedule_run(nodes, pods):
     """One timed end-to-end engine run. Returns (seconds, scheduled, total)."""
@@ -137,8 +161,6 @@ def bench_capacity_plan(n_pods=100_000, repeats=1):
     probe — versus the reference's loop of full re-simulations per candidate
     (apply.go:203-259). The planner's answer is exactly minimal, not the
     doubling-granularity answer the old loop produced."""
-    import os
-
     from open_simulator_tpu.apply.applier import CapacityPlanner
     from open_simulator_tpu.utils.synth import synth_node, synth_pod
 
@@ -167,12 +189,9 @@ def bench_mesh_cpu(n_nodes=1_000, n_pods=10_000, shards=8):
     Simulator(use_mesh=True) over `shards` devices and the single-device
     engine, in a subprocess (the CPU device count must be set before backend
     init). Returns (pods_per_sec, placements_match, error)."""
-    import json as _json
-    import subprocess
-
     code = f"""
 import json, os, sys, time
-sys.path.insert(0, {repr(__file__.rsplit('/', 1)[0])})
+sys.path.insert(0, {repr(REPO)})
 # config-based CPU forcing BEFORE any backend init: some images inject an
 # accelerator plugin whose env-var platform override can hang at import
 from open_simulator_tpu.utils.devices import force_cpu_platform, request_cpu_devices
@@ -211,132 +230,80 @@ print(json.dumps({{"rate": {n_pods} / best, "match": census(single) == mesh_cens
             text=True, timeout=900,
         )
         line = out.stdout.strip().splitlines()[-1]
-        data = _json.loads(line)
+        data = json.loads(line)
         return data["rate"], bool(data["match"]), ""
     except Exception as e:  # the mesh metric is best-effort; report, don't die
         return 0.0, False, f"{type(e).__name__}: {e}"
 
 
-def _ensure_live_backend(probe_timeout: float = 180.0) -> str:
-    """Probe the default JAX backend in a SUBPROCESS before this process
-    touches it: a wedged accelerator tunnel hangs backend init holding a
-    global lock, which would turn the whole bench into a silent timeout.
-    On probe failure, force the CPU backend (config route — the env-var
-    override can itself hang at import under injected plugins) so the bench
-    still emits its JSON lines. Returns the backend label used."""
-    import subprocess
-    import time as _time
+# --------------------------------------------------------------------------
+# metric registry: name -> (row builder, subprocess timeout seconds)
+# --------------------------------------------------------------------------
 
-    import tempfile
-
-    detail = ""
-    # Popen + poll, NOT subprocess.run: run's timeout path blocks in wait()
-    # after SIGKILL, which never returns for a child wedged in a D-state
-    # driver ioctl — the exact failure mode being probed for. stderr goes to a
-    # FILE, not a pipe: a chatty plugin writing >64KB to an undrained pipe
-    # would wedge an otherwise-healthy probe into a phantom timeout.
-    with tempfile.TemporaryFile() as errf:
-        probe = subprocess.Popen(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            stdout=subprocess.DEVNULL, stderr=errf,
-            start_new_session=True,
-        )
-        deadline = _time.time() + probe_timeout
-        while _time.time() < deadline:
-            rc = probe.poll()
-            if rc == 0:
-                return "default"
-            if rc is not None:
-                try:
-                    errf.seek(0)
-                    tail = errf.read()[-400:].decode("utf-8", "replace")
-                except Exception:
-                    tail = ""
-                detail = f"probe exited rc={rc}: {tail.strip()}"
-                break
-            _time.sleep(0.5)
-        else:
-            probe.kill()  # best effort; no wait() — the child may be unkillable
-            detail = f"probe timed out after {probe_timeout:.0f}s"
-    os.environ.pop("JAX_PLATFORMS", None)
-    print(json.dumps({"warning": "default backend unreachable; benching on CPU",
-                      "detail": detail}),
-          file=sys.stderr, flush=True)
-    try:
-        from open_simulator_tpu.utils.devices import force_cpu_platform
-
-        force_cpu_platform()
-    except Exception as e:  # even a broken jax install shouldn't kill the warning
-        print(json.dumps({"warning": f"cpu fallback failed: {e}"}),
-              file=sys.stderr, flush=True)
-    return "cpu-fallback"
-
-
-def main() -> None:
-    backend = _ensure_live_backend()
-    results = []
-
-    # ---- headline: north star ------------------------------------------------
+def _row_north_star():
     rate, placed, total, dt = bench_throughput(10_000, 100_000)
-    headline = {
+    return {
         "metric": "pods_scheduled_per_sec_100k_pods_10k_nodes",
-        "value": round(rate, 1),
-        "unit": "pods/s",
+        "value": round(rate, 1), "unit": "pods/s",
         "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4),
-        **({"backend": backend} if backend != "default" else {}),
+        "wall_s": round(dt, 3), "scheduled": placed, "total": total,
     }
-    results.append(dict(headline, wall_s=round(dt, 3), scheduled=placed, total=total))
-    print(json.dumps(headline), flush=True)
 
-    # ---- config 2: 10k/1k ----------------------------------------------------
+
+def _row_throughput_10k_1k():
     rate, placed, total, dt = bench_throughput(1_000, 10_000)
-    results.append({
+    return {
         "metric": "pods_scheduled_per_sec_10k_pods_1000_nodes",
         "value": round(rate, 1), "unit": "pods/s",
         "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4),
         "wall_s": round(dt, 3), "scheduled": placed, "total": total,
-    })
+    }
 
-    # ---- config 3: gpushare --------------------------------------------------
+
+def _row_gpushare():
     rate, placed, total, dt = bench_gpushare()
-    results.append({
+    return {
         "metric": "gpushare_pods_per_sec_5k_pods_1k_nodes",
         "value": round(rate, 1), "unit": "pods/s",
         "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4),
         "wall_s": round(dt, 3), "scheduled": placed, "total": total,
-    })
+    }
 
-    # ---- config 4: hard predicates ------------------------------------------
+
+def _row_hard():
     rate, placed, total, dt = bench_throughput(5_000, 50_000, hard=True)
-    results.append({
+    return {
         "metric": "hard_predicate_pods_per_sec_50k_pods_5k_nodes",
         "value": round(rate, 1), "unit": "pods/s",
         "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4),
         "wall_s": round(dt, 3), "scheduled": placed, "total": total,
-    })
+    }
 
-    # ---- placement agreement vs the serial scheduler -------------------------
+
+def _row_agreement():
     rate, total = bench_placement_agreement()
-    results.append({
+    return {
         "metric": "placement_agreement_waves_vs_serial_10k_hard",
         "value": round(rate, 6), "unit": "fraction",
         "vs_baseline": round(rate / 0.99, 4),  # target: >=99% agreement
         "pods": total,
-    })
+    }
 
-    # ---- mesh: sharded product path on a virtual CPU mesh --------------------
+
+def _row_mesh8():
     rate, match, err = bench_mesh_cpu()
-    results.append({
+    return {
         "metric": "mesh8_cpu_pods_per_sec_10k_pods_1k_nodes",
         "value": round(rate, 1), "unit": "pods/s",
         "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4),
         "placements_match_single_device": match,
         **({"error": err} if err else {}),
-    })
+    }
 
-    # ---- config 5: capacity planning ----------------------------------------
+
+def _row_capacity():
     rate, added, dt = bench_capacity_plan()
-    results.append({
+    return {
         "metric": "capacity_plan_pods_per_sec_100k_pods",
         # a search that exhausted its node budget has no meaningful throughput
         "value": round(rate, 1) if added is not None else 0.0,
@@ -344,18 +311,142 @@ def main() -> None:
         "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4) if added is not None else 0.0,
         "wall_s": round(dt, 3), "nodes_added": added,
         "search_exhausted": added is None,
-    })
+    }
 
-    if backend != "default":
-        # every in-process config ran on the fallback backend, not just the
-        # headline — label them all so records stay backend-comparable
-        for r in results:
-            r.setdefault("backend", backend)
-    for r in results[1:]:
-        print(json.dumps(r), file=sys.stderr, flush=True)
-    with open("BENCH_DETAIL.json", "w") as f:
-        json.dump(results, f, indent=1)
+
+# (name, builder, timeout_s, needs_device_backend). mesh8 always runs on a
+# virtual CPU mesh by definition, so it never probes or occupies the chip.
+METRICS = [
+    ("north_star", _row_north_star, 1800, True),
+    ("throughput_10k_1k", _row_throughput_10k_1k, 900, True),
+    ("gpushare", _row_gpushare, 900, True),
+    ("hard", _row_hard, 1800, True),
+    ("agreement", _row_agreement, 1800, True),
+    ("mesh8", _row_mesh8, 1200, False),
+    ("capacity", _row_capacity, 1800, True),
+]
+
+
+def _run_worker(name: str) -> None:
+    """Subprocess entry: select platform, run one metric, print its row."""
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # config route, not env var: the injected accelerator plugin can hang
+        # at import when JAX_PLATFORMS is set (see utils/devices.py)
+        os.environ.pop("JAX_PLATFORMS", None)
+        from open_simulator_tpu.utils.devices import force_cpu_platform
+
+        force_cpu_platform()
+    builder = {n: b for n, b, _, _ in METRICS}[name]
+    print(json.dumps(builder()), flush=True)
+
+
+# --------------------------------------------------------------------------
+# orchestrator (never imports jax)
+# --------------------------------------------------------------------------
+
+def _log_probe(rec: dict, probe_log: list) -> None:
+    probe_log.append(rec)
+    try:
+        with open(PROBE_LOG_FILE, "a") as f:
+            f.write(json.dumps(dict(rec, source="bench")) + "\n")
+    except OSError:
+        pass
+    print(json.dumps(dict(rec, probe=True)), file=sys.stderr, flush=True)
+
+
+def _probe_backend(timeout: float, probe_log: list) -> bool:
+    """One wedge-safe subprocess probe (shared implementation in
+    open_simulator_tpu/utils/devices.py), recorded into the probe log."""
+    from open_simulator_tpu.utils.devices import probe_default_backend
+
+    ok, rec = probe_default_backend(timeout)
+    _log_probe(rec, probe_log)
+    return ok
+
+
+def _run_metric(name: str, timeout: float, force_cpu: bool) -> dict | None:
+    """Run one metric in a subprocess; returns its row or None on failure."""
+    env = dict(os.environ)
+    if force_cpu:
+        env.pop("JAX_PLATFORMS", None)
+        env["BENCH_FORCE_CPU"] = "1"
+    else:
+        env.pop("BENCH_FORCE_CPU", None)  # a stray export would silently turn
+        # "default"-labeled rows into CPU runs
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--metric", name],
+        stdout=subprocess.PIPE, stderr=sys.stderr, text=True, env=env,
+        start_new_session=True,
+    )
+    try:
+        out, _ = child.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        child.kill()
+        try:
+            child.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+        return None
+    if child.returncode != 0:
+        return None
+    try:
+        return json.loads(out.strip().splitlines()[-1])
+    except (IndexError, ValueError):
+        return None
+
+
+def main() -> None:
+    from open_simulator_tpu.utils.devices import acquire_tpu_lock, release_tpu_lock
+
+    probe_log: list = []
+    results: list = []
+    # hold the chip lock so tools/probe_tpu.py skips its attempts while the
+    # bench may be running device work (two concurrent clients can wedge it).
+    # A prober may be mid-probe (up to ~120s): wait it out, then proceed
+    # regardless — benching beats deadlocking on a crashed lock holder.
+    deadline = time.time() + 180
+    while not acquire_tpu_lock(LOCK) and time.time() < deadline:
+        time.sleep(5)
+    try:
+        device_ok = _probe_backend(INITIAL_PROBE_TIMEOUT, probe_log)
+        for name, _, timeout, needs_device in METRICS:
+            if needs_device and not device_ok:
+                # re-probe before every metric: a late-recovering tunnel
+                # still yields partial on-chip rows
+                device_ok = _probe_backend(RETRY_PROBE_TIMEOUT, probe_log)
+            use_device = needs_device and device_ok
+            row = _run_metric(name, timeout, force_cpu=not use_device)
+            if row is None and use_device:
+                # the device run wedged or crashed: mark the backend down and
+                # redo this metric on CPU so the record stays complete
+                device_ok = False
+                _log_probe({"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                            "outcome": "metric-failed-on-device", "metric": name},
+                           probe_log)
+                row = _run_metric(name, timeout, force_cpu=True)
+                use_device = False
+            if row is None:
+                row = {"metric": name, "error": "metric subprocess failed",
+                       "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0}
+            if name == "mesh8":
+                row["backend"] = "cpu-virtual-mesh"
+            else:
+                row["backend"] = "default" if use_device else "cpu-fallback"
+            results.append(row)
+            out = sys.stdout if name == "north_star" else sys.stderr
+            headline = {k: row[k] for k in
+                        ("metric", "value", "unit", "vs_baseline", "backend")
+                        if k in row}
+            print(json.dumps(headline if name == "north_star" else row),
+                  file=out, flush=True)
+    finally:
+        release_tpu_lock(LOCK)
+        with open(os.path.join(REPO, "BENCH_DETAIL.json"), "w") as f:
+            json.dump({"results": results, "probe_log": probe_log}, f, indent=1)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--metric":
+        _run_worker(sys.argv[2])
+    else:
+        main()

@@ -111,6 +111,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def cmd_apply(args) -> int:
     from ..apply.applier import Applier, Options
+    from ..utils.devices import ensure_responsive_backend
+
+    # a wedged accelerator tunnel would otherwise hang the whole run at first
+    # device use; probe it with a deadline and degrade to CPU instead
+    ensure_responsive_backend()
 
     ext = [e.strip() for e in (args.extended_resources or "").split(",") if e.strip()]
     try:
@@ -143,6 +148,9 @@ def cmd_apply(args) -> int:
 
 def cmd_server(args) -> int:
     from ..server.http import Server
+    from ..utils.devices import ensure_responsive_backend
+
+    ensure_responsive_backend()
 
     try:
         server = Server(kubeconfig=args.kubeconfig, master=args.master)

@@ -78,6 +78,159 @@ def enable_compilation_cache() -> None:
             "kernels will recompile per process", e)
 
 
+def probe_default_backend(timeout: float = 60.0) -> tuple:
+    """Probe `jax.devices()` on the default platform in a SUBPROCESS with a
+    deadline. The single shared implementation of the wedge-safe probe (bench,
+    the background probe logger, and the CLI all use it): a wedged accelerator
+    tunnel blocks backend init forever holding a global lock, so the probe must
+    never run in-process, and the killed child may be unkillable (D-state in a
+    driver ioctl) — kill then bounded-wait to reap when possible.
+
+    Returns (ok, record) where record carries ts/outcome/elapsed_s plus
+    rc/platform/stderr_tail on non-timeout exits — the stderr tail is what
+    distinguishes "tunnel wedged" from "plugin crashed at import" in the logs."""
+    import subprocess
+    import sys
+    import tempfile
+    import time
+
+    t0 = time.time()
+    rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t0)),
+           "timeout_s": timeout}
+    # stderr to a FILE, not a pipe: a chatty plugin writing >64KB to an
+    # undrained pipe would wedge an otherwise-healthy probe into a timeout
+    with tempfile.TemporaryFile() as errf:
+        probe = subprocess.Popen(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); print(d[0].platform, len(d))"],
+            stdout=subprocess.PIPE, stderr=errf, text=True,
+            start_new_session=True,
+        )
+        try:
+            out, _ = probe.communicate(timeout=timeout)
+            ok = probe.returncode == 0
+            rec.update(outcome="ok" if ok else "error", rc=probe.returncode,
+                       platform=(out or "").strip() or None,
+                       elapsed_s=round(time.time() - t0, 1))
+            if not ok:
+                try:
+                    errf.seek(0)
+                    rec["stderr_tail"] = errf.read()[-400:].decode(
+                        "utf-8", "replace").strip()
+                except OSError:
+                    pass
+        except subprocess.TimeoutExpired:
+            ok = False
+            probe.kill()
+            try:
+                probe.wait(timeout=5)  # reap; a D-state child won't die
+            except subprocess.TimeoutExpired:
+                pass
+            rec.update(outcome="timeout", elapsed_s=round(time.time() - t0, 1))
+    return ok, rec
+
+
+# --- chip lock: serializes would-be accelerator clients on one machine --------
+# A killed mid-compile client is the suspected tunnel-wedge trigger, so the
+# bench, the background probe logger, and (opt-in via OPEN_SIMULATOR_TPU_LOCK)
+# the CLI coordinate through one pidfile.
+
+
+def tpu_lock_holder(lock_path: str):
+    """PID holding the lock, or None when missing/unreadable/stale (dead PID)."""
+    try:
+        with open(lock_path) as f:
+            pid = int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return None
+    if pid <= 0:
+        return None
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return None  # holder died without cleanup: stale
+    return pid
+
+
+def acquire_tpu_lock(lock_path: str) -> bool:
+    """Atomically acquire (O_CREAT|O_EXCL), stealing a stale dead-PID lock.
+    Returns False when a live process holds it."""
+    for _ in range(2):
+        try:
+            fd = os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, str(os.getpid()).encode())
+            os.close(fd)
+            return True
+        except FileExistsError:
+            if tpu_lock_holder(lock_path) is not None:
+                return False
+            try:
+                os.remove(lock_path)  # stale: steal and retry the O_EXCL create
+            except OSError:
+                pass
+    return False
+
+
+def release_tpu_lock(lock_path: str) -> None:
+    try:
+        os.remove(lock_path)
+    except OSError:
+        pass
+
+
+def ensure_responsive_backend(timeout: float = 60.0) -> str:
+    """Guard a CLI/server/library run against a wedged accelerator: probe the
+    default JAX backend with a deadline (probe_default_backend) and force the
+    CPU platform on failure (config route — the env-var override can itself
+    hang at import under injected plugins), so the run proceeds degraded
+    instead of hanging forever at first device use.
+
+    Returns "default" (probe ok), "cpu" (fell back), or "skipped".
+    Skipped when: OPEN_SIMULATOR_BACKEND_PROBE=0; the platform is already
+    pinned to cpu (env var, or in-process jax config — how tests pin it);
+    falls straight back to CPU without probing when OPEN_SIMULATOR_TPU_LOCK
+    names a lockfile held by a live process (another client owns the chip —
+    two concurrent clients are the suspected wedge trigger).
+    OPEN_SIMULATOR_BACKEND_PROBE_TIMEOUT overrides the deadline (seconds)."""
+    import sys
+
+    env_probe = os.environ.get("OPEN_SIMULATOR_BACKEND_PROBE", "")
+    if env_probe.lower() in ("0", "off", "false", "no"):
+        return "skipped"
+    if str(os.environ.get("JAX_PLATFORMS", "")).startswith("cpu"):
+        return "skipped"  # explicitly CPU: nothing to probe
+    j = sys.modules.get("jax")
+    if j is not None:
+        try:
+            if str(j.config.jax_platforms or "").startswith("cpu"):
+                return "skipped"  # already pinned in-process (force_cpu_platform)
+        except Exception:
+            pass
+    import logging
+
+    log = logging.getLogger("open_simulator_tpu")
+    lock_path = os.environ.get("OPEN_SIMULATOR_TPU_LOCK", "")
+    if lock_path and tpu_lock_holder(lock_path) is not None:
+        log.warning("accelerator lock %s is held; using CPU for this run",
+                    lock_path)
+        os.environ.pop("JAX_PLATFORMS", None)
+        force_cpu_platform()
+        return "cpu"
+    try:
+        timeout = float(
+            os.environ.get("OPEN_SIMULATOR_BACKEND_PROBE_TIMEOUT", timeout))
+    except ValueError:
+        pass
+    ok, rec = probe_default_backend(timeout)
+    if ok:
+        return "default"
+    log.warning("default JAX backend unresponsive (%s); falling back to CPU",
+                rec.get("stderr_tail") or rec["outcome"])
+    os.environ.pop("JAX_PLATFORMS", None)
+    force_cpu_platform()
+    return "cpu"
+
+
 def cpu_devices(n: int):
     """Best-effort list of ≥ n devices, preferring the default platform and falling
     back to virtual CPU devices. May return fewer if the CPU backend already

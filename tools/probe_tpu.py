@@ -1,0 +1,67 @@
+"""Background TPU-tunnel probe logger.
+
+The accelerator tunnel can wedge for hours (jax.devices() blocks forever in
+backend init — see utils/devices.py probe_default_backend). This script probes
+it in a subprocess on an interval and appends one JSON line per attempt to
+TPU_PROBE_LOG.jsonl, producing a round-long record of tunnel availability:
+either the evidence that on-chip numbers were impossible, or the signal that
+the tunnel recovered and the bench should be re-run on the device.
+
+Protocol: each probe attempt holds the `.tpu_lock` pidfile (stale dead-PID
+locks are stolen); if a live process — the bench — holds it, the attempt is
+skipped entirely. Two concurrent clients can wedge the tunnel, which is the
+failure being monitored in the first place.
+
+Usage: python tools/probe_tpu.py [--interval 600] [--timeout 120] [--once]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "TPU_PROBE_LOG.jsonl")
+LOCK = os.path.join(REPO, ".tpu_lock")
+sys.path.insert(0, REPO)
+
+from open_simulator_tpu.utils.devices import (  # noqa: E402
+    acquire_tpu_lock,
+    probe_default_backend,
+    release_tpu_lock,
+)
+
+
+def probe_once(timeout: float) -> dict:
+    """One lock-guarded subprocess probe. Never touches the backend in-process."""
+    if not acquire_tpu_lock(LOCK):
+        return {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                "outcome": "skipped-lock", "elapsed_s": 0.0}
+    try:
+        _, rec = probe_default_backend(timeout)
+        return rec
+    finally:
+        release_tpu_lock(LOCK)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=600.0)
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--once", action="store_true")
+    args = ap.parse_args()
+    while True:
+        rec = probe_once(args.timeout)
+        with open(LOG, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec), flush=True)
+        if args.once:
+            break
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()
