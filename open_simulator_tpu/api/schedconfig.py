@@ -95,6 +95,10 @@ class SchedulerConfig:
         default_factory=lambda: {k: d for k, (_, d) in SCORE_PLUGINS.items()})
     disabled_kernel_filters: FrozenSet[str] = frozenset()
     disabled_encoder_filters: FrozenSet[str] = frozenset()
+    # postFilter: the default set has exactly DefaultPreemption
+    # (algorithmprovider/registry.go:106-110); disabling it turns the
+    # engine's preemption pass off (simulator/preemption.py)
+    preemption_disabled: bool = False
 
     def weight_kwargs(self) -> Dict[str, float]:
         """{engine weight key: weight} for kernels.ScoreWeights(**kwargs)."""
@@ -165,10 +169,23 @@ def parse_scheduler_config(path: str) -> SchedulerConfig:
     # extension points whose overrides the engine cannot honor; bind/reserve
     # are accepted when they only touch the Simon set (the reference itself
     # rewrites them, utils.go:321-368)
-    for point in set(plugins) - {"score", "filter", "bind", "reserve"}:
+    for point in set(plugins) - {"score", "filter", "bind", "reserve", "postFilter"}:
         if (plugins.get(point) or {}).get("enabled") or (plugins.get(point) or {}).get("disabled"):
             raise ConfigError(
                 f"scheduler config: overriding the {point} extension point is not supported")
+    preemption_disabled = False
+    pf = plugins.get("postFilter") or {}
+    for entry in _plugin_list(pf.get("disabled"), "postFilter.disabled"):
+        if entry["name"] in ("*", "DefaultPreemption"):
+            preemption_disabled = True
+        else:
+            raise ConfigError(
+                f"scheduler config: unknown postFilter plugin {entry['name']!r}")
+    for entry in _plugin_list(pf.get("enabled"), "postFilter.enabled"):
+        if entry["name"] != "DefaultPreemption":
+            raise ConfigError(
+                f"scheduler config: unknown postFilter plugin {entry['name']!r}")
+        preemption_disabled = False
     for point in ("bind", "reserve"):
         for entry in _plugin_list((plugins.get(point) or {}).get("enabled"), point):
             if entry["name"] not in ("Simon", "Open-Local", "Open-Gpu-Share", "DefaultBinder"):
@@ -224,4 +241,5 @@ def parse_scheduler_config(path: str) -> SchedulerConfig:
         score_weights=weights,
         disabled_kernel_filters=frozenset(disabled_kernel),
         disabled_encoder_filters=frozenset(disabled_encoder),
+        preemption_disabled=preemption_disabled,
     )

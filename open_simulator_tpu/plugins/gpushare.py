@@ -267,6 +267,61 @@ class GpuShareHost:
         alloc = state.node.setdefault("status", {}).setdefault("allocatable", {})
         alloc[C.ResourceGpuCount] = str(info["GpuAllocatable"])
 
+    def release(self, pod: dict, node_i: int) -> None:
+        """Undo one committed pod's allocation (preemption eviction): subtract
+        its memory from the devices named by its gpu-index annotation and drop
+        it from the per-device pod lists. The reference has no release path —
+        a deleted pod's share lingers in its cache — but leaving it here would
+        desync the ledger from pods_on_node, which this build treats as the
+        single source of truth (see simulator/preemption.py)."""
+        mem = pod_gpu_mem(pod)
+        state = self.states[node_i]
+        if mem <= 0 or state is None:
+            return
+        try:
+            idl = gpu_id_str_to_list(pod_gpu_index(pod))
+        except ValueError:
+            return
+        for idx in idl:
+            if 0 <= idx < state.gpu_count:
+                state.dev_used[idx] -= mem
+                state.dev_pods[idx] = [p for p in state.dev_pods[idx] if p is not pod]
+        self._dirty.add(node_i)
+
+    def snapshot(self):
+        """Copy of all mutable ledger state + the node fields this plugin owns
+        (annotation + whole-GPU allocatable), for preemption rewind."""
+        states = []
+        for s in self.states:
+            if s is None:
+                states.append(None)
+                continue
+            anns = (s.node.get("metadata") or {}).get("annotations") or {}
+            alloc = (s.node.get("status") or {}).get("allocatable") or {}
+            states.append((
+                list(s.dev_used), [list(dp) for dp in s.dev_pods],
+                anns.get(C.AnnoNodeGpuShare), alloc.get(C.ResourceGpuCount),
+            ))
+        return states, self._assume_seq, set(self._dirty)
+
+    def restore(self, snap) -> None:
+        states, self._assume_seq, self._dirty = snap[0], snap[1], set(snap[2])
+        for s, rec in zip(self.states, states):
+            if s is None or rec is None:
+                continue
+            s.dev_used = list(rec[0])
+            s.dev_pods = [list(dp) for dp in rec[1]]
+            anns = s.node.setdefault("metadata", {}).setdefault("annotations", {})
+            if rec[2] is None:
+                anns.pop(C.AnnoNodeGpuShare, None)
+            else:
+                anns[C.AnnoNodeGpuShare] = rec[2]
+            alloc = s.node.setdefault("status", {}).setdefault("allocatable", {})
+            if rec[3] is None:
+                alloc.pop(C.ResourceGpuCount, None)
+            else:
+                alloc[C.ResourceGpuCount] = rec[3]
+
     def seed_pod(self, pod: dict, node_i: int) -> None:
         """Account one already-bound pod carrying a gpu-index annotation
         (live-cluster snapshots); O(1) per pod."""

@@ -200,6 +200,9 @@ class OpenLocalHost:
                     self.vg_names.setdefault(vg.name, len(self.vg_names) + 1)
         self.max_vgs = max((len(st.vgs) for st in self.states if st), default=0)
         self.max_devs = max((len(st.devices) for st in self.states if st), default=0)
+        # id(pod) → (node_i, lvm_units, dev_units): the exact units reserve()
+        # granted, so a preemption eviction can release precisely those
+        self._alloc: Dict[int, tuple] = {}
 
     @property
     def enabled(self) -> bool:
@@ -234,8 +237,55 @@ class OpenLocalHost:
             state.vgs[idx].requested += size
         for idx, _ in dev_units:
             state.devices[idx].is_allocated = True
+        self._alloc[id(pod)] = (node_i, lvm_units, dev_units)
         set_node_storage(self.nodes[node_i], state)
         return True
+
+    def release(self, pod: dict, node_i: int) -> None:
+        """Undo reserve() for one pod (preemption eviction), returning exactly
+        the units it was granted. No reference analog (see gpushare.release)."""
+        rec = self._alloc.pop(id(pod), None)
+        if rec is None or rec[0] != node_i:
+            return
+        state = self.states[node_i]
+        if state is None:
+            return
+        for idx, size in rec[1]:
+            state.vgs[idx].requested -= size
+        for idx, _ in rec[2]:
+            state.devices[idx].is_allocated = False
+        set_node_storage(self.nodes[node_i], state)
+
+    def snapshot(self):
+        """Copy of VG/device ledgers + the node annotation this plugin owns."""
+        from ..utils.objutil import annotations_of
+
+        states = []
+        for st, node in zip(self.states, self.nodes):
+            if st is None:
+                states.append(None)
+                continue
+            states.append((
+                [vg.requested for vg in st.vgs],
+                [d.is_allocated for d in st.devices],
+                annotations_of(node).get(C.AnnoNodeLocalStorage),
+            ))
+        return states, dict(self._alloc)
+
+    def restore(self, snap) -> None:
+        states, self._alloc = snap[0], dict(snap[1])
+        for st, node, rec in zip(self.states, self.nodes, states):
+            if st is None or rec is None:
+                continue
+            for vg, req in zip(st.vgs, rec[0]):
+                vg.requested = req
+            for d, alloc in zip(st.devices, rec[1]):
+                d.is_allocated = alloc
+            anns = node.setdefault("metadata", {}).setdefault("annotations", {})
+            if rec[2] is None:
+                anns.pop(C.AnnoNodeLocalStorage, None)
+            else:
+                anns[C.AnnoNodeLocalStorage] = rec[2]
 
     # ---- tensorization ---------------------------------------------------------
 

@@ -174,6 +174,18 @@ class Simulator:
         self.placed: Dict[object, PlacedGroup] = {}  # signature → aggregated commits
         self.pods_on_node: List[List[dict]] = [[] for _ in nodes]
         self.homeless: List[dict] = []  # bound to a node name we don't know
+        # Preemption bookkeeping (simulator/preemption.py). _sig_of and
+        # _commits_prio are maintained on every commit (a dict store + int
+        # append per pod): evictions must find any placed pod's signature,
+        # and commit order proxies pod start time. The commit LOG (pod-dict
+        # undo info for the rewind) only fills once mixed priorities arm the
+        # PostFilter.
+        self.preempted: List[dict] = []   # {pod, node, by} eviction records
+        self._sig_of: Dict[int, tuple] = {}   # id(pod) → (sig, node_i, seq)
+        self._commits_prio: List[int] = []    # spec.priority per commit, in order
+        self._commit_log: List[tuple] = []    # (pod, prev_gpu_index, prev_assume)
+        self._preempt_armed = False
+        self._priority_seen: set = set()
         self.match_cache: Dict[Tuple[int, object], bool] = {}  # (counter id, sched signature)
         self.disable_progress = disable_progress
         self.patch_pod_funcs = patch_pod_funcs or []
@@ -198,6 +210,11 @@ class Simulator:
     # ------------------------------------------------------------- state ----------
 
     def _commit_pod(self, pod: dict, node_i: int, scheduled: bool = True) -> None:
+        if scheduled and self._preempt_armed:
+            # rewind info BEFORE reserve() mutates the pod (preemption.restore)
+            anns = (pod.get("metadata") or {}).get("annotations") or {}
+            self._commit_log.append((
+                pod, anns.get(C.AnnoGpuIndex), anns.get(C.AnnoGpuAssumeTime)))
         pod.setdefault("spec", {})["nodeName"] = self.na.names[node_i]
         pod["status"] = {"phase": "Running"}
         # Snapshot the signature BEFORE reserve() writes gpu-index/assume-time
@@ -207,6 +224,11 @@ class Simulator:
         sig = pod.get(SIG_MEMO_KEY)
         if sig is None:
             sig = scheduling_signature(pod)
+        self._sig_of[id(pod)] = (sig, node_i, len(self._commits_prio))
+        try:
+            self._commits_prio.append(int((pod.get("spec") or {}).get("priority") or 0))
+        except (TypeError, ValueError):
+            self._commits_prio.append(0)
         if scheduled:
             # Open-Gpu-Share Reserve: assign device ids, write the gpu-index pod
             # annotation + simon/node-gpu-share node annotation, adjust whole-GPU
@@ -260,10 +282,36 @@ class Simulator:
         reference's strictly serial order: runs of unbound pods become one compiled
         scan; a pre-bound pod (spec.nodeName) flushes the run first, then commits
         directly — so earlier unbound pods never see capacity a later bound pod will
-        take, exactly as in the serial loop."""
+        take, exactly as in the serial loop.
+
+        When the pods seen so far carry more than one distinct spec.priority,
+        the DefaultPreemption PostFilter arms (simulator/preemption.py): failed
+        pods may evict strictly-lower-priority victims, exactly like the
+        reference's default plugin set (algorithmprovider/registry.go:106-110).
+        With uniform priorities preemption is provably inert — no victim can
+        have strictly lower priority — so the single-pass batched run is used
+        unchanged."""
+        if self._track_priorities(pods):
+            from .preemption import schedule_with_preemption
+
+            return schedule_with_preemption(self, pods)
+        return self._schedule_pods_inner(pods)
+
+    def _track_priorities(self, pods: List[dict]) -> bool:
+        """Arm the PostFilter when >1 distinct priority has been seen across
+        ALL schedule_pods calls (cluster pods and app pods schedule in separate
+        calls, and a priority gap BETWEEN those sets is exactly where the
+        reference could preempt), unless the scheduler config disabled it."""
+        if getattr(self.sched_config, "preemption_disabled", False):
+            return False
+        seen = self._priority_seen
+        seen.update((p.get("spec") or {}).get("priority") or 0 for p in pods)
+        self._preempt_armed = len(seen) > 1
+        return self._preempt_armed
+
+    def _schedule_pods_inner(self, pods: List[dict]) -> List[UnscheduledPod]:
         from ..utils.trace import Progress
 
-        self._warn_on_mixed_priorities(pods)
         failed: List[UnscheduledPod] = []
         run: List[dict] = []
         # None when disabled so the per-pod loops skip the call entirely
@@ -294,34 +342,6 @@ class Simulator:
         if self.gpu_host.enabled:
             self.gpu_host.flush()
         return failed
-
-    def _warn_on_mixed_priorities(self, pods: List[dict]) -> None:
-        """DefaultPreemption (PostFilter) is NOT simulated. With uniform pod
-        priorities this is provably inert: preemption requires a victim of
-        strictly lower priority than the failed pod (default_preemption.go
-        selectVictimsOnNode), so with one priority class there is never a
-        candidate and the reference's scheduler returns the same unschedulable
-        verdict. Inputs carrying MULTIPLE distinct spec.priority values could
-        preempt in the reference, so they get a loud warning here instead of a
-        silent divergence (see PARITY.md 'Preemption')."""
-        if getattr(self, "_priority_warned", False):
-            return
-        # persists across schedule_pods calls: cluster pods and app pods are
-        # scheduled in separate calls, and a priority gap BETWEEN those sets is
-        # exactly where the reference could preempt
-        seen = getattr(self, "_priority_seen", None)
-        if seen is None:
-            seen = self._priority_seen = set()
-        seen.update((p.get("spec") or {}).get("priority") or 0 for p in pods)
-        if len(seen) > 1:
-            import logging
-
-            logging.getLogger("open_simulator_tpu").warning(
-                "pods carry %d distinct spec.priority values; preemption "
-                "(DefaultPreemption PostFilter) is not simulated — "
-                "placements may diverge from a preempting scheduler for "
-                "workloads that overflow capacity", len(seen))
-            self._priority_warned = True
 
     def encode_batch(self, to_schedule: List[dict]) -> BatchTables:
         """Encode a pod batch into device-ready tables (no scheduling). Exposed for
@@ -825,6 +845,13 @@ class Simulator:
         )
         N = self.na.N  # stages arrays may carry phantom node padding; slice it off
         stages = {k: np.asarray(v)[:N] for k, v in stages.items()}
+        return self._reasons_from_stages(pod, forced, stages)
+
+    def _reasons_from_stages(self, pod: dict, forced: int,
+                             stages: Dict[str, np.ndarray]) -> Dict[str, int]:
+        """Reason counts from already-fetched per-stage masks ([N] each);
+        shared with the preemption pass, which evaluates the stages itself."""
+        N = self.na.N
         remaining = np.ones(N, bool)
         if forced >= 0:
             only = np.zeros(N, bool)

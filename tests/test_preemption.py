@@ -1,0 +1,261 @@
+"""DefaultPreemption (PostFilter) semantics — simulator/preemption.py.
+
+Mirrors the behavior of the reference's default PostFilter plugin
+(/root/reference/vendor/k8s.io/kubernetes/pkg/scheduler/framework/plugins/
+defaultpreemption/default_preemption.go): victim selection per
+selectVictimsOnNode, candidate ranking per pickOneNodeForPreemption, and the
+simulator-observable outcome — victims deleted from their nodes, the
+preemptor recorded unschedulable with a nominated node (scheduler.go records
+the FitError after PostFilter; Simon then deletes the pod,
+pkg/simulator/simulator.go:333-342), and later pods seeing the freed capacity.
+"""
+
+from __future__ import annotations
+
+from open_simulator_tpu.simulator.engine import Simulator
+
+from fixtures import make_node, make_pod
+
+
+def prio_pod(name, priority, cpu="1", **kw):
+    p = make_pod(name, cpu=cpu, **kw)
+    p["spec"]["priority"] = priority
+    return p
+
+
+def names_on(sim, node_i=0):
+    return sorted(p["metadata"]["name"] for p in sim.pods_on_node[node_i])
+
+
+def test_basic_preemption_evicts_lowest_importance_victims():
+    """selectVictimsOnNode: remove all lower-priority pods, then reprieve
+    most-important-first — the surviving victims are the latest-placed ones."""
+    nodes = [make_node("n0", cpu="4")]
+    lows = [prio_pod(f"low{i}", 0) for i in range(4)]
+    high = prio_pod("high", 100, cpu="2")
+    sim = Simulator(nodes)
+    failed = sim.schedule_pods(lows + [high])
+    # the preemptor is still recorded unschedulable (reference behavior), with
+    # the nominated node visible on its status
+    assert [u.pod["metadata"]["name"] for u in failed] == ["high"]
+    assert failed[0].pod["status"]["nominatedNodeName"] == "n0"
+    assert "Insufficient cpu" in failed[0].reason
+    # reprieve kept the two earliest-committed victims; the two latest were evicted
+    assert names_on(sim) == ["low0", "low1"]
+    assert sorted(r["pod"]["metadata"]["name"] for r in sim.preempted) == [
+        "low2", "low3"]
+    assert all(r["by"] == "high" and r["node"] == "n0" for r in sim.preempted)
+
+
+def test_freed_capacity_used_by_later_pods():
+    """After an eviction, later pods in the same batch schedule into the freed
+    space — the serial interleaving the reference's queue produces."""
+    nodes = [make_node("n0", cpu="4")]
+    lows = [prio_pod(f"low{i}", 0) for i in range(4)]
+    high = prio_pod("high", 100, cpu="4")  # evicts all four, still recorded failed
+    med = prio_pod("med", 50, cpu="2")     # schedules into the freed node
+    sim = Simulator(nodes)
+    failed = sim.schedule_pods(lows + [high, med])
+    assert [u.pod["metadata"]["name"] for u in failed] == ["high"]
+    assert names_on(sim) == ["med"]
+    assert len(sim.preempted) == 4
+
+
+def test_preemption_interleaves_with_scheduling():
+    """fail→evict→next-identical-pod-schedules alternation across wave-sized
+    groups of identical pods: each failed high pod frees exactly one slot,
+    which the NEXT high pod takes."""
+    nodes = [make_node("n0", cpu="8"), make_node("n1", cpu="8")]
+    lows = [prio_pod(f"low{i}", 0, labels={"app": "low"}) for i in range(16)]
+    highs = [prio_pod(f"high{i}", 100, labels={"app": "high"}) for i in range(4)]
+    sim = Simulator(nodes)
+    failed = sim.schedule_pods(lows + highs)
+    fail_names = [u.pod["metadata"]["name"] for u in failed]
+    assert fail_names == ["high0", "high2"]  # high1/high3 take the freed slots
+    assert len(sim.preempted) == 2
+    placed = [p for i in range(2) for p in sim.pods_on_node[i]]
+    assert sum(p["metadata"]["labels"]["app"] == "high" for p in placed) == 2
+    assert sum(p["metadata"]["labels"]["app"] == "low" for p in placed) == 14
+
+
+def test_preempt_never_policy_blocks_eviction():
+    """PodEligibleToPreemptOthers: preemptionPolicy Never ⇒ no preemption."""
+    nodes = [make_node("n0", cpu="4")]
+    lows = [prio_pod(f"low{i}", 0) for i in range(4)]
+    high = prio_pod("high", 100, cpu="2")
+    high["spec"]["preemptionPolicy"] = "Never"
+    sim = Simulator(nodes)
+    failed = sim.schedule_pods(lows + [high])
+    assert [u.pod["metadata"]["name"] for u in failed] == ["high"]
+    assert "nominatedNodeName" not in (failed[0].pod.get("status") or {})
+    assert names_on(sim) == ["low0", "low1", "low2", "low3"]
+    assert sim.preempted == []
+
+
+def test_unresolvable_nodes_are_not_candidates():
+    """nodesWherePreemptionMightHelp: a node failing on taints
+    (UnschedulableAndUnresolvable, taint_toleration.go:71) is skipped; the
+    eviction lands on the resource-full node."""
+    tainted = make_node("nA", cpu="8", taints=[
+        {"key": "dedicated", "value": "infra", "effect": "NoSchedule"}])
+    full = make_node("nB", cpu="1")
+    lows = [prio_pod("low0", 0, cpu="1")]
+    high = prio_pod("high", 100, cpu="1")
+    sim = Simulator([tainted, full])
+    failed = sim.schedule_pods(lows + [high])
+    assert [u.pod["metadata"]["name"] for u in failed] == ["high"]
+    assert failed[0].pod["status"]["nominatedNodeName"] == "nB"
+    assert [r["pod"]["metadata"]["name"] for r in sim.preempted] == ["low0"]
+
+
+def test_no_candidates_when_every_failure_is_unresolvable():
+    """All nodes fail on node affinity ⇒ preemption cannot help; nothing is
+    evicted (interpodaffinity-style unresolvable statuses keep victims safe)."""
+    nodes = [make_node("n0", cpu="1", labels={"disk": "hdd"})]
+    lows = [prio_pod("low0", 0, cpu="1")]
+    high = prio_pod("high", 100, cpu="1", node_selector={"disk": "ssd"})
+    sim = Simulator(nodes)
+    failed = sim.schedule_pods(lows + [high])
+    assert [u.pod["metadata"]["name"] for u in failed] == ["high"]
+    assert sim.preempted == []
+    assert names_on(sim) == ["low0"]
+
+
+def test_victims_are_the_lowest_priority_pods():
+    """Reprieve runs most-important-first, so the lowest-priority pod on the
+    node is the one evicted."""
+    nodes = [make_node("n0", cpu="3")]
+    a = prio_pod("a", 5)
+    b = prio_pod("b", 1)
+    c = prio_pod("c", 3)
+    high = prio_pod("high", 100, cpu="1")
+    sim = Simulator(nodes)
+    failed = sim.schedule_pods([a, b, c, high])
+    assert [u.pod["metadata"]["name"] for u in failed] == ["high"]
+    assert [r["pod"]["metadata"]["name"] for r in sim.preempted] == ["b"]
+    assert names_on(sim) == ["a", "c"]
+
+
+def test_pick_node_minimizes_highest_victim_priority():
+    """pickOneNodeForPreemption criterion 2: the node whose top victim has the
+    lower priority wins."""
+    nodes = [make_node("nA", cpu="1"), make_node("nB", cpu="1")]
+    va = prio_pod("va", 10, node_name="nA")
+    vb = prio_pod("vb", 5, node_name="nB")
+    high = prio_pod("high", 100, cpu="1")
+    sim = Simulator(nodes)
+    failed = sim.schedule_pods([va, vb, high])
+    assert [u.pod["metadata"]["name"] for u in failed] == ["high"]
+    assert [r["pod"]["metadata"]["name"] for r in sim.preempted] == ["vb"]
+    assert failed[0].pod["status"]["nominatedNodeName"] == "nB"
+
+
+def test_pdb_covered_victims_reprieved_first():
+    """selectVictimsOnNode reprieves PDB-violating victims before others, so
+    the PDB-covered pod survives and the uncovered one is evicted."""
+    nodes = [make_node("n0", cpu="2")]
+    covered = prio_pod("covered", 0, labels={"app": "db"})
+    free = prio_pod("free", 0, labels={"app": "web"})
+    high = prio_pod("high", 100, cpu="1")
+    sim = Simulator(nodes)
+    sim.model.pdbs.append({
+        "kind": "PodDisruptionBudget",
+        "metadata": {"name": "db-pdb", "namespace": "default"},
+        "spec": {"selector": {"matchLabels": {"app": "db"}}},
+        "status": {"disruptionsAllowed": 0},
+    })
+    failed = sim.schedule_pods([covered, free, high])
+    assert [u.pod["metadata"]["name"] for u in failed] == ["high"]
+    assert [r["pod"]["metadata"]["name"] for r in sim.preempted] == ["free"]
+    assert names_on(sim) == ["covered"]
+
+
+def test_pick_node_prefers_no_pdb_violations():
+    """pickOneNodeForPreemption criterion 1: a candidate whose eviction
+    violates no PDB beats one that would violate."""
+    nodes = [make_node("nA", cpu="1"), make_node("nB", cpu="1")]
+    va = prio_pod("va", 0, node_name="nA", labels={"app": "db"})
+    vb = prio_pod("vb", 0, node_name="nB", labels={"app": "web"})
+    high = prio_pod("high", 100, cpu="1")
+    sim = Simulator(nodes)
+    sim.model.pdbs.append({
+        "kind": "PodDisruptionBudget",
+        "metadata": {"name": "db-pdb", "namespace": "default"},
+        "spec": {"selector": {"matchLabels": {"app": "db"}}},
+        "status": {"disruptionsAllowed": 0},
+    })
+    failed = sim.schedule_pods([va, vb, high])
+    assert [u.pod["metadata"]["name"] for u in failed] == ["high"]
+    assert [r["pod"]["metadata"]["name"] for r in sim.preempted] == ["vb"]
+
+
+def test_preemption_across_schedule_calls():
+    """Cluster pods and app pods schedule in separate calls; a high-priority
+    app pod preempts cluster pods placed in the earlier call."""
+    nodes = [make_node("n0", cpu="2")]
+    sim = Simulator(nodes)
+    assert sim.schedule_pods([prio_pod(f"low{i}", 0) for i in range(2)]) == []
+    failed = sim.schedule_pods([prio_pod("high", 100, cpu="2")])
+    assert [u.pod["metadata"]["name"] for u in failed] == ["high"]
+    assert len(sim.preempted) == 2
+    assert names_on(sim) == []
+
+
+def test_preemption_disabled_by_scheduler_config(tmp_path):
+    """plugins.postFilter.disabled: [DefaultPreemption] turns the pass off."""
+    from open_simulator_tpu.api.schedconfig import parse_scheduler_config
+
+    cfg = tmp_path / "sched.yaml"
+    cfg.write_text(
+        "apiVersion: kubescheduler.config.k8s.io/v1beta1\n"
+        "kind: KubeSchedulerConfiguration\n"
+        "profiles:\n"
+        "- schedulerName: default-scheduler\n"
+        "  plugins:\n"
+        "    postFilter:\n"
+        "      disabled:\n"
+        "      - name: DefaultPreemption\n")
+    sc = parse_scheduler_config(str(cfg))
+    assert sc.preemption_disabled
+    nodes = [make_node("n0", cpu="2")]
+    lows = [prio_pod(f"low{i}", 0) for i in range(2)]
+    high = prio_pod("high", 100, cpu="1")
+    sim = Simulator(nodes, sched_config=sc)
+    failed = sim.schedule_pods(lows + [high])
+    assert [u.pod["metadata"]["name"] for u in failed] == ["high"]
+    assert sim.preempted == []
+    assert names_on(sim) == ["low0", "low1"]
+
+
+def test_no_lower_priority_no_attempt():
+    """A failed pod with no strictly-lower-priority pod placed anywhere never
+    preempts (uniform-priority inertness, the round-3 proof, now enforced by
+    the armed path too)."""
+    nodes = [make_node("n0", cpu="2")]
+    sim = Simulator(nodes)
+    # mixed priorities arm the pass, but the FAILING pod is the low one
+    pods = [prio_pod("high0", 100), prio_pod("high1", 100),
+            prio_pod("low", 0, cpu="2")]
+    failed = sim.schedule_pods(pods)
+    assert [u.pod["metadata"]["name"] for u in failed] == ["low"]
+    assert sim.preempted == []
+    assert names_on(sim) == ["high0", "high1"]
+
+
+def test_anti_affinity_failure_is_resolvable():
+    """A node failing only on another pod's required anti-affinity is a valid
+    candidate (Unschedulable, not UnschedulableAndUnresolvable): evicting the
+    carrier makes room."""
+    nodes = [make_node("n0", cpu="8")]
+    blocker = prio_pod("blocker", 0, labels={"app": "solo"})
+    blocker["spec"]["affinity"] = {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [{
+            "topologyKey": "kubernetes.io/hostname",
+            "labelSelector": {"matchLabels": {"app": "web"}},
+        }]}}
+    high = prio_pod("high", 100, labels={"app": "web"})
+    sim = Simulator(nodes)
+    failed = sim.schedule_pods([blocker, high])
+    assert [u.pod["metadata"]["name"] for u in failed] == ["high"]
+    assert [r["pod"]["metadata"]["name"] for r in sim.preempted] == ["blocker"]
+    assert names_on(sim) == []
