@@ -1,0 +1,29 @@
+"""simonlint: first-party static analysis for JAX/TPU hazards.
+
+The scheduling engine's parity with the vendored kube-scheduler rests on
+invariants the runtime never checks — static-vs-traced jit arguments, fixed
+scan-carry pytrees, no host syncs inside compiled paths, 32-bit dtypes at the
+device boundary. This package enforces them on every PR:
+
+    python -m open_simulator_tpu.cli lint open_simulator_tpu/
+
+See README.md ("Static analysis: simon lint") for the rule catalog and
+suppression syntax; rules live in rules.py, the driver in runner.py.
+"""
+
+from .base import RULE_REGISTRY, Finding, Rule, Severity
+from .context import ModuleContext
+from .runner import Report, analyze_file, analyze_paths, run_lint, write_bench
+
+__all__ = [
+    "RULE_REGISTRY",
+    "Finding",
+    "Rule",
+    "Severity",
+    "ModuleContext",
+    "Report",
+    "analyze_file",
+    "analyze_paths",
+    "run_lint",
+    "write_bench",
+]

@@ -1,0 +1,7 @@
+"""`python -m open_simulator_tpu.analysis [paths]` → simonlint."""
+
+import sys
+
+from .runner import run_lint
+
+sys.exit(run_lint())

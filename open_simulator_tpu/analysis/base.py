@@ -1,0 +1,128 @@
+"""simonlint core: findings, severities, the rule registry, and suppressions.
+
+The analyzer is a plain-AST pass (no imports of the analyzed code, no JAX
+dependency) so it can run in CI on a box with no accelerator and finish in
+well under the ~10s budget tracked by BENCH_ANALYSIS.json.
+
+Suppression syntax, modeled on `# type: ignore` / `# noqa`:
+
+    x = np.asarray(y)  # simonlint: ignore[host-sync-in-jit] -- reason
+
+A comment-only line suppresses the next code line instead, so multi-clause
+statements can carry the waiver above them:
+
+    # simonlint: ignore[dtype-drift] -- host-side staging buffer
+    req = requests.astype(np.float64).copy()
+
+Rule ids are kebab-case; `ignore[a,b]` lists several; the `-- reason` text is
+required by convention (CI reviewers grep for it) but not enforced.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Callable, Dict, List, Sequence
+
+
+class Severity(IntEnum):
+    """Ordering matters: findings at or above the runner's threshold fail."""
+
+    NOTE = 0
+    WARNING = 1
+    ERROR = 2
+
+    def label(self) -> str:
+        return self.name.lower()
+
+
+@dataclass
+class Finding:
+    """One diagnostic, anchored to a source position."""
+
+    rule: str
+    severity: Severity
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+
+    def human(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.severity.label()}[{self.rule}] {self.message}")
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity.label(),
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "suppressed": self.suppressed,
+        }
+
+
+@dataclass
+class Rule:
+    """A registered rule: id, default severity, and a per-module check.
+
+    `check(module_context) -> List[Finding]`; the runner owns file IO,
+    suppression filtering, and exit-code policy so rules stay pure.
+    """
+
+    id: str
+    severity: Severity
+    doc: str
+    check: Callable[["object"], List[Finding]] = field(repr=False, default=None)  # type: ignore[assignment]
+
+
+RULE_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule_id: str, severity: Severity, doc: str):
+    """Decorator: register `fn(ctx) -> List[Finding]` as a rule."""
+
+    def deco(fn: Callable) -> Callable:
+        if rule_id in RULE_REGISTRY:
+            raise ValueError(f"duplicate simonlint rule id: {rule_id}")
+        RULE_REGISTRY[rule_id] = Rule(id=rule_id, severity=severity, doc=doc, check=fn)
+        return fn
+
+    return deco
+
+
+_SUPPRESS_RE = re.compile(r"#\s*simonlint:\s*ignore\[([A-Za-z0-9_\-,\s*]+)\]")
+
+
+def suppressions_for(source_lines: Sequence[str]) -> Dict[int, frozenset]:
+    """Map 1-based line number -> suppressed rule-id set.
+
+    A trailing comment suppresses its own line; a comment-only line
+    suppresses the next line (chains of comment-only lines all bind to the
+    first code line below them). `*` suppresses every rule.
+    """
+    out: Dict[int, set] = {}
+    pending: set = set()
+    for i, raw in enumerate(source_lines, start=1):
+        m = _SUPPRESS_RE.search(raw)
+        stripped = raw.strip()
+        here = {r.strip() for r in m.group(1).split(",") if r.strip()} if m else set()
+        if stripped.startswith("#") or (not stripped and pending):
+            # comment-only waivers (and any blank lines after them) carry
+            # forward to the next code line
+            pending |= here
+            continue
+        if here or pending:
+            out.setdefault(i, set()).update(here | pending)
+            pending = set()
+    return {k: frozenset(v) for k, v in out.items()}
+
+
+def is_suppressed(finding: Finding, supp: Dict[int, frozenset]) -> bool:
+    rules = supp.get(finding.line)
+    if not rules:
+        return False
+    return finding.rule in rules or "*" in rules

@@ -1,0 +1,257 @@
+"""Per-module AST model shared by every simonlint rule.
+
+One `ModuleContext` is built per analyzed file. It answers the questions the
+JAX-hazard rules all need:
+
+  * what does this name resolve to? (import-alias canonicalization: `jnp`
+    -> `jax.numpy`, `partial` -> `functools.partial`, ...)
+  * which functions are jit roots (decorator form, `partial(jax.jit, ...)`
+    form, or the `g = jax.jit(f, static_argnames=...)` assignment form), and
+    which of their parameters are declared static?
+  * which functions are `lax.scan` / `while_loop` / `fori_loop` bodies, and
+    — transitively, via lexical nesting — which code is *traced*?
+  * which classes are NamedTuple carry contracts?
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+JIT_NAMES = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+PARTIAL_NAMES = {"functools.partial"}
+SCAN_NAMES = {"jax.lax.scan"}
+WHILE_NAMES = {"jax.lax.while_loop"}
+FORI_NAMES = {"jax.lax.fori_loop"}
+
+FuncDef = ast.FunctionDef  # async defs never appear in traced code; ignored
+
+
+@dataclass
+class JitInfo:
+    """How a function is jit-compiled and which params are static."""
+
+    static_names: Set[str] = field(default_factory=set)
+    site_line: int = 0
+
+
+@dataclass
+class ScanSite:
+    """One lax.scan/while_loop/fori_loop call and its resolved body."""
+
+    call: ast.Call
+    kind: str                      # "scan" | "while" | "fori"
+    body: Optional[FuncDef]        # None when unresolvable (lambda, import)
+    body_expr: ast.expr
+    carry_index: int               # param index of the carry in `body`
+    init: Optional[ast.expr]       # the initial-carry expression
+
+
+class ModuleContext:
+    def __init__(self, path: str, source: str, tree: Optional[ast.Module] = None):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree if tree is not None else ast.parse(source)
+        self.aliases: Dict[str, str] = {}
+        self.functions: Dict[str, List[FuncDef]] = {}
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        self.namedtuples: Dict[str, List[str]] = {}
+        self.jit: Dict[FuncDef, JitInfo] = {}
+        self.scans: List[ScanSite] = []
+        self._collect()
+
+    # ------------------------------------------------------------- resolution --
+    def resolve(self, node: ast.expr) -> Optional[str]:
+        """Canonical dotted name for a Name/Attribute chain, or None."""
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        head = self.aliases.get(cur.id, cur.id)
+        parts.append(head)
+        return ".".join(reversed(parts))
+
+    def _collect(self) -> None:
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+            elif isinstance(node, ast.FunctionDef):
+                self.functions.setdefault(node.name, []).append(node)
+            elif isinstance(node, ast.ClassDef):
+                self._maybe_namedtuple(node)
+
+        # second pass needs functions + aliases complete
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.FunctionDef):
+                self._check_jit_decorators(node)
+            elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                self._check_jit_assignment(node.value)
+            elif isinstance(node, ast.Call):
+                self._check_loop_call(node)
+
+    # ------------------------------------------------------------ namedtuples --
+    def _maybe_namedtuple(self, node: ast.ClassDef) -> None:
+        for b in node.bases:
+            r = self.resolve(b)
+            if r in ("typing.NamedTuple", "NamedTuple"):
+                fields = [
+                    s.target.id for s in node.body
+                    if isinstance(s, ast.AnnAssign) and isinstance(s.target, ast.Name)
+                ]
+                self.namedtuples[node.name] = fields
+                return
+
+    # -------------------------------------------------------------------- jit --
+    def _param_names(self, fn: FuncDef) -> List[str]:
+        a = fn.args
+        return [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+
+    def _statics_from_call(self, call: ast.Call, fn: FuncDef) -> Set[str]:
+        names: Set[str] = set()
+        params = self._param_names(fn)
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                        names.add(el.value)
+            elif kw.arg == "static_argnums":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                        if 0 <= el.value < len(params):
+                            names.add(params[el.value])
+        return names
+
+    def _mark_jit(self, fn: FuncDef, statics: Set[str], line: int) -> None:
+        info = self.jit.setdefault(fn, JitInfo())
+        info.static_names |= statics
+        info.site_line = info.site_line or line
+
+    def _check_jit_decorators(self, fn: FuncDef) -> None:
+        for dec in fn.decorator_list:
+            if self.resolve(dec) in JIT_NAMES:
+                self._mark_jit(fn, set(), dec.lineno)
+            elif isinstance(dec, ast.Call):
+                target = self.resolve(dec.func)
+                if target in JIT_NAMES:
+                    self._mark_jit(fn, self._statics_from_call(dec, fn), dec.lineno)
+                elif target in PARTIAL_NAMES and dec.args:
+                    if self.resolve(dec.args[0]) in JIT_NAMES:
+                        self._mark_jit(fn, self._statics_from_call(dec, fn), dec.lineno)
+
+    def _check_jit_assignment(self, call: ast.Call) -> None:
+        # `feasibility_jit = jax.jit(feasibility, static_argnames=(...))`
+        if self.resolve(call.func) in JIT_NAMES and call.args:
+            fn = self.lookup_function(call.args[0])
+            if fn is not None:
+                self._mark_jit(fn, self._statics_from_call(call, fn), call.lineno)
+
+    # ------------------------------------------------------------- loop bodies --
+    def lookup_function(self, expr: ast.expr) -> Optional[FuncDef]:
+        """Resolve a Name to its FunctionDef, preferring the definition whose
+        enclosing function also encloses the reference (several kernels nest
+        a local `body`/`cond`; plain name-matching would cross-wire them)."""
+        if not isinstance(expr, ast.Name):
+            return None
+        defs = self.functions.get(expr.id)
+        if not defs:
+            return None
+        if len(defs) > 1:
+            scope_chain = []
+            cur: Optional[ast.AST] = self.parents.get(expr)
+            while cur is not None:
+                scope_chain.append(cur)
+                cur = self.parents.get(cur)
+            for scope in scope_chain:  # innermost first
+                for fn in defs:
+                    if self.parents.get(fn) is scope:
+                        return fn
+        return defs[0]
+
+    def _resolve_body(self, expr: ast.expr) -> Tuple[Optional[FuncDef], int]:
+        """(function def, #positional args pre-bound by functools.partial)."""
+        fn = self.lookup_function(expr)
+        if fn is not None:
+            return fn, 0
+        if isinstance(expr, ast.Call) and self.resolve(expr.func) in PARTIAL_NAMES:
+            if expr.args:
+                inner = self.lookup_function(expr.args[0])
+                if inner is not None:
+                    return inner, len(expr.args) - 1
+        return None, 0
+
+    def _check_loop_call(self, call: ast.Call) -> None:
+        target = self.resolve(call.func)
+        if target in SCAN_NAMES and len(call.args) >= 2:
+            body, bound = self._resolve_body(call.args[0])
+            self.scans.append(ScanSite(
+                call=call, kind="scan", body=body, body_expr=call.args[0],
+                carry_index=bound, init=call.args[1]))
+        elif target in WHILE_NAMES and len(call.args) >= 3:
+            for i, kind in ((0, "while"), (1, "while")):
+                body, bound = self._resolve_body(call.args[i])
+                if body is not None:
+                    self.scans.append(ScanSite(
+                        call=call, kind=kind, body=body, body_expr=call.args[i],
+                        carry_index=bound, init=call.args[2]))
+        elif target in FORI_NAMES and len(call.args) >= 4:
+            body, bound = self._resolve_body(call.args[2])
+            if body is not None:
+                # fori body is (i, carry): carry is one past the index param
+                self.scans.append(ScanSite(
+                    call=call, kind="fori", body=body, body_expr=call.args[2],
+                    carry_index=bound + 1, init=call.args[3]))
+
+    # ---------------------------------------------------------------- tracing --
+    def traced_functions(self) -> Dict[FuncDef, Set[str]]:
+        """Every function whose body executes under a JAX trace, mapped to the
+        set of its parameters that are STATIC (concrete Python values at trace
+        time). Loop bodies and functions lexically nested inside a traced
+        function are traced with no static params."""
+        traced: Dict[FuncDef, Set[str]] = {}
+        for fn, info in self.jit.items():
+            traced[fn] = set(info.static_names)
+        for site in self.scans:
+            if site.body is not None and site.body not in traced:
+                traced[site.body] = set()
+
+        changed = True
+        while changed:
+            changed = False
+            for defs in self.functions.values():
+                for fn in defs:
+                    if fn in traced:
+                        continue
+                    anc = self.parents.get(fn)
+                    while anc is not None:
+                        if isinstance(anc, ast.FunctionDef) and anc in traced:
+                            traced[fn] = set()
+                            changed = True
+                            break
+                        anc = self.parents.get(anc)
+        return traced
+
+    def enclosing_function(self, node: ast.AST) -> Optional[FuncDef]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.FunctionDef):
+                return cur
+            cur = self.parents.get(cur)
+        return None

@@ -1,0 +1,400 @@
+"""The simonlint rule set: JAX/TPU hazards this codebase has been bitten by.
+
+Rule ids (stable — they appear in suppression comments and CI output):
+
+  host-sync-in-jit   device->host sync inside a traced function
+  recompile-trigger  static-looking jit parameter not declared static
+  dtype-drift        64-bit dtype on a TPU-targeted path
+  carry-contract     lax.scan carry without (or violating) a NamedTuple contract
+  contract-spec      malformed @shaped contract annotation
+
+Every rule is a pure function ModuleContext -> List[Finding]; file IO,
+suppressions, and exit-code policy live in runner.py.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..ops.contracts import parse_spec
+from .base import Finding, Severity, register
+from .context import ModuleContext
+
+# ----------------------------------------------------------------- helpers ----
+
+
+def _names_in(expr: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _local_walk(fn: ast.FunctionDef):
+    """Walk a function body without descending into nested defs/lambdas
+    (those are separate traced contexts with their own taint sets)."""
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _taint_set(fn: ast.FunctionDef, statics: Set[str]) -> Set[str]:
+    """Names whose values derive from TRACED arguments: the non-static
+    parameters, propagated through simple assignments / loop targets to a
+    fixpoint. Conservative in the safe direction (a tainted name may in fact
+    hold a static value; an untainted one never holds a traced one unless it
+    came from a closure, which we don't track)."""
+    a = fn.args
+    params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    tainted: Set[str] = {p for p in params if p not in statics}
+    for _ in range(10):
+        grew = False
+        for node in _local_walk(fn):
+            targets: List[ast.expr] = []
+            value: Optional[ast.AST] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AugAssign):
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.For):
+                targets, value = [node.target], node.iter
+            if value is None or not (_names_in(value) & tainted):
+                continue
+            for t in targets:
+                for name in _names_in(t):
+                    if name not in tainted:
+                        tainted.add(name)
+                        grew = True
+        if not grew:
+            break
+    return tainted
+
+
+# ---------------------------------------------------------- host-sync-in-jit --
+
+_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+_SYNC_CALLS = {"numpy.asarray", "numpy.array", "jax.device_get"}
+_SYNC_BUILTINS = {"float", "int", "bool", "print"}
+
+
+@register(
+    "host-sync-in-jit", Severity.ERROR,
+    "Device->host synchronization (.item()/np.asarray/float()/print/...) on a "
+    "traced value inside jit/pjit or a lax.scan|while_loop body. Under trace "
+    "these either raise ConcretizationTypeError at runtime or, worse, silently "
+    "pull the value at trace time and bake a stale constant into the compiled "
+    "program.",
+)
+def rule_host_sync(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fn, statics in ctx.traced_functions().items():
+        tainted = _taint_set(fn, statics)
+        for node in _local_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            hazard: Optional[str] = None
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SYNC_ATTRS
+                    and _names_in(node.func.value) & tainted):
+                hazard = f".{node.func.attr}()"
+            else:
+                target = ctx.resolve(node.func)
+                arg_names: Set[str] = set()
+                for argn in list(node.args) + [k.value for k in node.keywords]:
+                    arg_names |= _names_in(argn)
+                if target in _SYNC_CALLS and arg_names & tainted:
+                    hazard = target
+                elif (isinstance(node.func, ast.Name)
+                        and node.func.id in _SYNC_BUILTINS
+                        and node.func.id not in ctx.aliases
+                        and arg_names & tainted):
+                    hazard = f"{node.func.id}()"
+            if hazard:
+                out.append(Finding(
+                    "host-sync-in-jit", Severity.ERROR, ctx.path,
+                    node.lineno, node.col_offset,
+                    f"{hazard} on a value derived from traced arguments of "
+                    f"'{fn.name}' — forces a host sync (or a stale trace-time "
+                    f"constant) inside a compiled function",
+                ))
+    return out
+
+
+# --------------------------------------------------------- recompile-trigger --
+
+_STATICISH_ANNOTATIONS = {"int", "bool", "str", "tuple"}
+
+
+def _annotation_is_staticish(ctx: ModuleContext, ann: Optional[ast.expr]) -> Optional[str]:
+    if ann is None:
+        return None
+    base = ann.value if isinstance(ann, ast.Subscript) else ann
+    r = ctx.resolve(base)
+    if r in _STATICISH_ANNOTATIONS:
+        return r
+    if r in ("typing.Tuple", "typing.Literal"):
+        return r.split(".")[-1]
+    return None
+
+
+@register(
+    "recompile-trigger", Severity.WARNING,
+    "A jit-compiled function takes a parameter that is plainly host-side "
+    "configuration (int/bool/str/tuple annotation or scalar default) without "
+    "declaring it in static_argnums/static_argnames. Used in Python control "
+    "flow or shape arithmetic it aborts tracing; silently traced, every "
+    "structurally distinct value risks a fresh compilation.",
+)
+def rule_recompile(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fn, info in ctx.jit.items():
+        a = fn.args
+        params = list(a.posonlyargs + a.args)
+        defaults = [None] * (len(params) - len(a.defaults)) + list(a.defaults)
+        params_kw = list(a.kwonlyargs)
+        defaults_kw = list(a.kw_defaults)
+        for p, d in zip(params + params_kw, defaults + defaults_kw):
+            if p.arg in info.static_names or p.arg in ("self", "cls"):
+                continue
+            why = _annotation_is_staticish(ctx, p.annotation)
+            if why is None and isinstance(d, ast.Constant) and isinstance(
+                    d.value, (int, bool, str)) and not isinstance(d.value, float):
+                why = type(d.value).__name__
+            if why is None and isinstance(d, ast.Tuple):
+                why = "tuple"
+            if why is not None:
+                out.append(Finding(
+                    "recompile-trigger", Severity.WARNING, ctx.path,
+                    p.lineno, p.col_offset,
+                    f"parameter '{p.arg}' of jit-compiled '{fn.name}' looks "
+                    f"static ({why}) but is not in static_argnums/"
+                    f"static_argnames — declare it static or pass a device "
+                    f"array",
+                ))
+    return out
+
+
+# -------------------------------------------------------------- dtype-drift --
+
+_WIDE_DTYPES = {
+    "numpy.float64", "numpy.int64", "numpy.uint64", "numpy.longdouble",
+    "jax.numpy.float64", "jax.numpy.int64", "jax.numpy.uint64",
+}
+_WIDE_STRS = {"float64", "int64", "uint64"}
+_ARRAY_FACTORIES = {
+    "array", "asarray", "zeros", "ones", "full", "empty", "arange",
+    "fromiter", "astype", "frombuffer", "linspace",
+}
+
+
+@register(
+    "dtype-drift", Severity.WARNING,
+    "64-bit dtype (float64/int64) referenced on a TPU-targeted module. JAX "
+    "runs with x64 disabled: the value is silently downcast when it crosses "
+    "the device boundary, so 64-bit staging is only sound host-side — "
+    "whitelist intentional host buffers with "
+    "`# simonlint: ignore[dtype-drift] -- <why>`.",
+)
+def rule_dtype_drift(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Attribute):
+            r = ctx.resolve(node)
+            if r in _WIDE_DTYPES:
+                out.append(Finding(
+                    "dtype-drift", Severity.WARNING, ctx.path,
+                    node.lineno, node.col_offset,
+                    f"{r.split('.')[-1]} staging ({r}): 64-bit values are "
+                    f"downcast at the device boundary (JAX x64 is off) — keep "
+                    f"host-side and whitelist, or use an explicit 32-bit dtype",
+                ))
+        elif isinstance(node, ast.Call):
+            fname = (node.func.attr if isinstance(node.func, ast.Attribute)
+                     else node.func.id if isinstance(node.func, ast.Name) else "")
+            if fname not in _ARRAY_FACTORIES:
+                continue
+            for cand in list(node.args) + [k.value for k in node.keywords
+                                           if k.arg in (None, "dtype")]:
+                if isinstance(cand, ast.Constant) and cand.value in _WIDE_STRS:
+                    out.append(Finding(
+                        "dtype-drift", Severity.WARNING, ctx.path,
+                        cand.lineno, cand.col_offset,
+                        f'string dtype "{cand.value}" passed to {fname}(): '
+                        f"64-bit values are downcast at the device boundary — "
+                        f"use a 32-bit dtype or whitelist the host staging",
+                    ))
+    return out
+
+
+# ------------------------------------------------------------ carry-contract --
+
+
+def _carry_annotation(ctx: ModuleContext, body: ast.FunctionDef,
+                      carry_index: int) -> Optional[ast.arg]:
+    params = body.args.posonlyargs + body.args.args
+    if carry_index >= len(params):
+        return None
+    return params[carry_index]
+
+
+def _returned_carry_exprs(body: ast.FunctionDef) -> List[ast.expr]:
+    """First tuple element of every `return (carry, y)` in the body (local
+    scope only). A bare non-tuple return is itself taken as the carry."""
+    out: List[ast.expr] = []
+    for node in _local_walk(body):
+        if isinstance(node, ast.Return) and node.value is not None:
+            v = node.value
+            out.append(v.elts[0] if isinstance(v, ast.Tuple) and v.elts else v)
+    return out
+
+
+@register(
+    "carry-contract", Severity.ERROR,
+    "Every lax.scan body must declare its carry with a NamedTuple contract "
+    "(annotated carry parameter) and return that same contract from every "
+    "branch: a carry whose pytree structure, leaf shapes, or dtypes shift "
+    "between branches recompiles per step or fails deep inside XLA with no "
+    "source location.",
+)
+def rule_carry_contract(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for site in ctx.scans:
+        if site.kind != "scan":
+            continue
+        call_line, call_col = site.call.lineno, site.call.col_offset
+        if site.body is None:
+            out.append(Finding(
+                "carry-contract", Severity.ERROR, ctx.path, call_line, call_col,
+                "lax.scan body is not a statically resolvable function "
+                "(lambda or imported name) — declare a local body function "
+                "with a NamedTuple-annotated carry",
+            ))
+            continue
+        body = site.body
+        carry = _carry_annotation(ctx, body, site.carry_index)
+        if carry is None or carry.annotation is None:
+            out.append(Finding(
+                "carry-contract", Severity.ERROR, ctx.path,
+                body.lineno, body.col_offset,
+                f"scan body '{body.name}' has no carry contract: annotate its "
+                f"carry parameter with a NamedTuple type",
+            ))
+            continue
+        ann = carry.annotation
+        ann_name = ann.id if isinstance(ann, ast.Name) else None
+        if ann_name is None:
+            out.append(Finding(
+                "carry-contract", Severity.ERROR, ctx.path,
+                carry.lineno, carry.col_offset,
+                f"carry of scan body '{body.name}' is annotated with a "
+                f"non-NamedTuple type expression — use a NamedTuple class",
+            ))
+            continue
+        fields = ctx.namedtuples.get(ann_name)  # None => imported; trusted
+
+        # initial carry should be constructed with the same contract
+        init = site.init
+        if isinstance(init, ast.Tuple):
+            out.append(Finding(
+                "carry-contract", Severity.ERROR, ctx.path,
+                init.lineno, init.col_offset,
+                f"initial carry of lax.scan is a bare tuple but body "
+                f"'{body.name}' declares contract {ann_name} — construct "
+                f"{ann_name}(...) so the pytree structures match",
+            ))
+
+        # every return branch must yield the same contract
+        aliases_ok: Set[str] = {carry.arg}
+        for node in _local_walk(body):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                v = node.value
+                if _carry_expr_ok(ctx, v, ann_name, aliases_ok):
+                    aliases_ok.add(node.targets[0].id)
+        for rexpr in _returned_carry_exprs(body):
+            if not _carry_expr_ok(ctx, rexpr, ann_name, aliases_ok):
+                out.append(Finding(
+                    "carry-contract", Severity.ERROR, ctx.path,
+                    rexpr.lineno, rexpr.col_offset,
+                    f"scan body '{body.name}' returns a carry that is not "
+                    f"its declared contract {ann_name} on this branch",
+                ))
+            elif (isinstance(rexpr, ast.Call) and isinstance(rexpr.func, ast.Name)
+                    and rexpr.func.id == ann_name and fields is not None
+                    and rexpr.args and not rexpr.keywords
+                    and len(rexpr.args) != len(fields)):
+                out.append(Finding(
+                    "carry-contract", Severity.ERROR, ctx.path,
+                    rexpr.lineno, rexpr.col_offset,
+                    f"{ann_name}(...) constructed with {len(rexpr.args)} "
+                    f"positional leaves but the contract declares "
+                    f"{len(fields)} fields",
+                ))
+    return out
+
+
+def _carry_expr_ok(ctx: ModuleContext, expr: ast.expr, ann_name: str,
+                   aliases_ok: Set[str]) -> bool:
+    if isinstance(expr, ast.Name):
+        return expr.id in aliases_ok
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if isinstance(f, ast.Name):
+            if f.id == ann_name:
+                return True
+            if f.id in ctx.namedtuples:
+                return False  # a DIFFERENT contract constructor: the exact bug
+            return True  # unknown callable — can't verify statically, trust it
+        if isinstance(f, ast.Attribute) and f.attr == "_replace":
+            return bool(_names_in(f.value) & aliases_ok) or isinstance(f.value, ast.Call)
+    return False
+
+
+# -------------------------------------------------------------- contract-spec --
+
+
+@register(
+    "contract-spec", Severity.ERROR,
+    "An @shaped(...) kernel contract names a parameter the function does not "
+    "have, or a spec string that does not parse ('[DIMS] dtype', e.g. "
+    "'[N, R] f32'). Broken contracts are worse than none: simonlint and "
+    "readers both trust them.",
+)
+def rule_contract_spec(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for defs in ctx.functions.values():
+        for fn in defs:
+            for dec in fn.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                r = ctx.resolve(dec.func) or ""
+                if not (r == "shaped" or r.endswith(".shaped")):
+                    continue
+                a = fn.args
+                params = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+                for kw in dec.keywords:
+                    if kw.arg is None:
+                        continue
+                    if kw.arg not in params and kw.arg not in ("ret", "returns"):
+                        out.append(Finding(
+                            "contract-spec", Severity.ERROR, ctx.path,
+                            kw.value.lineno, kw.value.col_offset,
+                            f"@shaped names '{kw.arg}' which is not a "
+                            f"parameter of '{fn.name}'",
+                        ))
+                        continue
+                    if isinstance(kw.value, ast.Constant) and isinstance(
+                            kw.value.value, str):
+                        try:
+                            parse_spec(kw.value.value)
+                        except ValueError as e:
+                            out.append(Finding(
+                                "contract-spec", Severity.ERROR, ctx.path,
+                                kw.value.lineno, kw.value.col_offset,
+                                f"@shaped spec for '{kw.arg}' does not parse: {e}",
+                            ))
+    return out

@@ -1,0 +1,197 @@
+"""simonlint driver: file walking, suppression filtering, output, exit policy.
+
+Entry points:
+  * ``python -m open_simulator_tpu.cli lint [paths]``  (cli/main.py)
+  * ``python -m open_simulator_tpu.analysis [paths]``  (__main__.py)
+  * ``tools/run_analysis.py``                          (CI + bench record)
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from . import rules as _rules  # noqa: F401  (imported for rule registration)
+from .base import RULE_REGISTRY, Finding, Severity, is_suppressed, suppressions_for
+from .context import ModuleContext
+
+
+@dataclass
+class FileResult:
+    path: str
+    findings: List[Finding] = field(default_factory=list)
+    error: Optional[str] = None  # syntax/read error, reported as its own finding
+
+
+@dataclass
+class Report:
+    files: List[FileResult]
+    elapsed_s: float
+    selected_rules: List[str]
+
+    @property
+    def findings(self) -> List[Finding]:
+        return [f for fr in self.files for f in fr.findings]
+
+    def active(self, threshold: Severity = Severity.WARNING) -> List[Finding]:
+        return [f for f in self.findings
+                if not f.suppressed and f.severity >= threshold]
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {r: 0 for r in sorted(RULE_REGISTRY)}
+        for f in self.findings:
+            if not f.suppressed:
+                out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def suppressed_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            if f.suppressed:
+                out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                out.extend(os.path.join(root, n) for n in sorted(names)
+                           if n.endswith(".py"))
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+def analyze_file(path: str, select: Optional[Sequence[str]] = None) -> FileResult:
+    fr = FileResult(path=path)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError, ValueError) as e:
+        fr.error = str(e)
+        fr.findings.append(Finding(
+            "parse-error", Severity.ERROR, path,
+            getattr(e, "lineno", 1) or 1, 0, f"cannot analyze: {e}"))
+        return fr
+
+    ctx = ModuleContext(path, source, tree)
+    supp = suppressions_for(ctx.lines)
+    for rule_id, rule in sorted(RULE_REGISTRY.items()):
+        if select and rule_id not in select:
+            continue
+        for f in rule.check(ctx):
+            f.severity = rule.severity
+            f.suppressed = is_suppressed(f, supp)
+            fr.findings.append(f)
+    fr.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return fr
+
+
+def analyze_paths(paths: Sequence[str],
+                  select: Optional[Sequence[str]] = None) -> Report:
+    t0 = time.perf_counter()
+    files = [analyze_file(p, select) for p in iter_python_files(paths)]
+    return Report(
+        files=files,
+        elapsed_s=time.perf_counter() - t0,
+        selected_rules=sorted(select) if select else sorted(RULE_REGISTRY),
+    )
+
+
+def format_human(report: Report, show_suppressed: bool = False) -> str:
+    lines: List[str] = []
+    for f in report.findings:
+        if f.suppressed and not show_suppressed:
+            continue
+        tag = "  (suppressed)" if f.suppressed else ""
+        lines.append(f.human() + tag)
+    counts = report.counts()
+    total = sum(counts.values())
+    supp_total = sum(report.suppressed_counts().values())
+    per_rule = ", ".join(f"{k}={v}" for k, v in counts.items() if v)
+    lines.append(
+        f"simonlint: {total} finding(s) ({per_rule or 'none'}), "
+        f"{supp_total} suppressed, {len(report.files)} file(s) "
+        f"in {report.elapsed_s:.2f}s")
+    return "\n".join(lines)
+
+
+def format_json(report: Report) -> str:
+    return json.dumps({
+        "findings": [f.to_json() for f in report.findings],
+        "counts": report.counts(),
+        "suppressed": report.suppressed_counts(),
+        "files": len(report.files),
+        "elapsed_s": round(report.elapsed_s, 4),
+        "rules": report.selected_rules,
+    }, indent=2)
+
+
+def run_lint(argv: Optional[Sequence[str]] = None) -> int:
+    """The `simon lint` command. Exit 0 = clean (modulo suppressions)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="simon lint",
+        description="simonlint: JAX/TPU-hazard static analysis "
+                    "(rules: %s)" % ", ".join(sorted(RULE_REGISTRY)),
+    )
+    parser.add_argument("paths", nargs="*", default=["open_simulator_tpu"],
+                        help="files or directories (default: open_simulator_tpu)")
+    parser.add_argument("--format", choices=("human", "json"), default="human")
+    parser.add_argument("--select", default="",
+                        help="comma-separated rule ids to run (default: all)")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print suppressed findings")
+    parser.add_argument("--fail-on", choices=("note", "warning", "error", "never"),
+                        default="warning",
+                        help="lowest severity that fails the build")
+    parser.add_argument("--bench-out", default="", metavar="FILE",
+                        help="also write a BENCH_ANALYSIS.json-style record")
+    args = parser.parse_args(list(argv) if argv is not None else None)
+
+    select = [s.strip() for s in args.select.split(",") if s.strip()] or None
+    if select:
+        unknown = [s for s in select if s not in RULE_REGISTRY]
+        if unknown:
+            parser.error(f"unknown rule id(s): {', '.join(unknown)}")
+    report = analyze_paths(args.paths or ["open_simulator_tpu"], select)
+
+    print(format_json(report) if args.format == "json"
+          else format_human(report, args.show_suppressed))
+
+    if args.bench_out:
+        write_bench(report, args.bench_out)
+    if args.fail_on == "never":
+        return 0
+    threshold = {"note": Severity.NOTE, "warning": Severity.WARNING,
+                 "error": Severity.ERROR}[args.fail_on]
+    return 1 if report.active(threshold) else 0
+
+
+def write_bench(report: Report, path: str) -> None:
+    """Record analyzer wall time + finding counts so future PRs can assert the
+    pass stays fast (budget: <10s on the full tree) and watch finding drift."""
+    rec = {
+        "tool": "simonlint",
+        "files": len(report.files),
+        "elapsed_s": round(report.elapsed_s, 4),
+        "budget_s": 10.0,
+        "within_budget": report.elapsed_s < 10.0,
+        "counts_unsuppressed": report.counts(),
+        "counts_suppressed": report.suppressed_counts(),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(rec, fh, indent=2)
+        fh.write("\n")

@@ -1,0 +1,9 @@
+"""`python -m open_simulator_tpu.cli` → the simon CLI (same as the package
+entry point; exists so scripted invocations can bypass the top-level
+__main__'s import of the full package surface)."""
+
+import sys
+
+from .main import main
+
+sys.exit(main(sys.argv[1:]))

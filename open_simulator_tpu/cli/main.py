@@ -89,6 +89,12 @@ def build_parser() -> argparse.ArgumentParser:
     p_parity.add_argument("-v", "--verbose", action="store_true",
                           help="list disagreeing placements")
 
+    p_lint = sub.add_parser(
+        "lint", add_help=False,
+        help="Run simonlint, the JAX/TPU-hazard static analyzer, over the "
+             "given paths (default: the open_simulator_tpu package)")
+    p_lint.add_argument("lint_args", nargs=argparse.REMAINDER)
+
     p_server = sub.add_parser("server", help="Start a HTTP server that simulates "
                                              "deploy/scale requests against a live cluster")
     p_server.add_argument("--kubeconfig", default="", help="path of the kubeconfig file")
@@ -146,6 +152,14 @@ def cmd_apply(args) -> int:
     return 0 if result is not None else 1
 
 
+def cmd_lint(args) -> int:
+    """simonlint — static analysis of JAX/TPU hazards (analysis/runner.py).
+    Normally short-circuited in main(); this handles parse_args callers."""
+    from ..analysis.runner import run_lint
+
+    return run_lint(args.lint_args)
+
+
 def cmd_server(args) -> int:
     from ..server.http import Server
     from ..utils.devices import ensure_responsive_backend
@@ -199,12 +213,21 @@ def cmd_gen_doc(args) -> int:
 
 def main(argv: Optional[List[str]] = None) -> int:
     _init_logging()
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["lint"]:
+        # Dispatch before argparse: REMAINDER would reject flags placed ahead
+        # of the first path (`simon lint --format json pkg/`), and run_lint
+        # owns its own --help.
+        from ..analysis.runner import run_lint
+
+        return run_lint(argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
     from ..parity import cmd_parity
 
     handlers = {
         "apply": cmd_apply,
+        "lint": cmd_lint,
         "server": cmd_server,
         "version": cmd_version,
         "gen-doc": cmd_gen_doc,

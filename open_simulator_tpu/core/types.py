@@ -9,7 +9,7 @@ from YAML (the k8s JSON shape), not generated client types. Accessors in
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 
 @dataclass

@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import copy
 import random
-import string
 from typing import List, Optional
 
 from ..core import constants as C

@@ -21,7 +21,7 @@ from __future__ import annotations
 import copy
 import itertools
 import json
-from typing import List, Optional
+from typing import List
 
 from ..core import constants as C
 from ..utils.objutil import (

@@ -32,8 +32,8 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
+from .contracts import shaped
 from .resources import CPU_I, MEM_I
 
 class ScoreWeights(NamedTuple):
@@ -148,10 +148,22 @@ class Carry(NamedTuple):
     sdev_alloc: jax.Array   # [N, MAXSD] f32: 1.0 = exclusive device allocated
 
 
+class SerialState(NamedTuple):
+    """Scan-carry contract for schedule_group_serial's fused step: the ONLY
+    state a single-group serial run can mutate. Leaf shapes/dtypes are fixed
+    for the whole scan — simonlint's carry-contract rule holds every branch
+    of the body to this declaration."""
+
+    j: jax.Array       # [N] i32: per-node copies placed so far
+    cnt: jax.Array     # [Sd, D+1] f32: live DoNotSchedule counter rows
+    cnt_sa: jax.Array  # [Ss, D+1] f32: live ScheduleAnyway counter rows
+
+
 def _flr(x):
     return jnp.floor(x)
 
 
+@shaped(pernode="[N] f32", F="[N] bool", zones="[N] i32", ret="[N] f32")
 def selector_spread_score(pernode, F, zones, Z: int, maxN=None):
     """SelectorSpread (selector_spread.go:104-160): per-node count score with
     2/3 zone blending, over the feasible set F. THE single source of this
@@ -171,6 +183,8 @@ def selector_spread_score(pernode, F, zones, Z: int, maxN=None):
                      node_score * (1.0 / 3.0) + zscore * (2.0 / 3.0), node_score)
 
 
+@shaped(cnt_sa="[Ss, N] f32", relevantF="[N] bool", dom_rows="[Ss, N] i32",
+        svalid="[Ss] bool", maxskew="[Ss] f32", ret="[N] f32")
 def schedule_anyway_score(cnt_sa, relevantF, dom_rows, svalid, maxskew, D: int):
     """PodTopologySpread ScheduleAnyway scoring (scoring.go:108-200) from the
     per-term per-node counts: ln(topology size + 2) weights, maxSkew - 1
@@ -213,6 +227,7 @@ def counter_rows_at(tb: Tables, cry: Carry, ids):
     return rows, jnp.take_along_axis(rows, dom, axis=1), dom < D, dom
 
 
+@shaped(g="[] i32", ret="[N] f32")
 def interpod_raw(tb: Tables, cry: Carry, g):
     """InterPodAffinity raw score (scoring.go): incoming preferred terms plus
     existing pods' required (HardPodAffinityWeight=1) and preferred terms,
@@ -249,6 +264,7 @@ def least_balanced(used_c, used_m, a_c, a_m):
     return least, balanced
 
 
+@shaped(g="[] i32")
 def storage_alloc(tb: Tables, cry: Carry, g):
     """Simulate Open-Local allocation of group g's volumes on EVERY node at once.
 
@@ -353,6 +369,7 @@ def storage_alloc(tb: Tables, cry: Carry, g):
     }
 
 
+@shaped(g="[] i32", forced="[] i32", valid="[] bool")
 def feasibility(
     tb: Tables, cry: Carry, g, forced, valid,
     enable_gpu: bool = True, enable_storage: bool = True,
@@ -494,6 +511,7 @@ def feasibility(
     return feasible, stages
 
 
+@shaped(g="[] i32", feasible="[N] bool", ret="[N] f32")
 def scores(
     tb: Tables, cry: Carry, g, feasible, n_zones: int, enable_storage: bool = True,
     w: ScoreWeights = DEFAULT_WEIGHTS,
@@ -598,6 +616,7 @@ def scores(
     return total
 
 
+@shaped(g="[] i32", choice="[] i32", do="[] bool")
 def commit(
     tb: Tables, cry: Carry, g, choice, do,
     enable_gpu: bool = True, enable_storage: bool = True,
@@ -799,6 +818,7 @@ def _wave_score_table(tb: Tables, cry: Carry, st: dict, norms, g, j,
     return w.least * least + w.balanced * balanced + static_n[:, None]
 
 
+@shaped(g="[] i32", cap1="[] bool", ret="[N] i32")
 def _wave_capacity(tb: Tables, cry: Carry, g, cap1):
     """[N] i32: how many MORE copies of group g each node can take, from the
     closed-form NodeResourcesFit bound (same eps slack as feasibility())."""
@@ -936,6 +956,7 @@ def _wave_candidates(tb: Tables, cry: Carry, st: dict, g, j, avail, F,
 
 
 @partial(jax.jit, static_argnames=("gpu_live", "w", "filters", "block"))
+@shaped(g="[] i32", m="[] i32", cap1="[] bool")
 def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
                   w: ScoreWeights = DEFAULT_WEIGHTS,
                   filters: FilterFlags = DEFAULT_FILTERS,
@@ -1019,6 +1040,7 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
 
 
 @partial(jax.jit, static_argnames=("w", "filters", "block"))
+@shaped(g="[] i32", m="[] i32", cap1="[] bool")
 def schedule_spread_wave(tb: Tables, cry: Carry, g, m, cap1,
                          w: ScoreWeights = DEFAULT_WEIGHTS,
                          filters: FilterFlags = DEFAULT_FILTERS,
@@ -1225,6 +1247,7 @@ def schedule_spread_wave(tb: Tables, cry: Carry, g, m, cap1,
 
 
 @partial(jax.jit, static_argnames=("w", "filters", "ss_live", "sa_live", "n_zones"))
+@shaped(g="[] i32", valid="[P] bool", cap1="[] bool")
 def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
                           w: ScoreWeights = DEFAULT_WEIGHTS,
                           filters: FilterFlags = DEFAULT_FILTERS,
@@ -1326,7 +1349,7 @@ def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
     else:
         lb_table = None
 
-    def step(state, ok):
+    def step(state: SerialState, ok):
         j, cnt, cnt_sa = state
         # live DoNotSchedule filter, mirroring feasibility() term for term
         cnt_at = jnp.take_along_axis(cnt, dom_rows, axis=1)           # [Sd, N]
@@ -1377,15 +1400,17 @@ def schedule_group_serial(tb: Tables, cry: Carry, g, valid, cap1,
             sa_dom_c = sa_dom_rows[:, choice]
             cnt_sa = cnt_sa.at[jnp.arange(Ss), sa_dom_c].add(
                 sa_match * (sa_dom_c < D) * do)
-        return (j, cnt, cnt_sa), do
+        return SerialState(j, cnt, cnt_sa), do
 
-    (j, _, _), dos = jax.lax.scan(
-        step, (jnp.zeros(N, jnp.int32), cnt0, cnt_sa0), valid)
+    final_state, dos = jax.lax.scan(
+        step, SerialState(jnp.zeros(N, jnp.int32), cnt0, cnt_sa0), valid)
+    j = final_state.j
     placed = jnp.sum(dos)
     return _aggregate_commit(tb, cry, g, j, False), j, placed
 
 
 @partial(jax.jit, static_argnames=("n_zones", "enable_gpu", "enable_storage", "w", "filters"))
+@shaped(pod_group="[P] i32", forced_node="[P] i32", valid="[P] bool")
 def schedule_batch(
     tb: Tables, cry: Carry, pod_group, forced_node, valid, n_zones: int,
     enable_gpu: bool = True, enable_storage: bool = True,
@@ -1393,7 +1418,7 @@ def schedule_batch(
 ):
     """Scan the whole batch; returns (final carry, placements[P] int32, -1=unschedulable)."""
 
-    def body(c, xs):
+    def body(c: Carry, xs):
         return _step(tb, c, xs, n_zones, enable_gpu, enable_storage, w, filters)
 
     final, choices = jax.lax.scan(body, cry, (pod_group, forced_node, valid))

@@ -14,6 +14,13 @@ from typing import Dict, Iterable, List
 import numpy as np
 
 from ..utils.objutil import CPU, EPHEMERAL, MEMORY, PODS, node_allocatable, pod_resource_requests
+from .contracts import shaped
+
+# DEVICE-BOUNDARY NOTE: every vector built here is float64 ON PURPOSE — k8s
+# memory quantities (e.g. 16Ti = 2**44 bytes) lose integer precision in f32,
+# so staging/accumulation stays 64-bit on the host. The encoder owns the one
+# sanctioned narrowing to f32 when rows enter the device tables; each f64
+# allocation below carries a simonlint dtype-drift waiver pointing here.
 
 # NonZero defaults (vendored util/non_zero.go:34-37): used by LeastAllocated /
 # BalancedAllocation scoring only, never by the Fit filter.
@@ -51,16 +58,18 @@ class ResourceAxis:
     def R(self) -> int:
         return len(self.names)
 
+    @shaped(ret="[R] f64")
     def node_vector(self, node: dict) -> np.ndarray:
         """Allocatable as a dense row (absent resources = 0)."""
-        v = np.zeros(self.R, np.float64)
+        v = np.zeros(self.R, np.float64)  # simonlint: ignore[dtype-drift] -- host staging, see device-boundary note
         for k, q in node_allocatable(node).items():
             v[self.index[k]] = q
         return v
 
+    @shaped(ret="[R] f64")
     def pod_vector(self, pod: dict) -> np.ndarray:
         """Pod request row; the pods-count column is always 1 (one scheduling slot)."""
-        v = np.zeros(self.R, np.float64)
+        v = np.zeros(self.R, np.float64)  # simonlint: ignore[dtype-drift] -- host staging, see device-boundary note
         for k, q in pod_resource_requests(pod).items():
             if k in self.index:
                 v[self.index[k]] = q
@@ -70,6 +79,7 @@ class ResourceAxis:
         return v
 
 
+@shaped(ret="[2] f64")
 def pod_nonzero_cpu_mem(pod: dict) -> np.ndarray:
     """Scoring-side request: per-container max(request, default) summed, init containers
     taken as a per-resource max — the NonZeroRequested accumulation of the vendored
@@ -88,7 +98,7 @@ def pod_nonzero_cpu_mem(pod: dict) -> np.ndarray:
         imem = max(parse_quantity(req["memory"]), DEFAULT_MEMORY) if "memory" in req else DEFAULT_MEMORY
         cpu = max(cpu, icpu)
         mem = max(mem, imem)
-    return np.array([cpu, mem], np.float64)
+    return np.array([cpu, mem], np.float64)  # simonlint: ignore[dtype-drift] -- host staging, see device-boundary note
 
 
 def pod_has_unknown_resource(pod: dict, axis: ResourceAxis) -> bool:

@@ -28,7 +28,6 @@ DaemonSet pods pinned via matchFields metadata.name affinity are detected and en
 from __future__ import annotations
 
 import json
-import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -36,8 +35,6 @@ import numpy as np
 
 from ..core import constants as C
 from ..ops.resources import (
-    CPU_I,
-    MEM_I,
     PODS_I,
     ResourceAxis,
     pod_has_unknown_resource,
@@ -54,7 +51,6 @@ from ..utils.objutil import (
     pod_resource_requests,
     toleration_tolerates_taint,
 )
-from ..utils.quantity import parse_quantity
 
 # ----------------------------------------------------------------- node arrays --------
 
@@ -707,7 +703,7 @@ class Encoder:
         resources of req/(alloc-req); Share() semantics at alloc-req == 0. Pods with no
         requests score MaxNodeScore on every node (→ constant → normalizes to 0)."""
         alloc = self.na.alloc  # [N, R]
-        req = requests.astype(np.float64).copy()
+        req = requests.astype(np.float64).copy()  # simonlint: ignore[dtype-drift] -- host-side Share() math; result narrows to f32 below
         req[PODS_I] = 0.0  # the synthetic pods-slot is not a PodRequestsAndLimits entry
         if not req.any():
             return np.ones(self.na.N, np.float32)
@@ -1187,7 +1183,7 @@ def build_batch_tables(
     seed_counter = np.zeros((T, D + 1), np.float32)
     seed_carrier = np.zeros((Tc, D + 1), np.float32)
     for pg in placed.values():
-        nis = np.fromiter(pg.node_counts.keys(), np.int64, len(pg.node_counts))
+        nis = np.fromiter(pg.node_counts.keys(), np.int64, len(pg.node_counts))  # simonlint: ignore[dtype-drift] -- host-side fancy index, never shipped to device
         cnts = np.fromiter(pg.node_counts.values(), np.float32, len(pg.node_counts))
         # node keys are unique per group, so fancy-index += never drops adds;
         # count-scaled vectors match the wave kernel's aggregate commit math

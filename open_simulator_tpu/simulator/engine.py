@@ -533,7 +533,7 @@ class Simulator:
         self._last_flags = (enable_gpu, enable_storage)
         jnp = _jax()
         P = len(to_schedule)
-        choices = np.full(P, -1, np.int64)
+        choices = np.full(P, -1, np.int32)  # node indices; matches the kernels' i32 outputs
         segs = self._segments(bt, P) if self.use_waves else [("serial", 0, P)]
         # Dispatch every segment asynchronously and fetch ONE concatenated
         # result at the end: the chip may sit behind a tunnel, so a per-segment
@@ -753,13 +753,21 @@ class Simulator:
         """Aggregate used/allocatable totals after a probe_pods run, read from
         the device carry in one fetch — the inputs of satisfyResourceSetting
         (apply.go:689-775) without materializing node statuses. CPU in milli,
-        memory in bytes (the axis units)."""
+        memory in bytes (the axis units).
+
+        The np.asarray below is an INTENTIONAL device→host boundary — the one
+        sanctioned sync of this probe path (audited for PR1): it runs outside
+        any jit trace, after the scan pipeline has been dispatched, so it
+        costs exactly one round trip and can never bake a constant into a
+        compiled program. The f64 widening is host-side on purpose: summing
+        byte-quantities across thousands of nodes overflows f32 precision."""
         from ..ops.resources import CPU_I, MEM_I
 
         N = self.na.N
         if self._last_carry is None:
-            used = np.zeros((N, self.axis.R), np.float64)
+            used = np.zeros((N, self.axis.R), np.float64)  # simonlint: ignore[dtype-drift] -- host-side accumulator, see docstring
         else:
+            # simonlint: ignore[dtype-drift] -- host-side accumulator, see docstring
             used = np.asarray(self._last_carry.requested)[:N].astype(np.float64)
         alloc = self.na.alloc
         return {

@@ -15,7 +15,7 @@ import logging
 import threading
 import time
 from collections import deque
-from typing import Deque, List, Optional, Tuple
+from typing import Deque, List, Tuple
 
 log = logging.getLogger("open_simulator_tpu.trace")
 

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Iterable, List, Optional
+from typing import Iterable, List
 
 import yaml
 

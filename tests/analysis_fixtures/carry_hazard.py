@@ -1,0 +1,59 @@
+"""simonlint fixture: carry-contract hazards. NEVER imported — AST only."""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GoodCarry(NamedTuple):
+    total: jax.Array
+    count: jax.Array
+
+
+class OtherCarry(NamedTuple):
+    total: jax.Array
+
+
+def unannotated(xs):
+    def body(carry, x):  # FINDING: carry has no contract annotation
+        return carry + x, x
+
+    return jax.lax.scan(body, jnp.float32(0.0), xs)
+
+
+def tuple_init(xs):
+    def body(carry: GoodCarry, x):
+        return GoodCarry(carry.total + x, carry.count + 1), x
+
+    # FINDING: bare-tuple init vs declared GoodCarry contract
+    return jax.lax.scan(body, (jnp.float32(0.0), jnp.int32(0)), xs)
+
+
+def branch_drift(xs):
+    def body(carry: GoodCarry, x):
+        if True:  # pragma: no cover - fixture
+            return OtherCarry(carry.total + x), x  # FINDING: wrong contract
+        return GoodCarry(carry.total, carry.count), x
+
+    return jax.lax.scan(body, GoodCarry(jnp.float32(0.0), jnp.int32(0)), xs)
+
+
+def arity_drift(xs):
+    def body(carry: GoodCarry, x):
+        return GoodCarry(carry.total + x), x  # FINDING: 1 leaf vs 2 fields
+
+    return jax.lax.scan(body, GoodCarry(jnp.float32(0.0), jnp.int32(0)), xs)
+
+
+def lambda_body(xs):
+    # FINDING: unresolvable body
+    return jax.lax.scan(lambda c, x: (c + x, x), jnp.float32(0.0), xs)
+
+
+def clean(xs):
+    def body(carry: GoodCarry, x):
+        nxt = GoodCarry(carry.total + x, carry.count + 1)
+        return nxt, carry.total
+
+    return jax.lax.scan(body, GoodCarry(jnp.float32(0.0), jnp.int32(0)), xs)

@@ -1,0 +1,20 @@
+"""simonlint fixture: a module with no findings (negative control)."""
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RunningSum(NamedTuple):
+    acc: jax.Array
+
+
+@partial(jax.jit, static_argnames=("scale",))
+def scaled_sum(xs, scale: int = 1):
+    def body(carry: RunningSum, x):
+        return RunningSum(carry.acc + x * scale), x
+
+    final, _ = jax.lax.scan(body, RunningSum(jnp.float32(0.0)), xs)
+    return final.acc

@@ -1,0 +1,23 @@
+"""simonlint fixture: contract-spec hazards. NEVER imported — AST only."""
+
+from open_simulator_tpu.ops.contracts import shaped
+
+
+@shaped(vec="[N] f32", ret="[N] f32")
+def clean_kernel(vec):
+    return vec
+
+
+@shaped(nope="[N] f32")  # FINDING: 'nope' is not a parameter
+def wrong_name(vec):
+    return vec
+
+
+@shaped(vec="[N] q99")  # FINDING: unknown dtype token
+def wrong_dtype(vec):
+    return vec
+
+
+@shaped(vec="N] f32")  # FINDING: unparseable spec
+def wrong_grammar(vec):
+    return vec

@@ -1,0 +1,20 @@
+"""simonlint fixture: dtype-drift hazards. NEVER imported — AST only."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def widen(rows):
+    staged = np.zeros((4, 4), np.float64)  # FINDING: attribute float64
+    ids = np.arange(10, dtype="int64")  # FINDING: string dtype
+    dev = jnp.asarray(staged)  # the silent downcast the rule exists for
+    return dev, ids
+
+
+def whitelisted(rows):
+    acc = np.zeros(8, np.float64)  # simonlint: ignore[dtype-drift] -- fixture: host accumulator
+    return acc
+
+
+def device_wide(x):
+    return jnp.zeros_like(x, dtype=jnp.int64)  # FINDING: jnp int64

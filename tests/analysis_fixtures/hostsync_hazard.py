@@ -1,0 +1,47 @@
+"""simonlint fixture: host-sync-in-jit hazards. NEVER imported — analyzed as AST only."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+
+@jax.jit
+def pulls_scalar(x):
+    total = jnp.sum(x)
+    return total.item()  # FINDING: .item() on traced value
+
+
+@partial(jax.jit, static_argnames=("flag",))
+def mixed(x, flag):
+    y = x * 2.0
+    host = np.asarray(y)  # FINDING: np.asarray on traced value
+    if flag:  # static: fine
+        print(y)  # FINDING: print on traced value
+    return host
+
+
+@jax.jit
+def casts(x):
+    n = float(x)  # FINDING: float() on traced value
+    return n
+
+
+@jax.jit
+def suppressed_pull(x):
+    return x.item()  # simonlint: ignore[host-sync-in-jit] -- fixture: tests suppression
+
+
+def scan_user(xs):
+    def body(carry, x):
+        v = carry + x
+        np.array(v)  # FINDING: host sync inside scan body
+        return v, v
+
+    return jax.lax.scan(body, jnp.float32(0.0), xs)
+
+
+def host_side_is_fine(x):
+    # not traced: no findings here
+    arr = np.asarray(x)
+    return float(arr.sum())

@@ -1,0 +1,36 @@
+"""simonlint fixture: recompile-trigger hazards. NEVER imported — AST only."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def scalar_config(x, n_buckets: int, debug: bool = False):
+    # FINDING x2: n_buckets and debug look static but are traced
+    return jnp.reshape(x, (n_buckets, -1))
+
+
+@partial(jax.jit, static_argnames=("n_buckets", "debug"))
+def scalar_config_ok(x, n_buckets: int, debug: bool = False):
+    # clean: both declared static
+    return jnp.reshape(x, (n_buckets, -1))
+
+
+@jax.jit
+def tuple_default(x, shape=(8, 8)):
+    # FINDING: tuple default not declared static
+    return jnp.broadcast_to(x, shape)
+
+
+def _impl(x, mode: str):
+    return x
+
+
+def _impl_ok(x, mode: str):
+    return x
+
+
+jitted_impl = jax.jit(_impl)  # FINDING on `mode`: call-form jit, str param not static
+jitted_impl_ok = jax.jit(_impl_ok, static_argnums=(1,))  # clean

@@ -1,0 +1,235 @@
+"""simonlint analyzer tests: every rule family fires on its fixture, every
+suppression suppresses, the real package stays clean, and the @shaped
+contract layer validates what it promises."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+import open_simulator_tpu
+from open_simulator_tpu.analysis import (
+    RULE_REGISTRY,
+    Severity,
+    analyze_file,
+    analyze_paths,
+)
+from open_simulator_tpu.analysis.base import suppressions_for
+from open_simulator_tpu.analysis.runner import run_lint
+from open_simulator_tpu.ops import contracts, kernels
+
+FIXTURES = Path(__file__).parent / "analysis_fixtures"
+PACKAGE = Path(open_simulator_tpu.__file__).parent
+
+
+def _counts(path, rule, suppressed=False):
+    fr = analyze_file(str(FIXTURES / path))
+    assert fr.error is None
+    return sum(1 for f in fr.findings
+               if f.rule == rule and f.suppressed == suppressed)
+
+
+# ------------------------------------------------------------ rule families --
+
+
+def test_host_sync_rule_fires():
+    assert _counts("hostsync_hazard.py", "host-sync-in-jit") == 5
+    # the .item() in suppressed_pull carries a waiver
+    assert _counts("hostsync_hazard.py", "host-sync-in-jit", suppressed=True) == 1
+
+
+def test_host_sync_spares_host_code():
+    fr = analyze_file(str(FIXTURES / "hostsync_hazard.py"))
+    # host_side_is_fine() uses np.asarray/float outside any traced context
+    assert not any(f.rule == "host-sync-in-jit" and f.line > 44 for f in fr.findings)
+
+
+def test_recompile_rule_fires():
+    fr = analyze_file(str(FIXTURES / "recompile_hazard.py"))
+    hits = [f for f in fr.findings if f.rule == "recompile-trigger"]
+    named = {f.message.split("'")[1] for f in hits}
+    assert named == {"n_buckets", "debug", "shape", "mode"}
+    # the static_argnames / static_argnums variants stay clean
+    assert not any("scalar_config_ok" in f.message or "_impl_ok" in f.message
+                   for f in hits)
+
+
+def test_dtype_rule_fires():
+    assert _counts("dtype_hazard.py", "dtype-drift") == 3
+    assert _counts("dtype_hazard.py", "dtype-drift", suppressed=True) == 1
+
+
+def test_carry_rule_fires():
+    fr = analyze_file(str(FIXTURES / "carry_hazard.py"))
+    msgs = [f.message for f in fr.findings if f.rule == "carry-contract"]
+    assert len(msgs) == 5
+    assert any("no carry contract" in m for m in msgs)
+    assert any("bare tuple" in m for m in msgs)
+    assert any("not its declared contract GoodCarry" in m for m in msgs)
+    assert any("1 positional leaves" in m for m in msgs)
+    assert any("not a statically resolvable function" in m for m in msgs)
+    # clean() at the bottom of the fixture produces nothing
+    assert not any(f.line > 55 for f in fr.findings)
+
+
+def test_contract_spec_rule_fires():
+    fr = analyze_file(str(FIXTURES / "contract_hazard.py"))
+    hits = [f for f in fr.findings if f.rule == "contract-spec"]
+    assert len(hits) == 3
+    assert not any(f.line < 10 for f in hits)  # clean_kernel passes
+
+
+def test_clean_module_is_clean():
+    fr = analyze_file(str(FIXTURES / "clean_module.py"))
+    assert fr.findings == []
+
+
+def test_fixture_tree_reports_all_four_families_and_fails():
+    report = analyze_paths([str(FIXTURES)])
+    fired = {f.rule for f in report.findings if not f.suppressed}
+    assert {"host-sync-in-jit", "recompile-trigger",
+            "dtype-drift", "carry-contract"} <= fired
+    assert report.active(Severity.WARNING)
+    rc = run_lint([str(FIXTURES)])
+    assert rc == 1
+
+
+# ------------------------------------------------------------- suppressions --
+
+
+def test_suppression_binds_to_own_line_and_next_line():
+    supp = suppressions_for([
+        "x = 1  # simonlint: ignore[dtype-drift]",
+        "# simonlint: ignore[carry-contract] -- why",
+        "y = 2",
+        "z = 3",
+    ])
+    assert supp[1] == frozenset({"dtype-drift"})
+    assert supp[3] == frozenset({"carry-contract"})
+    assert 4 not in supp
+
+
+def test_suppression_survives_blank_lines():
+    supp = suppressions_for([
+        "# simonlint: ignore[dtype-drift] -- why",
+        "",
+        "v = np.zeros(3, np.float64)",
+    ])
+    assert supp[3] == frozenset({"dtype-drift"})
+
+
+def test_suppression_star_and_lists():
+    supp = suppressions_for(["a = f()  # simonlint: ignore[r1, r2]"])
+    assert supp[1] == frozenset({"r1", "r2"})
+    supp = suppressions_for(["a = f()  # simonlint: ignore[*]"])
+    assert "*" in supp[1]
+
+
+# ------------------------------------------------------- the repo stays clean --
+
+
+def test_package_tree_is_lint_clean():
+    """The acceptance gate: no unsuppressed finding anywhere in the package.
+    A new hazard must be fixed or carry an explicit reasoned waiver."""
+    report = analyze_paths([str(PACKAGE)])
+    active = report.active(Severity.WARNING)
+    assert active == [], "\n".join(f.human() for f in active)
+
+
+def test_analysis_pass_is_fast():
+    report = analyze_paths([str(PACKAGE)])
+    assert report.elapsed_s < 10.0, f"lint took {report.elapsed_s:.2f}s"
+
+
+# -------------------------------------------------------------- CLI surface --
+
+
+def test_cli_lint_json_and_exit_codes(tmp_path):
+    rc = run_lint([str(FIXTURES / "clean_module.py")])
+    assert rc == 0
+    bench = tmp_path / "bench.json"
+    rc = run_lint(["--format", "json", "--bench-out", str(bench),
+                   str(FIXTURES / "dtype_hazard.py")])
+    assert rc == 1
+    rec = json.loads(bench.read_text())
+    assert rec["tool"] == "simonlint"
+    assert rec["counts_unsuppressed"]["dtype-drift"] == 3
+    assert rec["counts_suppressed"]["dtype-drift"] == 1
+    assert rec["elapsed_s"] >= 0
+
+
+def test_cli_accepts_flags_before_paths():
+    from open_simulator_tpu.cli.main import main as cli_main
+
+    rc = cli_main(["lint", "--format", "json",
+                   str(FIXTURES / "clean_module.py")])
+    assert rc == 0
+    rc = cli_main(["lint", "--select", "dtype-drift",
+                   str(FIXTURES / "dtype_hazard.py")])
+    assert rc == 1
+
+
+def test_cli_lint_select_and_unknown_rule():
+    rc = run_lint(["--select", "dtype-drift", str(FIXTURES / "carry_hazard.py")])
+    assert rc == 0  # carry hazards filtered out by --select
+    with pytest.raises(SystemExit):
+        run_lint(["--select", "no-such-rule", str(FIXTURES)])
+
+
+@pytest.mark.slow
+def test_module_entrypoint_subprocess():
+    proc = subprocess.run(
+        [sys.executable, "-m", "open_simulator_tpu.cli", "lint", str(FIXTURES)],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 1
+    assert "host-sync-in-jit" in proc.stdout
+
+
+# -------------------------------------------------------- contracts (@shaped) --
+
+
+def test_parse_spec_roundtrip():
+    spec = contracts.parse_spec("[N, R] f32")
+    assert spec.dims == ("N", "R") and spec.dtype == "f32"
+    assert contracts.parse_spec("[] bool").dims == ()
+    assert contracts.parse_spec("any").dims is None
+    assert contracts.parse_spec("[N, ...] i32").dims == ("N", "...")
+    for bad in ("f99", "[N f32", "[N] ", "[N-1] f32"):
+        with pytest.raises(ValueError):
+            contracts.parse_spec(bad)
+
+
+def test_shaped_rejects_unknown_parameter():
+    with pytest.raises(TypeError):
+        @contracts.shaped(nope="[N] f32")
+        def f(x):
+            return x
+
+
+def test_shaped_attaches_contract_and_kernels_declare_them():
+    assert contracts.contract_of(kernels.selector_spread_score)
+    assert str(contracts.contract_of(kernels.selector_spread_score)["ret"]) == "[N] f32"
+    # jit-wrapped kernels keep their contract reachable
+    assert contracts.contract_of(kernels.schedule_batch)
+    assert contracts.contract_of(kernels.schedule_wave)["cap1"].dtype == "bool"
+
+
+def test_check_args_enforces_rank_dtype_and_axis_consistency():
+    import numpy as np
+
+    @contracts.shaped(a="[N] f32", b="[N] i32")
+    def f(a, b):
+        return a
+
+    ok_a = np.zeros(4, np.float32)
+    ok_b = np.zeros(4, np.int32)
+    contracts.check_args(f, ok_a, ok_b)
+    with pytest.raises(TypeError):  # dtype mismatch
+        contracts.check_args(f, ok_a.astype(np.float64), ok_b)
+    with pytest.raises(TypeError):  # rank mismatch
+        contracts.check_args(f, ok_a.reshape(2, 2), ok_b)
+    with pytest.raises(TypeError):  # symbolic axis inconsistency
+        contracts.check_args(f, ok_a, np.zeros(5, np.int32))

@@ -1,0 +1,52 @@
+#!/usr/bin/env python3
+"""CI entry for simonlint: lint the package tree, record the bench, gate the build.
+
+    python tools/run_analysis.py                  # lint open_simulator_tpu/, update BENCH_ANALYSIS.json
+    python tools/run_analysis.py --no-bench p1 p2 # lint explicit paths, no bench record
+
+Equivalent to `python -m open_simulator_tpu.cli lint` plus the repo-root
+bench bookkeeping: BENCH_ANALYSIS.json tracks analyzer wall time (budget:
+<10s on the full tree) and per-rule finding counts so a future PR that slows
+the pass down or starts leaning on suppressions shows up in the diff."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+_VALUE_FLAGS = {"--format", "--select", "--fail-on", "--bench-out"}
+
+
+def _has_positional(args) -> bool:
+    skip = False
+    for a in args:
+        if skip:
+            skip = False
+        elif a in _VALUE_FLAGS:
+            skip = True
+        elif a.startswith("--") and "=" in a:
+            continue
+        elif not a.startswith("-"):
+            return True
+    return False
+
+
+def main(argv=None) -> int:
+    from open_simulator_tpu.analysis.runner import run_lint
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    if "--no-bench" in args:
+        args.remove("--no-bench")
+    elif "--bench-out" not in args:
+        args = ["--bench-out", os.path.join(REPO_ROOT, "BENCH_ANALYSIS.json")] + args
+    if not _has_positional(args):
+        args.append(os.path.join(REPO_ROOT, "open_simulator_tpu"))
+    return run_lint(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
