@@ -177,9 +177,9 @@ def bench_capacity_plan(n_pods=100_000, repeats=1):
             dt = time.perf_counter() - t0
             result_nodes = n if found else None
             if best is None or dt < best[0]:
-                best = (dt, result_nodes)
-        dt, added = best
-        return n_pods / dt, added, dt
+                best = (dt, result_nodes, dict(planner.stats))
+        dt, added, stats = best
+        return n_pods / dt, added, dt, stats
     finally:
         os.environ.pop("MaxCPU", None)
 
@@ -302,7 +302,7 @@ def _row_mesh8():
 
 
 def _row_capacity():
-    rate, added, dt = bench_capacity_plan()
+    rate, added, dt, stats = bench_capacity_plan()
     return {
         "metric": "capacity_plan_pods_per_sec_100k_pods",
         # a search that exhausted its node budget has no meaningful throughput
@@ -311,6 +311,14 @@ def _row_capacity():
         "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4) if added is not None else 0.0,
         "wall_s": round(dt, 3), "nodes_added": added,
         "search_exhausted": added is None,
+        # incremental-probe accounting: candidate evaluations, device
+        # dispatches, the one-time encode wall split, pod encodings (must be
+        # 1 on the incremental path), and which path ran
+        "probes": stats.get("probes"),
+        "dispatches": stats.get("dispatches"),
+        "encode_s": round(float(stats.get("encode_s") or 0.0), 3),
+        "encodes": stats.get("encodes"),
+        "search_path": stats.get("path"),
     }
 
 
@@ -403,10 +411,15 @@ def main() -> None:
     # hold the chip lock so tools/probe_tpu.py skips its attempts while the
     # bench may be running device work (two concurrent clients can wedge it).
     # A prober may be mid-probe (up to ~120s): wait it out, then proceed
-    # regardless — benching beats deadlocking on a crashed lock holder.
+    # regardless — benching beats deadlocking on a crashed lock holder. Track
+    # whether WE got the lock: past the deadline it may still belong to a live
+    # prober, and deleting a live holder's lockfile would let the next client
+    # run concurrently with its in-flight probe.
     deadline = time.time() + 180
-    while not acquire_tpu_lock(LOCK) and time.time() < deadline:
+    lock_owned = acquire_tpu_lock(LOCK)
+    while not lock_owned and time.time() < deadline:
         time.sleep(5)
+        lock_owned = acquire_tpu_lock(LOCK)
     try:
         device_ok = _probe_backend(INITIAL_PROBE_TIMEOUT, probe_log)
         for name, _, timeout, needs_device in METRICS:
@@ -440,7 +453,8 @@ def main() -> None:
             print(json.dumps(headline if name == "north_star" else row),
                   file=out, flush=True)
     finally:
-        release_tpu_lock(LOCK)
+        if lock_owned:
+            release_tpu_lock(LOCK)
         with open(os.path.join(REPO, "BENCH_DETAIL.json"), "w") as f:
             json.dump({"results": results, "probe_log": probe_log}, f, indent=1)
 

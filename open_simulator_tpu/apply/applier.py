@@ -36,6 +36,25 @@ from ..utils.yamlio import (
 )
 
 MAX_AUTO_NODES = 10_000  # auto-search upper bound before giving up
+PROBE_FANOUT = 8  # candidates per incremental-probe dispatch (one vmap lane each)
+
+
+def _grid(lo: int, hi: int, k: int) -> List[int]:
+    """Up to k evenly spaced ints covering [lo, hi], endpoints included."""
+    if hi <= lo:
+        return [max(lo, hi)]
+    if hi - lo + 1 <= k:
+        return list(range(lo, hi + 1))
+    return sorted({lo + round(i * (hi - lo) / (k - 1)) for i in range(k)})
+
+
+def _interior(lo: int, hi: int, k: int) -> List[int]:
+    """Up to k evenly spaced ints strictly inside (lo, hi)."""
+    if hi - lo <= 1:
+        return []
+    if hi - lo - 1 <= k:
+        return list(range(lo + 1, hi))
+    return sorted({lo + max(1, round(i * (hi - lo) / (k + 1))) for i in range(1, k + 1)})
 
 
 class CapacityPlanner:
@@ -68,6 +87,10 @@ class CapacityPlanner:
         self.cluster_objects = cluster_objects
         self.app_objects = app_objects or []
         self.sched_config = sched_config
+        # filled by search(): path ("incremental"/"fresh"), probes (candidate
+        # evaluations), dispatches (device round-trips), encode_s (one-time
+        # pod-encoding wall), encodes (must stay 1 on the incremental path)
+        self.stats: Dict[str, object] = {}
 
     @classmethod
     def try_build(cls, cluster: ResourceTypes, apps: List[AppResource],
@@ -151,12 +174,15 @@ class CapacityPlanner:
         return (cpu_rate <= cls._env_pct(C.EnvMaxCPU)
                 and mem_rate <= cls._env_pct(C.EnvMaxMemory))
 
-    def lower_bound(self) -> int:
+    def lower_bound(self, totals=None) -> int:
         """Smallest n passing the NECESSARY conditions: per-resource totals fit
         AND the MaxCPU/MaxMemory integer-rate envelope of
         satisfy_resource_setting holds. Any n below provably fails, so the
-        probe search starts here. Monotone in n -> binary search, no device."""
-        cpu_used, mem_used, n_pods = self._totals()
+        probe search starts here. Monotone in n -> binary search, no device.
+        `totals` overrides the (cpu_used, mem_used, n_pods) host scan — the
+        incremental session derives the same sums from its encoded groups
+        without the per-pod loop (ProbeSession.batch_totals)."""
+        cpu_used, mem_used, n_pods = totals if totals is not None else self._totals()
         base = [self._node_caps(n) for n in self.base_nodes]
         b_cpu = sum(c for c, _, _ in base)
         b_mem = sum(m for _, m, _ in base)
@@ -205,19 +231,134 @@ class CapacityPlanner:
         return ok, 0
 
     def search(self):
-        """(found, best_n, history) — doubling from the lower bound, then
-        binary refinement, all on probes. history = [(n, n_failed)] for the
-        give-up diagnostics. found=False means no-progress/max-exhausted."""
+        """(found, best_n, history) — the incremental encode-once probe session
+        when the workload qualifies (one pod encoding + device transfer for the
+        WHOLE search, candidates evaluated as multi-candidate fan-out
+        dispatches, and the final answer re-validated by one fresh-Simulator
+        probe), else the fresh-probe doubling + binary refinement.
+        history = [(n, n_failed)] for the give-up diagnostics. found=False
+        means no-progress/max-exhausted."""
+        self.stats = {"path": "fresh", "probes": 0, "dispatches": 0,
+                      "encode_s": 0.0, "encodes": 0}
+        out = self._search_incremental()
+        if out is not None:
+            return out
+        return self._search_fresh()
+
+    # ----------------------------------------------- incremental fan-out ----
+
+    def _search_incremental(self):
+        """Encode-once search over a ProbeSession, or None when the session's
+        equivalence gates reject the workload (the caller then runs the
+        fresh-probe search). The answer itself is re-validated ABOVE this
+        layer: the Applier's _plan runs one full fresh-Simulator simulation at
+        n and falls back to the reference-style full search on divergence —
+        the existing provable-equivalence guard, unchanged."""
+        from ..simulator.probe import ProbeSession
+
+        session = ProbeSession.try_build(
+            self.base_nodes, self.new_node, self.pods,
+            cluster_objects=self.cluster_objects, app_objects=self.app_objects,
+            sched_config=self.sched_config, n_new=2, fanout=PROBE_FANOUT)
+        if session is None:
+            return None
+        # the session's group encoding already holds the request totals: skip
+        # lower_bound's per-pod host scan (measurable at 100k pods)
+        lb = self.lower_bound(totals=session.batch_totals())
+        self.stats.update(path="incremental", encode_s=session.encode_s,
+                          encodes=session.encodes)
+        if lb > MAX_AUTO_NODES:
+            return False, MAX_AUTO_NODES, []
+        m = max(lb, 1)
+
+        def eval_many(cands):
+            session.ensure_capacity(max(cands))
+            res = session.probe_many(cands)
+            self.stats["probes"] += len(res)
+            self.stats["dispatches"] += 1
+            out = {}
+            for n, (scheduled, total, u) in res.items():
+                nf = total - scheduled
+                ok = nf == 0 and self._envelope_ok(
+                    u["cpu_used"], u["cpu_alloc"], u["mem_used"], u["mem_alloc"])
+                out[n] = (ok, nf)
+            return out
+
+        # The arithmetic bound is frequently EXACT (homogeneous workloads), so
+        # the first dispatch probes it alone — one lane, no fan-out waste; if
+        # it passes, minimality is already proven (everything below lb fails).
+        first = lb if lb > 0 else 0
+        res = eval_many([first])
+        ok, nf = res[first]
+        if ok:
+            return True, first, []
+        hist: List[tuple] = [(first, nf)]
+        lo_fail = first
+        hi_ok = None
+        # Doubling collapsed into fan-out rounds: round r grids (2^r m, 2^(r+1) m]
+        # with interior points, so the first passing round already brackets
+        # tightly.
+        round_lo = first + 1
+        round_hi = min(2 * m, MAX_AUTO_NODES)
+        while hi_ok is None:
+            if round_lo > round_hi:
+                return False, MAX_AUTO_NODES, hist
+            cands = _grid(round_lo, round_hi, PROBE_FANOUT)
+            res = eval_many(cands)
+            for n in cands:  # increasing; feasibility is monotone in n
+                ok, nf = res[n]
+                if ok:
+                    hi_ok = n
+                    break
+                lo_fail = max(lo_fail, n)
+                hist.append((n, nf))
+            if hi_ok is not None:
+                break
+            # 4x capacity with no progress: remaining pods unfixable by nodes
+            last_n, last_nf = hist[-1]
+            for n1, nf1 in hist:
+                if nf1 > 0 and last_n >= 4 * n1 and last_nf >= nf1:
+                    return False, last_n, hist
+            if round_hi >= MAX_AUTO_NODES:
+                return False, MAX_AUTO_NODES, hist
+            round_lo, round_hi = round_hi + 1, min(round_hi * 2, MAX_AUTO_NODES)
+        # (PROBE_FANOUT+1)-ary refinement of (lo_fail, hi_ok]
+        while hi_ok - lo_fail > 1:
+            cands = _interior(lo_fail, hi_ok, PROBE_FANOUT)
+            res = eval_many(cands)
+            for n in cands:
+                ok, _ = res[n]
+                if ok:
+                    hi_ok = n
+                    break
+                lo_fail = n
+        return True, hi_ok, hist
+
+    # ---------------------------------------------------- fresh fallback ----
+
+    def _search_fresh(self):
+        """The original fresh-Simulator probe loop: doubling from the lower
+        bound, then binary refinement — one fresh probe per candidate."""
+        for key, v in (("probes", 0), ("dispatches", 0), ("encode_s", 0.0),
+                       ("encodes", 0)):
+            self.stats.setdefault(key, v)
+        self.stats["path"] = "fresh"
+
+        def probe(n):
+            self.stats["probes"] += 1
+            self.stats["dispatches"] += 1
+            return self.probe(n)
+
         lb = self.lower_bound()
         if lb == 0:
-            ok, nf = self.probe(0)
+            ok, nf = probe(0)
             if ok:
                 return True, 0, []
             lb = 1
         hist = []
         lo, hi = max(0, lb - 1), max(lb, 1)  # everything below lb provably fails
         while hi <= MAX_AUTO_NODES:
-            ok, nf = self.probe(hi)
+            ok, nf = probe(hi)
             if ok:
                 break
             hist.append((hi, nf))
@@ -229,7 +370,7 @@ class CapacityPlanner:
             return False, MAX_AUTO_NODES, hist
         while lo + 1 < hi:
             mid = (lo + hi) // 2
-            ok, _ = self.probe(mid)
+            ok, _ = probe(mid)
             if ok:
                 hi = mid
             else:

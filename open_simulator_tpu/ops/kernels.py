@@ -1423,3 +1423,83 @@ def schedule_batch(
 
     final, choices = jax.lax.scan(body, cry, (pod_group, forced_node, valid))
     return final, choices
+
+
+# ---------------------------------------------------------------------------
+# Multi-candidate capacity probing: evaluate S node-active masks in ONE
+# dispatch. The capacity planner's doubling/refinement search asks "would this
+# batch schedule on base + n template nodes?" for several n at once; each
+# candidate differs only in which node columns are active, so the fan-out is a
+# vmap over (carry, active) with the tables closed over — `active` folds into
+# static_mask, making an inactive node exactly a pad_batch_tables phantom
+# (infeasible everywhere, excluded from every normalizer, zero counts). Under
+# a ('scenarios', 'nodes') mesh (parallel/mesh.py) the vmapped axis shards as
+# data parallelism, one candidate lane per device.
+# ---------------------------------------------------------------------------
+
+
+def _mask_active(tb: Tables, active) -> Tables:
+    """Fold a [N] node-active mask into the static group mask (the single
+    feasibility root every filter ANDs into)."""
+    return tb._replace(static_mask=tb.static_mask & active[None, :])
+
+
+@partial(jax.jit, static_argnames=("gpu_live", "w", "filters", "block"))
+@shaped(active_s="[S, N] bool", g="[] i32", m="[] i32", cap1="[] bool")
+def probe_wave_fanout(tb: Tables, cry_s: Carry, active_s, g, m, cap1,
+                      gpu_live: bool = False,
+                      w: ScoreWeights = DEFAULT_WEIGHTS,
+                      filters: FilterFlags = DEFAULT_FILTERS,
+                      block: int = WAVE_BLOCK):
+    """schedule_wave over S candidate node-active masks in one dispatch.
+    cry_s is a Carry whose leaves carry a leading [S] axis. Returns
+    (carry_s, placed_s [S] i32)."""
+
+    def one(cry: Carry, active):
+        c2, _, placed = schedule_wave(
+            _mask_active(tb, active), cry, g, m, cap1,
+            gpu_live=gpu_live, w=w, filters=filters, block=block)
+        return c2, placed
+
+    return jax.vmap(one)(cry_s, active_s)
+
+
+@partial(jax.jit, static_argnames=("w", "filters", "ss_live", "sa_live", "n_zones"))
+@shaped(active_s="[S, N] bool", g="[] i32", valid="[P] bool", cap1="[] bool")
+def probe_group_serial_fanout(tb: Tables, cry_s: Carry, active_s, g, valid, cap1,
+                              w: ScoreWeights = DEFAULT_WEIGHTS,
+                              filters: FilterFlags = DEFAULT_FILTERS,
+                              ss_live: bool = False, sa_live: bool = False,
+                              n_zones: int = 2):
+    """schedule_group_serial over S candidate node-active masks in one
+    dispatch. Returns (carry_s, placed_s [S] i32)."""
+
+    def one(cry: Carry, active):
+        c2, _, placed = schedule_group_serial(
+            _mask_active(tb, active), cry, g, valid, cap1,
+            w=w, filters=filters, ss_live=ss_live, sa_live=sa_live,
+            n_zones=n_zones)
+        return c2, placed
+
+    return jax.vmap(one)(cry_s, active_s)
+
+
+@partial(jax.jit, static_argnames=("n_zones", "enable_gpu", "enable_storage", "w", "filters"))
+@shaped(active_s="[S, N] bool", pod_group="[P] i32", forced_node="[P] i32", valid="[P] bool")
+def probe_serial_fanout(tb: Tables, cry_s: Carry, active_s, pod_group,
+                        forced_node, valid, n_zones: int,
+                        enable_gpu: bool = True, enable_storage: bool = True,
+                        w: ScoreWeights = DEFAULT_WEIGHTS,
+                        filters: FilterFlags = DEFAULT_FILTERS):
+    """schedule_batch over S candidate node-active masks in one dispatch.
+    Returns (carry_s, placed_s [S] i32) — the probe only needs counts, so the
+    per-pod choices stay on device and reduce to a sum per lane."""
+
+    def one(cry: Carry, active):
+        c2, choices = schedule_batch(
+            _mask_active(tb, active), cry, pod_group, forced_node, valid,
+            n_zones=n_zones, enable_gpu=enable_gpu,
+            enable_storage=enable_storage, w=w, filters=filters)
+        return c2, jnp.sum((choices >= 0).astype(jnp.int32))
+
+    return jax.vmap(one)(cry_s, active_s)

@@ -7,8 +7,11 @@ from .distributed import make_global_mesh, node_mesh_local
 from .mesh import (
     NODE_AXIS,
     SCENARIO_AXIS,
+    fanout_shardings,
     make_node_mesh,
+    make_scenario_mesh,
     pad_batch_tables,
+    put_fanout_inputs,
     schedule_batch_on_mesh,
     schedule_scenarios_on_mesh,
     table_shardings,
@@ -23,8 +26,11 @@ __all__ = [
     "node_mesh_local",
     "NODE_AXIS",
     "SCENARIO_AXIS",
+    "fanout_shardings",
     "make_node_mesh",
+    "make_scenario_mesh",
     "pad_batch_tables",
+    "put_fanout_inputs",
     "schedule_batch_on_mesh",
     "schedule_scenarios_on_mesh",
     "table_shardings",

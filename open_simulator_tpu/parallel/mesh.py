@@ -220,3 +220,57 @@ def tables_from_batch(bt: BatchTables) -> kernels.Tables:
     """Assemble a kernels.Tables from a BatchTables BY FIELD NAME — the single place
     that maps between the two structs, immune to field reordering."""
     return kernels.Tables(**{f: getattr(bt, f) for f in kernels.Tables._fields})
+
+
+# ----------------------------------------------------------------------------
+# Multi-candidate probe fan-out (kernels.probe_*_fanout): the capacity
+# planner's candidate lanes are independent what-if scenarios, so the vmapped
+# [S] axis shards over the 'scenarios' mesh axis — one candidate node count
+# per device — while the tables stay node-sharded/replicated as usual.
+# ----------------------------------------------------------------------------
+
+
+def make_scenario_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """Pure-DP mesh ('scenarios' = n, 'nodes' = 1) for the capacity prober's
+    multi-candidate fan-out: each candidate lane lands on its own device and
+    no cross-device collectives are needed within a lane."""
+    devs = list(devices) if devices is not None else jax.devices()
+    n = n_devices if n_devices is not None else len(devs)
+    return make_node_mesh(n, scenario_axis=n, devices=devs)
+
+
+def fanout_shardings(mesh: Mesh):
+    """(tables_sharding, carry_s_sharding, active_s_sharding) for the
+    probe_*_fanout kernels: tables as in table_shardings (node axis sharded —
+    trivially replicated on a pure-scenario mesh), carry leaves and the active
+    mask sharded over their leading [S] candidate axis."""
+
+    def s(spec):
+        return NamedSharding(mesh, spec)
+
+    carry_s = kernels.Carry(
+        requested=s(P(SCENARIO_AXIS, NODE_AXIS, None)),
+        nonzero=s(P(SCENARIO_AXIS, NODE_AXIS, None)),
+        port_used=s(P(SCENARIO_AXIS, NODE_AXIS, None)),
+        counter=s(P(SCENARIO_AXIS, None, None)),
+        carrier=s(P(SCENARIO_AXIS, None, None)),
+        dev_used=s(P(SCENARIO_AXIS, NODE_AXIS, None)),
+        vg_req=s(P(SCENARIO_AXIS, NODE_AXIS, None)),
+        sdev_alloc=s(P(SCENARIO_AXIS, NODE_AXIS, None)),
+    )
+    return table_shardings(mesh), carry_s, s(P(SCENARIO_AXIS, NODE_AXIS))
+
+
+def put_fanout_inputs(mesh: Mesh, bt: BatchTables, carry_s_np, active_s_np):
+    """device_put the probe fan-out inputs with their mesh shardings: returns
+    (tables, carry_s, active_s) ready for kernels.probe_*_fanout inside a
+    `with mesh:` block. carry_s_np leaves carry a leading [S] axis; S must be
+    divisible by the mesh's scenario-axis size."""
+    ts, cs, as_ = fanout_shardings(mesh)
+    tables = kernels.Tables(*(
+        jax.device_put(np.asarray(v), s) for v, s in zip(tables_from_batch(bt), ts)
+    ))
+    carry_s = kernels.Carry(*(
+        jax.device_put(np.asarray(v), s) for v, s in zip(carry_s_np, cs)
+    ))
+    return tables, carry_s, jax.device_put(np.asarray(active_s_np), as_)

@@ -1082,46 +1082,29 @@ def _pad_slots(rows: List[List], width: int, fill, dtype) -> np.ndarray:
     return out
 
 
-def build_batch_tables(
+def build_pod_axis_tables(
     enc: Encoder,
     batch: List[Tuple[int, int]],          # (group_id, forced_node) per pod, in order
-    placed: Dict[object, PlacedGroup],
-    match_cache: Dict[Tuple[int, str], bool],
     pad_to: Optional[int] = None,
-) -> BatchTables:
-    """Assemble numpy tables for one batch. `match_cache` memoizes counter-selector vs
-    placed-pod-signature matches across batches (engine-owned)."""
-    na, axis = enc.na, enc.axis
-    N, R = na.N, axis.R
+) -> Dict[str, np.ndarray]:
+    """The node-axis-INDEPENDENT half of BatchTables: per-group statics
+    (requests, term slots, selector-match matrices, gpu/storage group rows)
+    and the batch pod arrays. Everything here is a function of the encoder's
+    interned groups/counters/carriers and the pod order alone — the
+    incremental capacity prober computes it exactly once per search and keeps
+    it fixed across every candidate node count.
+
+    Side effect: interns every group's host ports, which SIZES the port axis.
+    Must therefore run before build_node_axis_tables (the seed port table
+    reads len(enc.ports))."""
     G = max(1, len(enc.group_list))
     T = max(1, len(enc.counter_list))
     Tc = max(1, len(enc.carrier_list))
-
+    R = enc.axis.R
     groups = enc.group_list or []
     # Intern every group's host ports BEFORE sizing the port axis, or new ports in this
     # batch would land out of range and clamp onto other pods' columns.
     grp_port_ids = [enc.port_ids(g.ports) for g in groups] or [[]]
-    PORT = max(1, len(enc.ports))
-
-    def stack(attr, fill=0.0):
-        if not groups:
-            return np.zeros((G, N), np.float32)
-        return np.stack([getattr(g, attr).astype(np.float32) for g in groups])
-
-    static_mask = (
-        np.stack([g.static_mask for g in groups]) if groups else np.zeros((G, N), bool)
-    )
-    # Intern every topology domain FIRST — D (and the sentinel index) depend on it.
-    counter_dom_raw = [na.domain_of(cs.topo_key) for cs in enc.counter_list]
-    carr_dom_raw = [na.domain_of(cs.topo_key) for cs in enc.carrier_list]
-    D = max(1, na.D)  # StringTable length includes the reserved 0 slot; ids are < D
-
-    counter_dom = np.full((T, N), D, np.int32)
-    for t, dom in enumerate(counter_dom_raw):
-        counter_dom[t] = np.where(dom >= 0, dom, D)
-    carr_dom = np.full((Tc, N), D, np.int32)
-    for t, dom in enumerate(carr_dom_raw):
-        carr_dom[t] = np.where(dom >= 0, dom, D)
 
     A = max((len(g.req_aff) for g in groups), default=0)
     B = max((len(g.req_anti) for g in groups), default=0)
@@ -1129,13 +1112,6 @@ def build_batch_tables(
     Sd = max((len(g.spread_dns) for g in groups), default=0)
     Ss = max((len(g.spread_sa) for g in groups), default=0)
     PP = max((len(g.ports) for g in groups), default=0)
-
-    dns_edom = np.zeros((G, max(1, Sd), D + 1), bool)
-    for gi, g in enumerate(groups):
-        for si, (cid, _, _) in enumerate(g.spread_dns):
-            dom = na.domain_of(enc.counter_list[cid].topo_key)
-            elig = g.dns_elig if g.dns_elig is not None else np.ones(N, bool)
-            dns_edom[gi, si, dom[elig & (dom >= 0)]] = True
 
     carr_sel_match_g = np.zeros((Tc, G), bool)
     for t, cs in enumerate(enc.carrier_list):
@@ -1164,9 +1140,6 @@ def build_batch_tables(
         carr_w_vals.append(wv)
     Ca = max((len(a) for a in carr_anti_lists), default=0)
     Cw = max((len(a) for a in carr_w_lists), default=0)
-    carr_anti_t = _pad_slots(carr_anti_lists or [[]], Ca, -1, np.int32)
-    carr_w_t = _pad_slots(carr_w_lists or [[]], Cw, -1, np.int32)
-    carr_w_w = _pad_slots(carr_w_vals or [[]], Cw, 0.0, np.float32)
     counter_sel_match_g = np.zeros((T, G), bool)
     for t, cs in enumerate(enc.counter_list):
         for gi, g in enumerate(groups):
@@ -1175,6 +1148,105 @@ def build_batch_tables(
     for gi, g in enumerate(groups):
         for cs in g.carried:
             grp_carries[gi, enc.carriers[cs]] = 1.0
+
+    SL = max((len(g.lvm_sizes) for g in groups), default=0)
+    SD = max((len(g.sdev_sizes) for g in groups), default=0)
+
+    # ---- batch pod arrays -------------------------------------------------------
+    P = len(batch)
+    P_pad = max(pad_to or P, P, 1)
+    pod_group = np.zeros(P_pad, np.int32)
+    forced_node = np.full(P_pad, -1, np.int32)
+    valid = np.zeros(P_pad, bool)
+    for i, (gi, fn) in enumerate(batch):
+        pod_group[i] = gi
+        forced_node[i] = fn
+        valid[i] = True
+
+    return dict(
+        grp_requests=(
+            np.stack([g.requests for g in groups]) if groups else np.zeros((G, R), np.float32)
+        ),
+        grp_nonzero=(
+            np.stack([g.nonzero for g in groups]) if groups else np.zeros((G, 2), np.float32)
+        ),
+        grp_unknown=np.array([g.unknown_resource for g in groups] or [False], bool),
+        grp_ports=_pad_slots(grp_port_ids, PP, 0, np.int32),
+        counter_sel_match_g=counter_sel_match_g,
+        req_aff_t=_pad_slots([g.req_aff for g in groups] or [[]], A, -1, np.int32),
+        grp_aff_self=np.array([g.aff_self for g in groups] or [False], bool),
+        req_anti_t=_pad_slots([g.req_anti for g in groups] or [[]], B, -1, np.int32),
+        pref_t=_pad_slots([[c for c, _ in g.pref] for g in groups] or [[]], Cp, -1, np.int32),
+        pref_w=_pad_slots([[w for _, w in g.pref] for g in groups] or [[]], Cp, 0.0, np.float32),
+        dns_t=_pad_slots([[c for c, _, _ in g.spread_dns] for g in groups] or [[]], Sd, -1, np.int32),
+        dns_maxskew=_pad_slots([[m for _, m, _ in g.spread_dns] for g in groups] or [[]], Sd, 1.0, np.float32),
+        dns_self=_pad_slots([[s for _, _, s in g.spread_dns] for g in groups] or [[]], Sd, 0.0, np.float32),
+        sa_t=_pad_slots([[c for c, _, _ in g.spread_sa] for g in groups] or [[]], Ss, -1, np.int32),
+        sa_maxskew=_pad_slots([[m for _, m, _ in g.spread_sa] for g in groups] or [[]], Ss, 1.0, np.float32),
+        sa_self=_pad_slots([[s for _, _, s in g.spread_sa] for g in groups] or [[]], Ss, 0.0, np.float32),
+        ss_t=np.array([g.ss_counter for g in groups] or [-1], np.int32),
+        ss_skip=np.array([g.ss_skip for g in groups] or [False], bool),
+        carr_sel_match_g=carr_sel_match_g,
+        carr_anti_t=_pad_slots(carr_anti_lists or [[]], Ca, -1, np.int32),
+        carr_w_t=_pad_slots(carr_w_lists or [[]], Cw, -1, np.int32),
+        carr_w_w=_pad_slots(carr_w_vals or [[]], Cw, 0.0, np.float32),
+        grp_carries=grp_carries,
+        grp_gpu_mem=np.array([g.gpu_mem for g in groups] or [0.0], np.float32),
+        grp_gpu_num=np.array([g.gpu_num for g in groups] or [0.0], np.float32),
+        grp_lvm_size=_pad_slots([g.lvm_sizes for g in groups] or [[]], SL, 0.0, np.float32),
+        grp_lvm_vg=_pad_slots([g.lvm_vg_ids for g in groups] or [[]], SL, 0, np.int32),
+        grp_sdev_size=_pad_slots([g.sdev_sizes for g in groups] or [[]], SD, 0.0, np.float32),
+        grp_sdev_media=_pad_slots([g.sdev_media for g in groups] or [[]], SD, 0, np.int32),
+        pod_group=pod_group,
+        forced_node=forced_node,
+        valid=valid,
+    )
+
+
+def build_node_axis_tables(
+    enc: Encoder,
+    placed: Dict[object, PlacedGroup],
+    match_cache: Dict[Tuple[int, str], bool],
+) -> Dict[str, np.ndarray]:
+    """The node-axis half of BatchTables: every [*, N] mask/raw/domain table,
+    the per-node plugin matrices, and the carry seeds aggregated from
+    `placed`. Reads len(enc.ports), so build_pod_axis_tables must have interned
+    the batch's host ports first."""
+    na = enc.na
+    N, R = na.N, enc.axis.R
+    G = max(1, len(enc.group_list))
+    T = max(1, len(enc.counter_list))
+    Tc = max(1, len(enc.carrier_list))
+    groups = enc.group_list or []
+    PORT = max(1, len(enc.ports))
+
+    def stack(attr):
+        if not groups:
+            return np.zeros((G, N), np.float32)
+        return np.stack([getattr(g, attr).astype(np.float32) for g in groups])
+
+    static_mask = (
+        np.stack([g.static_mask for g in groups]) if groups else np.zeros((G, N), bool)
+    )
+    # Intern every topology domain FIRST — D (and the sentinel index) depend on it.
+    counter_dom_raw = [na.domain_of(cs.topo_key) for cs in enc.counter_list]
+    carr_dom_raw = [na.domain_of(cs.topo_key) for cs in enc.carrier_list]
+    D = max(1, na.D)  # StringTable length includes the reserved 0 slot; ids are < D
+
+    counter_dom = np.full((T, N), D, np.int32)
+    for t, dom in enumerate(counter_dom_raw):
+        counter_dom[t] = np.where(dom >= 0, dom, D)
+    carr_dom = np.full((Tc, N), D, np.int32)
+    for t, dom in enumerate(carr_dom_raw):
+        carr_dom[t] = np.where(dom >= 0, dom, D)
+
+    Sd = max((len(g.spread_dns) for g in groups), default=0)
+    dns_edom = np.zeros((G, max(1, Sd), D + 1), bool)
+    for gi, g in enumerate(groups):
+        for si, (cid, _, _) in enumerate(g.spread_dns):
+            dom = na.domain_of(enc.counter_list[cid].topo_key)
+            elig = g.dns_elig if g.dns_elig is not None else np.ones(N, bool)
+            dns_edom[gi, si, dom[elig & (dom >= 0)]] = True
 
     # ---- seeds from placed pods -----------------------------------------------
     seed_requested = np.zeros((N, R), np.float32)
@@ -1234,30 +1306,12 @@ def build_batch_tables(
         sdev_cap, sdev_media, seed_sdev_alloc = local_host.device_matrices(maxsd)
         seed_sdev_alloc = seed_sdev_alloc.astype(np.float32)
     else:
-        maxvg = maxsd = 1
         vg_cap = seed_vg_req = np.zeros((N, 1), np.float32)
         vg_nameid = np.zeros((N, 1), np.int32)
         sdev_cap = seed_sdev_alloc = np.zeros((N, 1), np.float32)
         sdev_media = np.zeros((N, 1), np.int32)
-    SL = max((len(g.lvm_sizes) for g in groups), default=0)
-    SD = max((len(g.sdev_sizes) for g in groups), default=0)
-    grp_lvm_size = _pad_slots([g.lvm_sizes for g in groups] or [[]], SL, 0.0, np.float32)
-    grp_lvm_vg = _pad_slots([g.lvm_vg_ids for g in groups] or [[]], SL, 0, np.int32)
-    grp_sdev_size = _pad_slots([g.sdev_sizes for g in groups] or [[]], SD, 0.0, np.float32)
-    grp_sdev_media = _pad_slots([g.sdev_media for g in groups] or [[]], SD, 0, np.int32)
 
-    # ---- batch pod arrays -------------------------------------------------------
-    P = len(batch)
-    P_pad = max(pad_to or P, P, 1)
-    pod_group = np.zeros(P_pad, np.int32)
-    forced_node = np.full(P_pad, -1, np.int32)
-    valid = np.zeros(P_pad, bool)
-    for i, (gi, fn) in enumerate(batch):
-        pod_group[i] = gi
-        forced_node[i] = fn
-        valid[i] = True
-
-    return BatchTables(
+    return dict(
         alloc=na.alloc.astype(np.float32),
         node_zone=na.zone_id.astype(np.int32),
         n_zones=len(na.zones) + 1,
@@ -1272,45 +1326,12 @@ def build_batch_tables(
         avoid_raw=stack("avoid_raw"),
         image_raw=stack("image_raw"),
         extra_raw=stack("extra_raw"),
-        grp_requests=(
-            np.stack([g.requests for g in groups]) if groups else np.zeros((G, R), np.float32)
-        ),
-        grp_nonzero=(
-            np.stack([g.nonzero for g in groups]) if groups else np.zeros((G, 2), np.float32)
-        ),
-        grp_unknown=np.array([g.unknown_resource for g in groups] or [False], bool),
-        grp_ports=_pad_slots(grp_port_ids, PP, 0, np.int32),
         counter_dom=counter_dom,
-        counter_sel_match_g=counter_sel_match_g,
-        req_aff_t=_pad_slots([g.req_aff for g in groups] or [[]], A, -1, np.int32),
-        grp_aff_self=np.array([g.aff_self for g in groups] or [False], bool),
-        req_anti_t=_pad_slots([g.req_anti for g in groups] or [[]], B, -1, np.int32),
-        pref_t=_pad_slots([[c for c, _ in g.pref] for g in groups] or [[]], Cp, -1, np.int32),
-        pref_w=_pad_slots([[w for _, w in g.pref] for g in groups] or [[]], Cp, 0.0, np.float32),
-        dns_t=_pad_slots([[c for c, _, _ in g.spread_dns] for g in groups] or [[]], Sd, -1, np.int32),
-        dns_maxskew=_pad_slots([[m for _, m, _ in g.spread_dns] for g in groups] or [[]], Sd, 1.0, np.float32),
-        dns_self=_pad_slots([[s for _, _, s in g.spread_dns] for g in groups] or [[]], Sd, 0.0, np.float32),
-        dns_edom=dns_edom,
-        sa_t=_pad_slots([[c for c, _, _ in g.spread_sa] for g in groups] or [[]], Ss, -1, np.int32),
-        sa_maxskew=_pad_slots([[m for _, m, _ in g.spread_sa] for g in groups] or [[]], Ss, 1.0, np.float32),
-        sa_self=_pad_slots([[s for _, _, s in g.spread_sa] for g in groups] or [[]], Ss, 0.0, np.float32),
-        ss_t=np.array([g.ss_counter for g in groups] or [-1], np.int32),
-        ss_skip=np.array([g.ss_skip for g in groups] or [False], bool),
         carr_dom=carr_dom,
-        carr_sel_match_g=carr_sel_match_g,
-        carr_anti_t=carr_anti_t,
-        carr_w_t=carr_w_t,
-        carr_w_w=carr_w_w,
-        grp_carries=grp_carries,
-        grp_gpu_mem=np.array([g.gpu_mem for g in groups] or [0.0], np.float32),
-        grp_gpu_num=np.array([g.gpu_num for g in groups] or [0.0], np.float32),
+        dns_edom=dns_edom,
         grp_gpu_pre=grp_gpu_pre,
         grp_gpu_take=grp_gpu_take,
         dev_total=dev_total,
-        grp_lvm_size=grp_lvm_size,
-        grp_lvm_vg=grp_lvm_vg,
-        grp_sdev_size=grp_sdev_size,
-        grp_sdev_media=grp_sdev_media,
         vg_cap=vg_cap,
         vg_nameid=vg_nameid,
         sdev_cap=sdev_cap,
@@ -1323,7 +1344,115 @@ def build_batch_tables(
         seed_port_used=seed_port_used,
         seed_counter=seed_counter,
         seed_carrier=seed_carrier,
-        pod_group=pod_group,
-        forced_node=forced_node,
-        valid=valid,
+    )
+
+
+def build_batch_tables(
+    enc: Encoder,
+    batch: List[Tuple[int, int]],          # (group_id, forced_node) per pod, in order
+    placed: Dict[object, PlacedGroup],
+    match_cache: Dict[Tuple[int, str], bool],
+    pad_to: Optional[int] = None,
+) -> BatchTables:
+    """Assemble numpy tables for one batch. `match_cache` memoizes counter-selector vs
+    placed-pod-signature matches across batches (engine-owned).
+
+    Construction is split along the node axis: build_pod_axis_tables is a
+    function of the encoder + pod order only (computed once per capacity
+    search by the incremental prober), build_node_axis_tables carries every
+    [*, N] table and the seeds. The pod-axis half runs first — it interns the
+    batch's host ports, which sizes the node-side seed port table."""
+    pod_side = build_pod_axis_tables(enc, batch, pad_to=pad_to)
+    node_side = build_node_axis_tables(enc, placed, match_cache)
+    return BatchTables(**pod_side, **node_side)
+
+
+def extend_node_axis(
+    bt: "BatchTables",
+    k: int,
+    template_col: int,
+    hostname_counters: Sequence[int] = (),
+    hostname_carriers: Sequence[int] = (),
+) -> "BatchTables":
+    """Append k copies of node column `template_col` to every node-axis table of
+    an UNPADDED BatchTables (the pre-pad_encoder_axes form) — the incremental
+    capacity prober's growth path: extending the candidate-node axis without
+    rebuilding NodeArrays/Encoder from raw node dicts.
+
+    Template copies are indistinguishable to every selector except through
+    their hostname label (new_fake_nodes rewrites only kubernetes.io/hostname),
+    so every appended column is a verbatim copy of the template column, EXCEPT
+    the rows listed in hostname_counters/hostname_carriers: those topologies
+    have one domain per node, so each appended node gets a fresh domain id.
+    The domain axis therefore grows by k; the seed/edom sentinel column moves
+    from D to D+k and the new interior columns start at zero (no placed pod
+    can be on an appended node). Seeds for the appended nodes are zero for the
+    same reason — the caller must only append nodes that carry no bound pods."""
+    if k <= 0:
+        return bt
+    import dataclasses
+
+    N = bt.alloc.shape[0]
+    D = bt.seed_counter.shape[1] - 1
+    newD = D + k
+
+    def rep_col(a: np.ndarray) -> np.ndarray:  # [*, N, ...] along axis 1
+        return np.concatenate(
+            [a, np.repeat(a[:, template_col:template_col + 1], k, axis=1)], axis=1)
+
+    def rep_row(a: np.ndarray) -> np.ndarray:  # [N, ...] along axis 0
+        return np.concatenate(
+            [a, np.repeat(a[template_col:template_col + 1], k, axis=0)], axis=0)
+
+    def zero_rows(a: np.ndarray) -> np.ndarray:  # [N, ...]: appended seeds are empty
+        return np.concatenate(
+            [a, np.zeros((k,) + a.shape[1:], a.dtype)], axis=0)
+
+    def widen(a: np.ndarray) -> np.ndarray:  # [*, D+1] -> [*, newD+1]
+        out = np.zeros(a.shape[:-1] + (newD + 1,), a.dtype)
+        out[..., :D] = a[..., :D]
+        out[..., newD] = a[..., D]  # sentinel column moves with D
+        return out
+
+    new_dom_ids = (D + np.arange(k)).astype(np.int32)
+
+    def dom_ext(dom: np.ndarray, per_node_rows: Sequence[int]) -> np.ndarray:
+        ext = rep_col(dom)
+        ext = np.where(ext == D, newD, ext).astype(np.int32)  # sentinel remap
+        for t in per_node_rows:
+            ext[t, N:] = new_dom_ids  # fresh hostname domain per appended node
+        return ext
+
+    return dataclasses.replace(
+        bt,
+        alloc=rep_row(bt.alloc),
+        node_zone=np.concatenate(
+            [bt.node_zone, np.repeat(bt.node_zone[template_col:template_col + 1], k)]),
+        static_mask=rep_col(bt.static_mask),
+        mask_taint=rep_col(bt.mask_taint),
+        mask_unsched=rep_col(bt.mask_unsched),
+        mask_aff=rep_col(bt.mask_aff),
+        mask_extra=rep_col(bt.mask_extra),
+        simon_raw=rep_col(bt.simon_raw),
+        nodeaff_raw=rep_col(bt.nodeaff_raw),
+        taint_raw=rep_col(bt.taint_raw),
+        avoid_raw=rep_col(bt.avoid_raw),
+        image_raw=rep_col(bt.image_raw),
+        extra_raw=rep_col(bt.extra_raw),
+        counter_dom=dom_ext(bt.counter_dom, hostname_counters),
+        carr_dom=dom_ext(bt.carr_dom, hostname_carriers),
+        dns_edom=widen(bt.dns_edom),
+        dev_total=rep_row(bt.dev_total),
+        vg_cap=rep_row(bt.vg_cap),
+        vg_nameid=rep_row(bt.vg_nameid),
+        sdev_cap=rep_row(bt.sdev_cap),
+        sdev_media=rep_row(bt.sdev_media),
+        seed_requested=zero_rows(bt.seed_requested),
+        seed_nonzero=zero_rows(bt.seed_nonzero),
+        seed_port_used=zero_rows(bt.seed_port_used),
+        seed_dev_used=zero_rows(bt.seed_dev_used),
+        seed_vg_req=zero_rows(bt.seed_vg_req),
+        seed_sdev_alloc=zero_rows(bt.seed_sdev_alloc),
+        seed_counter=widen(bt.seed_counter),
+        seed_carrier=widen(bt.seed_carrier),
     )

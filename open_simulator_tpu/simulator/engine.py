@@ -346,6 +346,22 @@ class Simulator:
     def encode_batch(self, to_schedule: List[dict]) -> BatchTables:
         """Encode a pod batch into device-ready tables (no scheduling). Exposed for
         the bench/graft harnesses and the parallel (mesh-sharded) path."""
+        bt = self.encode_batch_raw(to_schedule)
+        # Pad encoder-derived axes (G/T/Tc/D/ports/term slots) to pow2 buckets: the
+        # encoder interns cumulatively across apps, so without this every
+        # ScheduleApp batch would get fresh shapes and a fresh XLA compile.
+        bt = pad_encoder_axes(bt)
+        # Pad the node axis the same way: the capacity planner re-simulates at N,
+        # N+1, N+2... nodes (apply.go:203-259) — bucketed N keeps the XLA compile
+        # cache warm across probes. Phantom nodes are infeasible by construction.
+        return pad_batch_tables(bt, bucket_capped(self.na.N, 1024))
+
+    def encode_batch_raw(self, to_schedule: List[dict]) -> BatchTables:
+        """encode_batch WITHOUT the encoder-axis/node-axis padding: the exact
+        per-group/per-counter table content at this simulator's real axis sizes.
+        The incremental capacity prober (simulator/probe.py) holds this form so
+        its node-axis extension path can append template columns before the
+        bucketed pads are applied."""
         batch: List[Tuple[int, int]] = []
         for pod in to_schedule:
             # strip_daemon_pin can only fire on pods with node affinity; the
@@ -373,15 +389,7 @@ class Simulator:
         # Pad the scan length to bound compile-cache churn: powers of two up to 2048,
         # then multiples of 2048 (a 10k batch scans 10240 steps, not 16384).
         pad = bucket_capped(len(batch), 2048)
-        bt = build_batch_tables(self.encoder, batch, self.placed, self.match_cache, pad_to=pad)
-        # Pad encoder-derived axes (G/T/Tc/D/ports/term slots) to pow2 buckets: the
-        # encoder interns cumulatively across apps, so without this every
-        # ScheduleApp batch would get fresh shapes and a fresh XLA compile.
-        bt = pad_encoder_axes(bt)
-        # Pad the node axis the same way: the capacity planner re-simulates at N,
-        # N+1, N+2... nodes (apply.go:203-259) — bucketed N keeps the XLA compile
-        # cache warm across probes. Phantom nodes are infeasible by construction.
-        return pad_batch_tables(bt, bucket_capped(self.na.N, 1024))
+        return build_batch_tables(self.encoder, batch, self.placed, self.match_cache, pad_to=pad)
 
     def _wave_eligibility(self, gi: int) -> Tuple[bool, ...]:
         """(eligible, cap1, spread_live, gpu_live, ss_live, sa_live,

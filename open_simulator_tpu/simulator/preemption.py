@@ -365,9 +365,12 @@ def _pick_one_node(sim, candidates: List[dict]) -> dict:
     if len(cands) > 1:  # fewest victims
         cands = min_by(cands, lambda c: len(c["victims"]))
     if len(cands) > 1:
-        # latest earliest-start among each node's highest-priority victims
+        # latest earliest-start among each node's highest-priority victims.
+        # victims list PDB-violating pods FIRST, so victims[0] is not
+        # necessarily the highest-priority one (GetEarliestPodStartTime
+        # tracks the true max priority across all victims).
         def earliest(c):
-            hi = pod_priority(c["victims"][0])
+            hi = max(pod_priority(p) for p in c["victims"])
             return min(_commit_seq(sim, p) for p in c["victims"]
                        if pod_priority(p) == hi)
         latest = max(earliest(c) for c in cands)
@@ -422,7 +425,11 @@ def schedule_with_preemption(sim, pods: List[dict]) -> List[UnscheduledPod]:
         return failed
     recorded: List[UnscheduledPod] = []
     remaining = list(pods)
-    attempted: Dict[object, int] = {}  # signature → len(_commits_prio) at attempt
+    # (signature, priority) → len(_commits_prio) at the failed attempt. The
+    # priority is part of the key because scheduling_signature excludes
+    # spec.priority: a later same-spec pod with HIGHER priority sees a larger
+    # victim pool and must get its own attempt.
+    attempted: Dict[object, int] = {}
     while True:
         target = _select_target(sim, remaining, failed, attempted)
         if target is None:
@@ -433,11 +440,17 @@ def schedule_with_preemption(sim, pods: List[dict]) -> List[UnscheduledPod]:
         node_i, victims, reasons = try_preempt(sim, pod)
         if node_i >= 0:
             evict(sim, victims, node_i, pod)
+            # evictions change the victim pool WITHOUT appending to
+            # _commits_prio, so the suffix-min gate can't see them —
+            # invalidate every dedup entry instead of silently skipping a
+            # same-signature pod that could now preempt.
+            attempted.clear()
             # recordSchedulingFailure sets status.nominatedNodeName before
             # Simon deletes the pod; keep it visible on the record
             pod.setdefault("status", {})["nominatedNodeName"] = sim.na.names[node_i]
         else:
-            attempted[scheduling_signature(pod)] = len(sim._commits_prio)
+            attempted[(scheduling_signature(pod), pod_priority(pod))] = len(
+                sim._commits_prio)
         recorded.extend(prefix_failed)
         recorded.append(UnscheduledPod(
             pod, sim._format_reason(pod, reasons, sim.na.N)))
@@ -466,7 +479,7 @@ def _select_target(sim, remaining: List[dict], failed: List[UnscheduledPod],
         prio = pod_priority(p)
         if global_min >= prio or _preempt_policy_never(p):
             continue
-        at = attempted.get(scheduling_signature(p))
+        at = attempted.get((scheduling_signature(p), prio))
         if at is not None:
             if at >= n:
                 continue  # state rewound past the attempt point: no new info

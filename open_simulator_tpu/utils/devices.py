@@ -147,8 +147,12 @@ def tpu_lock_holder(lock_path: str):
         return None
     try:
         os.kill(pid, 0)
-    except OSError:
+    except ProcessLookupError:
         return None  # holder died without cleanup: stale
+    except PermissionError:
+        return pid  # alive under another user (EPERM): a LIVE holder, never steal
+    except OSError:
+        return pid  # unknown kill failure: assume live rather than steal
     return pid
 
 

@@ -183,3 +183,50 @@ def test_engine_mesh_epoch_spread_wave_matches_single_device(monkeypatch):
     f2 = sim_single.schedule_pods(copy.deepcopy(pods))
     assert census(sim_mesh) == census(sim_single)
     assert len(f1) == len(f2) == 0
+
+
+def test_probe_fanout_scenario_mesh_matches_local():
+    """The capacity prober's multi-candidate fan-out: S node-active masks in
+    one dispatch must equal S independently masked schedule_batch runs, both
+    on the local vmap path and sharded over a pure-scenario mesh."""
+    from open_simulator_tpu.parallel import make_scenario_mesh, put_fanout_inputs
+
+    sim, bt = _encode(12, 24, hard=False)
+    tables, carry = sim._to_device(bt)
+    N = bt.alloc.shape[0]
+    S = 4
+    counts = (3, 6, 9, 12)
+    active = np.zeros((S, N), bool)
+    for i, n in enumerate(counts):
+        active[i, :n] = True
+
+    # per-lane reference: schedule_batch with the mask folded into static_mask
+    want = []
+    for i in range(S):
+        tb2 = tables._replace(
+            static_mask=tables.static_mask & jnp.asarray(active[i])[None, :])
+        _, ch = kernels.schedule_batch(
+            tb2, carry, jnp.asarray(bt.pod_group), jnp.asarray(bt.forced_node),
+            jnp.asarray(bt.valid), n_zones=bt.n_zones)
+        want.append(int(np.asarray(jnp.sum((ch >= 0).astype(jnp.int32)))))
+
+    carry_s = kernels.Carry(*(jnp.broadcast_to(v, (S,) + v.shape) for v in carry))
+    _, placed_local = kernels.probe_serial_fanout(
+        tables, carry_s, jnp.asarray(active), jnp.asarray(bt.pod_group),
+        jnp.asarray(bt.forced_node), jnp.asarray(bt.valid), n_zones=bt.n_zones)
+    assert np.asarray(placed_local).tolist() == want
+
+    # one candidate lane per device on the ('scenarios', 'nodes'=1) mesh
+    mesh = make_scenario_mesh(4)
+    assert mesh.shape["scenarios"] == 4 and mesh.shape["nodes"] == 1
+    seeds = (bt.seed_requested, bt.seed_nonzero, bt.seed_port_used,
+             bt.seed_counter, bt.seed_carrier, bt.seed_dev_used,
+             bt.seed_vg_req, bt.seed_sdev_alloc)
+    carry_np = tuple(np.broadcast_to(a, (S,) + a.shape) for a in seeds)
+    tables_m, carry_m, active_m = put_fanout_inputs(mesh, bt, carry_np, active)
+    with mesh:
+        _, placed_mesh = kernels.probe_serial_fanout(
+            tables_m, carry_m, active_m, jnp.asarray(bt.pod_group),
+            jnp.asarray(bt.forced_node), jnp.asarray(bt.valid),
+            n_zones=bt.n_zones)
+    assert np.asarray(placed_mesh).tolist() == want

@@ -259,3 +259,25 @@ def test_anti_affinity_failure_is_resolvable():
     assert [u.pod["metadata"]["name"] for u in failed] == ["high"]
     assert [r["pod"]["metadata"]["name"] for r in sim.preempted] == ["blocker"]
     assert names_on(sim) == []
+
+
+def test_higher_priority_same_spec_pod_gets_own_attempt():
+    """The attempted-dedup is keyed by (signature, priority): after a
+    low-priority pod's failed attempt, a later pod with the SAME spec but a
+    HIGHER priority sees a larger victim pool and must not be skipped."""
+    nodes = [make_node("n0", cpu="4")]
+    tiny = prio_pod("tiny", 0, cpu="1")
+    mid = prio_pod("mid", 50, cpu="3")
+    atk_low = prio_pod("atk-low", 10, cpu="2", labels={"app": "atk"})
+    atk_high = prio_pod("atk-high", 100, cpu="2", labels={"app": "atk"})
+    sim = Simulator(nodes)
+    failed = sim.schedule_pods([tiny, mid, atk_low, atk_high])
+    # atk-low attempts (tiny is strictly lower) but evicting tiny frees only
+    # 1 cpu — no candidate; atk-high's pool includes mid and must succeed
+    assert sorted(u.pod["metadata"]["name"] for u in failed) == [
+        "atk-high", "atk-low"]
+    assert [r["pod"]["metadata"]["name"] for r in sim.preempted] == ["mid"]
+    high_rec = next(u for u in failed
+                    if u.pod["metadata"]["name"] == "atk-high")
+    assert high_rec.pod["status"]["nominatedNodeName"] == "n0"
+    assert names_on(sim) == ["tiny"]

@@ -1,0 +1,177 @@
+"""Incremental capacity probing (simulator/probe.py): the encode-once session
+must be BIT-identical to fresh-Simulator probes — counts and utilization —
+across candidate sweeps, node-padding bucket boundaries, and the node-axis
+extension path; and CapacityPlanner.search must use it with a bounded probe
+count while agreeing with the fresh-probe search."""
+
+import pytest
+
+from fixtures import make_node, make_pod
+from open_simulator_tpu.apply.applier import CapacityPlanner
+from open_simulator_tpu.core.types import ResourceTypes
+from open_simulator_tpu.models.fakenode import new_fake_nodes
+from open_simulator_tpu.simulator.engine import Simulator
+from open_simulator_tpu.simulator.probe import ProbeSession
+
+
+@pytest.fixture(autouse=True)
+def _no_envelope(monkeypatch):
+    monkeypatch.delenv("MaxCPU", raising=False)
+    monkeypatch.delenv("MaxMemory", raising=False)
+
+
+def _cluster(n_base=2):
+    base = [make_node(f"base-{i}", cpu="8", memory="16Gi") for i in range(n_base)]
+    template = make_node("tpl", cpu="8", memory="16Gi")
+    return base, template
+
+
+def _fresh_probe(base, template, pods, n, cluster_objects=None):
+    """(scheduled, total), utilization via a fresh Simulator — the reference
+    probe the incremental path must reproduce exactly."""
+    sim = Simulator(base + new_fake_nodes(template, n))
+    if cluster_objects is not None:
+        sim.register_cluster_objects(cluster_objects)
+    counts = sim.probe_pods(list(pods))
+    return counts, sim.probe_utilization()
+
+
+def _assert_matches(session, base, template, pods, ns, cluster_objects=None):
+    res = session.probe_many(ns)
+    for n in ns:
+        scheduled, total, u = res[n]
+        fresh_counts, fresh_u = _fresh_probe(base, template, pods, n,
+                                             cluster_objects)
+        assert (scheduled, total) == fresh_counts, f"counts diverge at n={n}"
+        assert u == fresh_u, f"utilization diverges at n={n}"
+
+
+def test_incremental_matches_fresh_across_bucket_boundary():
+    """Sweep candidates whose FRESH probes straddle a node-padding bucket
+    (2 base + n: n=5 pads to 8 nodes, n=7 pads to 16) while the session stays
+    at one padded shape — the masked-column ≡ phantom-column equivalence."""
+    base, template = _cluster()
+    pods = [make_pod(f"p-{i}", cpu="2", memory="2Gi") for i in range(40)]
+    session = ProbeSession.try_build(base, template, pods, n_new=12)
+    assert session is not None
+    _assert_matches(session, base, template, pods, [0, 3, 5, 6, 7, 8, 11, 14])
+
+
+def test_extension_path_matches_fresh():
+    """Growing the session via extend_node_axis (appended template columns,
+    fresh hostname domains) must stay bit-identical — including candidates
+    beyond the originally encoded bucket."""
+    base, template = _cluster()
+    pods = [make_pod(f"p-{i}", cpu="2", memory="2Gi") for i in range(40)]
+    session = ProbeSession.try_build(base, template, pods, n_new=2)
+    assert session is not None
+    before = session.n_new
+    session.ensure_capacity(12)
+    assert session.n_new >= 12 and session.extensions == 1
+    assert session.encodes == 1  # extension never re-encodes the pod batch
+    _assert_matches(session, base, template, pods,
+                    [before - 1, before, before + 1, 12])
+
+
+def test_serial_segments_match():
+    """Alternating pod shapes force serial (scan) segments instead of waves."""
+    base, template = _cluster()
+    pods = []
+    for i in range(24):
+        if i % 3 == 0:
+            pods.append(make_pod(f"s-{i}", cpu="3", memory="1Gi"))
+        else:
+            pods.append(make_pod(f"t-{i}", cpu="1", memory="3Gi"))
+    session = ProbeSession.try_build(base, template, pods, n_new=8)
+    assert session is not None
+    assert {s[0] for s in session._segs} == {"serial"}
+    _assert_matches(session, base, template, pods, [0, 2, 4, 6])
+
+
+def test_selector_spread_live_matches():
+    """A service selecting the batch routes it through the fused group-serial
+    kernel with a live SelectorSpread counter — vmapped, still exact."""
+    base, template = _cluster()
+    svc = {"apiVersion": "v1", "kind": "Service",
+           "metadata": {"name": "svc", "namespace": "default"},
+           "spec": {"selector": {"app": "web"}}}
+    cluster = ResourceTypes()
+    cluster.services = [svc]
+    pods = [make_pod(f"w-{i}", cpu="1", memory="1Gi", labels={"app": "web"})
+            for i in range(30)]
+    session = ProbeSession.try_build(base, template, pods,
+                                     cluster_objects=cluster, n_new=8)
+    assert session is not None
+    assert {s[0] for s in session._segs} == {"spread"}
+    _assert_matches(session, base, template, pods, [0, 2, 4, 6],
+                    cluster_objects=cluster)
+
+
+def test_session_gates():
+    base, template = _cluster()
+    plain = [make_pod(f"p-{i}", cpu="1", memory="1Gi") for i in range(10)]
+    # topology spread: eligible-domain sets depend on the node census
+    sp = make_pod("sp-0", cpu="1", memory="1Gi", labels={"app": "x"})
+    sp["spec"]["topologySpreadConstraints"] = [{
+        "maxSkew": 1, "topologyKey": "kubernetes.io/hostname",
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": "x"}}}]
+    assert ProbeSession.try_build(base, template, [sp] * 10, n_new=4) is None
+    # node-advertised images: ImageLocality divides by the total node count
+    imgbase = [make_node("ib", cpu="8", memory="16Gi")]
+    imgbase[0]["status"]["images"] = [{"names": ["busybox"], "sizeBytes": 100 << 20}]
+    assert ProbeSession.try_build(imgbase, template, plain, n_new=4) is None
+    # bound-after-unbound: probe order-inequivalent (planner guard mirrored)
+    mixed = [make_pod("free"), make_pod("bound", node_name="base-0")]
+    assert ProbeSession.try_build(base, template, mixed, n_new=4) is None
+    # bound-BEFORE-unbound builds, and the bound commit is candidate-invariant
+    ordered = [make_pod("bound", node_name="base-0", cpu="2", memory="2Gi"),
+               make_pod("free", cpu="2", memory="2Gi")]
+    session = ProbeSession.try_build(base, template, ordered, n_new=4)
+    assert session is not None
+    _assert_matches(session, base, template, ordered, [0, 1])
+
+
+def test_search_incremental_minimal_and_bounded_probe_count():
+    """Probe-count regression: the whole search must be a handful of fan-out
+    dispatches with pod encoding paid exactly once, and the answer must match
+    the fresh-probe search and be exactly minimal."""
+    base, template = _cluster()
+    pods = [make_pod(f"p-{i}", cpu="2", memory="2Gi") for i in range(20)]
+    planner = CapacityPlanner(base, template, pods)
+    found, n, hist = planner.search()
+    assert found
+    assert planner.stats["path"] == "incremental"
+    assert planner.stats["encodes"] == 1
+    assert planner.stats["probes"] <= 40
+    assert planner.stats["dispatches"] <= 6
+    # minimality against fresh probes
+    ok_n, _ = planner.probe(n)
+    assert ok_n
+    if n > 0:
+        ok_prev, _ = planner.probe(n - 1)
+        assert not ok_prev
+    # agreement with the fresh-probe search
+    planner2 = CapacityPlanner(base, template, list(pods))
+    found2, n2, _ = planner2._search_fresh()
+    assert (found, n) == (found2, n2)
+
+
+def test_search_falls_back_when_gated():
+    """A spread-constrained workload rejects the session; search must still
+    answer via fresh probes (path="fresh") with the same semantics."""
+    base, template = _cluster()
+    pods = []
+    for i in range(12):
+        p = make_pod(f"sp-{i}", cpu="2", memory="2Gi", labels={"app": "x"})
+        p["spec"]["topologySpreadConstraints"] = [{
+            "maxSkew": 4, "topologyKey": "kubernetes.io/hostname",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "x"}}}]
+        pods.append(p)
+    planner = CapacityPlanner(base, template, pods)
+    found, n, _ = planner.search()
+    assert planner.stats["path"] == "fresh"
+    assert found
+    ok_n, _ = planner.probe(n)
+    assert ok_n

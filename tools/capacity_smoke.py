@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""CI smoke for the incremental capacity planner (fast, CPU-only).
+
+Runs a small synthetic add-node search through CapacityPlanner and asserts the
+properties the bench relies on, so incremental-path regressions fail in CI
+instead of in the bench:
+
+- the search finds the expected minimal node count;
+- it runs on the incremental (encode-once) path with pod encoding paid
+  exactly once and a bounded candidate/dispatch budget;
+- the answer agrees with the fresh-Simulator probe at n and fails at n-1.
+
+Prints one JSON line with the measured numbers.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MaxCPU"] = "60"
+
+from open_simulator_tpu.apply.applier import CapacityPlanner  # noqa: E402
+from open_simulator_tpu.utils.synth import synth_node, synth_pod  # noqa: E402
+
+# 2000 pods x 100m on 8x32-core base nodes under a 60% MaxCPU envelope:
+# int(200000 / alloc * 100) <= 60 needs alloc >= 333,334m -> 11 nodes -> +3.
+EXPECTED_N = 3
+MAX_PROBES = 40
+MAX_DISPATCHES = 6
+
+
+def main() -> int:
+    base = [synth_node(i) for i in range(8)]
+    template = synth_node(0)
+    pods = [synth_pod(i) for i in range(2000)]
+    t0 = time.perf_counter()
+    planner = CapacityPlanner(base, template, pods)
+    found, n, _hist = planner.search()
+    dt = time.perf_counter() - t0
+    row = {
+        "metric": "capacity_smoke_2k_pods",
+        "found": found,
+        "nodes_added": n,
+        "wall_s": round(dt, 3),
+        **{k: planner.stats.get(k)
+           for k in ("path", "probes", "dispatches", "encodes", "encode_s")},
+    }
+    print(json.dumps(row), flush=True)
+    assert found, "search did not converge"
+    assert n == EXPECTED_N, f"nodes_added {n} != expected {EXPECTED_N}"
+    assert planner.stats["path"] == "incremental", planner.stats
+    assert planner.stats["encodes"] == 1, "pod encoding must run exactly once"
+    assert planner.stats["probes"] <= MAX_PROBES, planner.stats
+    assert planner.stats["dispatches"] <= MAX_DISPATCHES, planner.stats
+    ok_n, _ = planner.probe(n)
+    assert ok_n, "fresh probe disagrees at n"
+    ok_prev, _ = planner.probe(n - 1)
+    assert not ok_prev, "answer is not minimal"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
