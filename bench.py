@@ -345,7 +345,18 @@ def _run_worker(name: str) -> None:
 
         force_cpu_platform()
     builder = {n: b for n, b, _, _ in METRICS}[name]
-    print(json.dumps(builder()), flush=True)
+    row = builder()
+    # each metric runs in its own subprocess, so the registry holds exactly
+    # this run's counters: embed them so a perf regression row in
+    # BENCH_DETAIL.json carries its own explanation (segment mix, compile
+    # misses, transfer bytes, probe accounting)
+    try:
+        from open_simulator_tpu.obs import REGISTRY
+
+        row["obs_metrics"] = REGISTRY.values()
+    except Exception:
+        pass  # observability must never fail the bench
+    print(json.dumps(row), flush=True)
 
 
 # --------------------------------------------------------------------------

@@ -7,6 +7,7 @@ Rule ids (stable — they appear in suppression comments and CI output):
   dtype-drift        64-bit dtype on a TPU-targeted path
   carry-contract     lax.scan carry without (or violating) a NamedTuple contract
   contract-spec      malformed @shaped contract annotation
+  metric-in-jit      metrics-registry mutation or wall-clock read under trace
 
 Every rule is a pure function ModuleContext -> List[Finding]; file IO,
 suppressions, and exit-code policy live in runner.py.
@@ -352,6 +353,64 @@ def _carry_expr_ok(ctx: ModuleContext, expr: ast.expr, ann_name: str,
         if isinstance(f, ast.Attribute) and f.attr == "_replace":
             return bool(_names_in(f.value) & aliases_ok) or isinstance(f.value, ast.Call)
     return False
+
+
+# -------------------------------------------------------------- metric-in-jit --
+
+# wall-clock reads: meaningless under trace (they'd run once at trace time and
+# bake a constant timestamp into the compiled program)
+_CLOCK_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic", "time.process_time",
+    "time.perf_counter_ns", "time.monotonic_ns", "time.time_ns",
+}
+# registry mutation methods. `.set(...)` is deliberately ABSENT: traced code
+# is full of `arr.at[i].set(v)`, and a gauge .set under trace is caught by the
+# factory/import half below whenever the metric came from obs.metrics.
+_METRIC_MUTATORS = {"inc", "observe"}
+# obs.metrics surface: constructing or fetching a metric under trace is as
+# wrong as mutating one
+_METRIC_FACTORIES = {
+    "open_simulator_tpu.obs.metrics.counter",
+    "open_simulator_tpu.obs.metrics.gauge",
+    "open_simulator_tpu.obs.metrics.histogram",
+}
+
+
+@register(
+    "metric-in-jit", Severity.ERROR,
+    "Metrics-registry mutation (.inc()/.observe()/obs.metrics factories) or "
+    "wall-clock read (time.perf_counter()/time.time()/...) inside jit/pjit or "
+    "a lax.scan|while_loop body. Instrumentation must stay on the host side "
+    "of the device boundary: under trace these run ONCE at trace time — the "
+    "counter moves per compile instead of per dispatch and the timestamp is "
+    "a baked constant — or force a host sync mid-kernel.",
+)
+def rule_metric_in_jit(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in ctx.traced_functions():
+        for node in _local_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            hazard: Optional[str] = None
+            target = ctx.resolve(node.func)
+            if target in _CLOCK_CALLS:
+                hazard = f"{target}()"
+            elif target is not None and (
+                    target in _METRIC_FACTORIES
+                    or target.startswith("open_simulator_tpu.obs.")):
+                hazard = f"{target}(...)"
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_MUTATORS):
+                hazard = f".{node.func.attr}()"
+            if hazard:
+                out.append(Finding(
+                    "metric-in-jit", Severity.ERROR, ctx.path,
+                    node.lineno, node.col_offset,
+                    f"{hazard} inside traced '{fn.name}' — instrumentation "
+                    f"must stay host-side of the device boundary (move the "
+                    f"registry update / clock read to the dispatch site)",
+                ))
+    return out
 
 
 # -------------------------------------------------------------- contract-spec --

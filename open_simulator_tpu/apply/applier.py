@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, TextIO
 
 from ..api.v1alpha1 import ConfigError, SimonConfig, parse_simon_config, validate_config
 from ..core import constants as C
+from ..obs import instruments as obs
 from ..core.types import AppResource, NodeStatus, ResourceTypes, SimulateResult
 from ..models.fakenode import new_fake_nodes
 from ..simulator.core import simulate
@@ -241,9 +242,13 @@ class CapacityPlanner:
         self.stats = {"path": "fresh", "probes": 0, "dispatches": 0,
                       "encode_s": 0.0, "encodes": 0}
         out = self._search_incremental()
-        if out is not None:
-            return out
-        return self._search_fresh()
+        if out is None:
+            out = self._search_fresh()
+        # registry mirror of the stats dict: search accounting survives the
+        # planner object, so server /metrics and CLI snapshots report it
+        obs.CAPACITY_SEARCHES.labels(path=str(self.stats.get("path"))).inc()
+        obs.CAPACITY_ROUNDS.inc(int(self.stats.get("dispatches") or 0))
+        return out
 
     # ----------------------------------------------- incremental fan-out ----
 

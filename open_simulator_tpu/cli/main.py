@@ -78,6 +78,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--placement-dump", default="",
         help="write a JSON placement dump for the parity tool",
     )
+    p_apply.add_argument(
+        "--trace-out", default="", metavar="FILE.json",
+        help="write a Chrome trace-event JSON of the run's host spans "
+             "(perfetto-loadable; includes the metrics snapshot as metadata)")
+    p_apply.add_argument(
+        "--metrics-out", default="", metavar="FILE.json",
+        help="write the metrics-registry snapshot of the run as JSON "
+             "(render later with `simon metrics FILE.json`)")
+
+    p_metrics = sub.add_parser(
+        "metrics", help="Render a saved metrics snapshot (--metrics-out / "
+                        "--trace-out file) as Prometheus text")
+    p_metrics.add_argument("snapshot", help="snapshot or trace JSON file")
 
     p_parity = sub.add_parser(
         "parity", help="Compute the placement match-rate between two dumps "
@@ -124,6 +137,8 @@ def cmd_apply(args) -> int:
     ensure_responsive_backend()
 
     ext = [e.strip() for e in (args.extended_resources or "").split(",") if e.strip()]
+    trace_out = getattr(args, "trace_out", "")
+    metrics_out = getattr(args, "metrics_out", "")
     try:
         applier = Applier(Options(
             simon_config=args.simon_config,
@@ -133,13 +148,38 @@ def cmd_apply(args) -> int:
             extended_resources=ext,
             output_file=args.output_file,
         ))
-        if args.profile:
-            import jax
+        if trace_out:
+            from ..utils.trace import start_collection
 
-            with jax.profiler.trace(args.profile):
+            start_collection()
+        try:
+            if args.profile:
+                import jax
+
+                with jax.profiler.trace(args.profile):
+                    result = applier.run()
+            else:
                 result = applier.run()
-        else:
-            result = applier.run()
+        finally:
+            # dumps are written on FAILED runs too — a raising run records
+            # failed=True spans, which is exactly when the trace matters —
+            # and collection always stops (a leaked collector would grow for
+            # the life of the process)
+            if trace_out or metrics_out:
+                from ..obs import REGISTRY
+
+                if trace_out:
+                    from ..obs.chrome import write_chrome_trace
+                    from ..utils.trace import stop_collection
+
+                    write_chrome_trace(trace_out, stop_collection(),
+                                       metrics=REGISTRY.snapshot())
+                if metrics_out:
+                    import json
+
+                    with open(metrics_out, "w") as f:
+                        json.dump(REGISTRY.snapshot(), f, indent=1)
+                        f.write("\n")
         if result is not None and args.placement_dump:
             from ..parity import placement_dump, save_dump
 
@@ -186,6 +226,32 @@ def cmd_server(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    """Render a saved registry snapshot (apply --metrics-out, or the metadata
+    of a --trace-out Chrome trace) as Prometheus text on stdout."""
+    import json
+
+    from ..obs import render_text_from_snapshot
+
+    try:
+        with open(args.snapshot) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"metrics error: {e}", file=sys.stderr)
+        return 1
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        doc = (doc.get("metadata") or {}).get("metrics")
+        if not doc:
+            print("metrics error: trace file carries no metrics snapshot",
+                  file=sys.stderr)
+            return 1
+    if not isinstance(doc, dict):
+        print("metrics error: not a metrics snapshot", file=sys.stderr)
+        return 1
+    sys.stdout.write(render_text_from_snapshot(doc))
+    return 0
+
+
 def cmd_version(_args) -> int:
     print(f"Version: {__version__}")
     print(f"Commit: {COMMIT_ID}")
@@ -228,6 +294,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     handlers = {
         "apply": cmd_apply,
         "lint": cmd_lint,
+        "metrics": cmd_metrics,
         "server": cmd_server,
         "version": cmd_version,
         "gen-doc": cmd_gen_doc,

@@ -1,0 +1,25 @@
+"""simonmetrics: first-party observability for the TPU scheduling engine.
+
+- `obs.metrics` — process-wide thread-safe registry (Counter / Gauge /
+  Histogram with fixed buckets, labels, zero deps), Prometheus-text
+  rendering for the server's `GET /metrics`, JSON snapshots for
+  `--metrics-out`, bench rows, and `/debug/vars`.
+- `obs.instruments` — the metric catalog (scheduler-parity names mapped to
+  kube-scheduler's in PARITY.md) plus the compile-cache dispatch tracker
+  and the jax.monitoring backend-compile listener.
+- `obs.chrome` — Chrome trace-event (perfetto-loadable) export of
+  utils/trace.Span trees for `--trace-out FILE.json`.
+
+Instrumentation lives on the HOST side of the device boundary by contract:
+the `metric-in-jit` simonlint rule rejects registry mutations or wall-clock
+reads inside jit/scan bodies.
+"""
+
+from .metrics import (  # noqa: F401
+    REGISTRY,
+    Registry,
+    counter,
+    gauge,
+    histogram,
+    render_text_from_snapshot,
+)

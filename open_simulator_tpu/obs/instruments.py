@@ -1,0 +1,196 @@
+"""The metric catalog: every counter/gauge/histogram the engine family emits.
+
+One module owns the names so the README catalog, the PARITY.md mapping to
+kube-scheduler's metrics, and the call sites cannot drift apart. Everything
+here is host-side and jax-free at import; the one JAX touchpoint
+(`install_jax_monitoring`) is called lazily from Simulator.__init__, after
+the engine has already decided to import jax.
+
+kube-scheduler parity (PARITY.md "Metrics parity" for the full table):
+`simon_scheduling_attempts_total{result}` ↔ `schedule_attempts_total`,
+`simon_e2e_scheduling_duration_seconds` ↔ `e2e_scheduling_duration_seconds`,
+`simon_filter_rejections_total{reason}` ↔ the per-extension-point failure
+accounting behind `PodUnschedulable` events; the compile-cache / transfer /
+segment metrics are XLA-native with no k8s analog.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Set, Tuple
+
+from .metrics import PODS_BUCKETS, SECONDS_BUCKETS, counter, histogram
+
+# ------------------------------------------------------------------ engine ----
+
+SCHED_ATTEMPTS = counter(
+    "simon_scheduling_attempts_total",
+    "Pod scheduling attempts by outcome (kube-scheduler "
+    "schedule_attempts_total). bound = pre-bound direct commit; homeless = "
+    "bound to an unknown node (dropped from reports, reference parity).",
+    ("result",))  # scheduled | unschedulable | bound | homeless
+E2E_SECONDS = histogram(
+    "simon_e2e_scheduling_duration_seconds",
+    "Wall seconds per schedule_pods call, end to end "
+    "(kube-scheduler e2e_scheduling_duration_seconds).",
+    buckets=SECONDS_BUCKETS)
+ENCODE_SECONDS = histogram(
+    "simon_encode_seconds",
+    "Host-side batch encode time (pods -> device tables) per scheduling run.",
+    buckets=SECONDS_BUCKETS)
+BATCH_PODS = histogram(
+    "simon_batch_pods",
+    "Pods per contiguous unbound scheduling run handed to the device.",
+    buckets=PODS_BUCKETS)
+SEGMENTS = counter(
+    "simon_segments_total",
+    "Device dispatch segments by kind (wave / spread / serial).",
+    ("kind",))
+SEGMENT_PODS = counter(
+    "simon_segment_pods_total",
+    "Pods carried by device dispatch segments, by segment kind.",
+    ("kind",))
+TRANSFER_BYTES = counter(
+    "simon_device_transfer_bytes_total",
+    "Host->device bytes staged for scheduling/probe table uploads.")
+COMMITS = counter(
+    "simon_commits_total",
+    "Pods committed onto nodes (placements materialized on cluster state). "
+    "Monotonic reconciliation: commits - simon_commit_rollbacks_total - "
+    "simon_preemption_victims_total = placements currently live.")
+COMMIT_ROLLBACKS = counter(
+    "simon_commit_rollbacks_total",
+    "Commits undone by preemption rewinds (the replay then re-commits and "
+    "re-counts them; see simon_commits_total for the reconciliation).")
+FILTER_REJECTIONS = counter(
+    "simon_filter_rejections_total",
+    "Per-node filter-stage rejections behind failed pods, keyed by the "
+    "FitError reason label (_reasons_from_stages) — the per-extension-point "
+    "failure accounting of kube-scheduler's framework metrics.",
+    ("reason",))
+
+# compile-cache accounting: a dispatch whose static shape signature was seen
+# before in this process hits the jit cache; a fresh signature compiles (or
+# loads the persistent XLA cache). Ground truth backend compiles come from
+# install_jax_monitoring below.
+COMPILE_HITS = counter(
+    "simon_compile_cache_hits_total",
+    "Kernel dispatches whose static shape bucket was already compiled.",
+    ("kernel",))
+COMPILE_MISSES = counter(
+    "simon_compile_cache_misses_total",
+    "Kernel dispatches that triggered a fresh compile, with the shape "
+    "bucket that triggered it.",
+    ("kernel", "shape"))
+XLA_COMPILES = counter(
+    "simon_xla_backend_compiles_total",
+    "XLA backend compiles observed via jax.monitoring (all programs).")
+XLA_COMPILE_SECONDS = counter(
+    "simon_xla_backend_compile_seconds_total",
+    "Total XLA backend compile wall seconds (jax.monitoring).")
+
+# ------------------------------------------------------------------- probe ----
+
+PROBE_SESSIONS = counter(
+    "simon_probe_sessions_total",
+    "Incremental ProbeSessions built (encode-once capacity probing).")
+PROBE_PROBES = counter(
+    "simon_probe_candidates_total",
+    "Candidate node counts evaluated through ProbeSession.probe_many.")
+PROBE_DISPATCHES = counter(
+    "simon_probe_dispatches_total",
+    "Device round-trips spent on capacity probing (fan-out dispatches).")
+PROBE_ENCODES = counter(
+    "simon_probe_encodes_total",
+    "Pod-batch encodes paid by probe sessions (1 per session on the "
+    "incremental path).")
+PROBE_ENCODE_SECONDS = counter(
+    "simon_probe_encode_seconds_total",
+    "One-time session build/encode wall seconds.")
+PROBE_EXTENSIONS = counter(
+    "simon_probe_extensions_total",
+    "Template-column node-axis extensions (bucket outgrown mid-search).")
+PROBE_FANOUT = histogram(
+    "simon_probe_fanout_width",
+    "Candidate lanes per fan-out dispatch (post power-of-two quantization).",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0))
+
+# -------------------------------------------------------------- preemption ----
+
+PREEMPT_ATTEMPTS = counter(
+    "simon_preemption_attempts_total",
+    "PostFilter runs for failed pods, by outcome (kube-scheduler "
+    "preemption_attempts_total).",
+    ("outcome",))  # nominated | no_candidates
+PREEMPT_VICTIMS = counter(
+    "simon_preemption_victims_total",
+    "Pods evicted by preemption (kube-scheduler preemption_victims).")
+PREEMPT_REPLAY_PODS = counter(
+    "simon_preemption_replay_pods_total",
+    "Pods re-scheduled by rewind/replay passes — the simulator-specific "
+    "cost of exact mid-batch preemption (PARITY.md cost envelope).")
+
+# ---------------------------------------------------------- capacity search ---
+
+CAPACITY_SEARCHES = counter(
+    "simon_capacity_searches_total",
+    "Add-node capacity-planner searches, by probe path.",
+    ("path",))  # incremental | fresh
+CAPACITY_ROUNDS = counter(
+    "simon_capacity_search_rounds_total",
+    "Search rounds (device dispatches) spent by capacity searches.")
+
+# ------------------------------------------------- dispatch shape tracking ----
+
+_SEEN_SHAPES: Set[Tuple] = set()
+_SEEN_LOCK = threading.Lock()
+
+
+def record_dispatch(kernel: str, **dims) -> bool:
+    """Count one kernel dispatch against the compile cache: the first time a
+    (kernel, static-shape) signature is seen in this process it is a miss
+    (XLA compiles or loads the persistent cache), afterwards a hit. `dims`
+    must contain exactly the dispatch's static/shape-defining parts — traced
+    values never belong here. Returns True on miss (fresh compile)."""
+    key = (kernel,) + tuple(sorted(dims.items()))
+    with _SEEN_LOCK:
+        miss = key not in _SEEN_SHAPES
+        if miss:
+            _SEEN_SHAPES.add(key)
+    if miss:
+        shape = ",".join(f"{k}={v}" for k, v in sorted(dims.items()))
+        COMPILE_MISSES.labels(kernel=kernel, shape=shape).inc()
+    else:
+        COMPILE_HITS.labels(kernel=kernel).inc()
+    return miss
+
+
+def record_filter_reasons(reasons: Dict[str, int]) -> None:
+    """Fold one failed pod's FitError reason counts (label -> node count)
+    into the rejection counters."""
+    for label, n in reasons.items():
+        FILTER_REJECTIONS.labels(reason=label).inc(n)
+
+
+_jaxmon_installed = False
+
+
+def install_jax_monitoring() -> None:
+    """Register the jax.monitoring listener that counts real XLA backend
+    compiles (idempotent; safe when jax is absent/old). Called from
+    Simulator.__init__, which has already committed to importing jax."""
+    global _jaxmon_installed
+    if _jaxmon_installed:
+        return
+    _jaxmon_installed = True
+    try:
+        from jax import monitoring
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            if event == "/jax/core/compile/backend_compile_duration":
+                XLA_COMPILES.inc()
+                XLA_COMPILE_SECONDS.inc(duration)
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # monitoring is diagnostics; never break the engine
+        pass

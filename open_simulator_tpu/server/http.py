@@ -18,6 +18,7 @@ which trades the informer cache for zero dependencies.
 from __future__ import annotations
 
 import json
+import os
 import time
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -33,6 +34,45 @@ from ..utils.objutil import labels_of, name_of, namespace_of, owner_references
 def owned_by_workload(refs: List[dict], kind: str, name: str) -> bool:
     """OwnedByWorkload (utils.go:840-865): owner-ref kind+name match."""
     return any(r.get("kind") == kind and r.get("name") == name for r in refs)
+
+
+def sample_stacks(seconds: float, interval: float = 0.01,
+                  top: int = 50) -> str:
+    """Sampling profiler over sys._current_frames(): every `interval`, snap
+    the stack of every thread except the caller's, aggregate identical
+    stacks, and render the `top` hottest with sample counts — the
+    /debug/pprof/profile payload. A sampler sees application work on ANY
+    thread (request handlers, the scheduling engine, background pollers),
+    which a tracing profiler enabled around a sleep never could."""
+    import sys
+    import traceback
+
+    me = threading.get_ident()
+    counts: dict = {}
+    samples = 0
+    deadline = time.perf_counter() + max(0.0, seconds)
+    while True:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = tuple(
+                f"{os.path.basename(fs.filename)}:{fs.lineno} {fs.name}"
+                for fs in traceback.extract_stack(frame))
+            counts[stack] = counts.get(stack, 0) + 1
+        samples += 1
+        if time.perf_counter() >= deadline:
+            break
+        time.sleep(interval)
+    lines = [
+        f"stack samples: {samples} over {seconds:g}s "
+        f"({len(counts)} distinct stacks, all threads except profiler)",
+        "",
+    ]
+    for stack, n in sorted(counts.items(), key=lambda kv: -kv[1])[:top]:
+        lines.append(f"{n} sample(s):")
+        lines.extend(f"    {fr}" for fr in stack)
+        lines.append("")
+    return "\n".join(lines)
 
 
 class ClusterSnapshot:
@@ -207,25 +247,32 @@ class Server:
             def do_GET(self):
                 if self.path == "/healthz":
                     self._send(200, {"message": "ok"})
+                elif self.path == "/metrics" or self.path.startswith("/metrics?"):
+                    # Prometheus scrape surface (the reference mounts
+                    # kube-scheduler's metrics handler; server.go:152) —
+                    # everything obs/instruments.py accumulates, text format
+                    from ..obs import REGISTRY
+
+                    data = REGISTRY.render_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
                 elif self.path.startswith("/debug/pprof/profile"):
                     # pprof-style CPU profile (server.go:152 registers pprof):
-                    # sample this process for ?seconds=N (default 5), return
-                    # pstats dump text sorted by cumulative time
-                    import cProfile
-                    import io
-                    import pstats
-                    import time as _t
+                    # sample ALL threads' stacks for ?seconds=N (default 5)
+                    # and return flat hot-stack counts. The previous
+                    # cProfile.enable(); sleep(); disable() only profiled the
+                    # sleeping handler thread, so the dump never contained
+                    # application work.
                     from urllib.parse import parse_qs, urlparse
 
                     q = parse_qs(urlparse(self.path).query)
                     seconds = min(float((q.get("seconds") or ["5"])[0]), 60.0)
-                    pr = cProfile.Profile()
-                    pr.enable()
-                    _t.sleep(seconds)
-                    pr.disable()
-                    buf = io.StringIO()
-                    pstats.Stats(pr, stream=buf).sort_stats("cumulative").print_stats(50)
-                    data = buf.getvalue().encode()
+                    data = sample_stacks(seconds).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
                     self.send_header("Content-Length", str(len(data)))
@@ -233,9 +280,11 @@ class Server:
                     self.wfile.write(data)
                 elif self.path == "/debug/vars":
                     # the profiling surface the reference exposes via pprof
-                    # (server.go:152): uptime, rss, and recent traced phases
+                    # (server.go:152): uptime, rss, recent traced phases, and
+                    # the flat metrics-registry view
                     import resource
 
+                    from ..obs import REGISTRY
                     from ..utils.trace import recent_spans
 
                     started = getattr(server, "_t_start", None)
@@ -244,6 +293,7 @@ class Server:
                             round(time.time() - started, 3) if started else None),
                         "max_rss_kb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
                         "recent_traces": recent_spans(),
+                        "metrics": REGISTRY.values(),
                     })
                 elif self.path == "/test":
                     self.send_response(200)

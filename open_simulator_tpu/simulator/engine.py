@@ -24,11 +24,14 @@ Behavioral parity notes:
 from __future__ import annotations
 
 import copy
+import dataclasses
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..core import constants as C
+from ..obs import instruments as obs
 from ..core.types import AppResource, NodeStatus, ResourceTypes, SimulateResult, UnscheduledPod
 from ..algo.queues import sort_affinity, sort_toleration
 from ..models.workloads import generate_valid_pods_from_app
@@ -75,6 +78,13 @@ def _jax():
 
         _jnp = jnp
     return _jnp
+
+
+def batch_tables_nbytes(bt: BatchTables) -> int:
+    """Host bytes a BatchTables stages for device transfer (tables + seeds) —
+    the simon_device_transfer_bytes_total accounting unit."""
+    return sum(v.nbytes for f in dataclasses.fields(bt)
+               if isinstance(v := getattr(bt, f.name), np.ndarray))
 
 
 class ClusterModel:
@@ -150,6 +160,9 @@ class Simulator:
         # persistent XLA cache: fresh processes (CLI runs, server workers)
         # reuse compiled scan kernels instead of re-paying 15-40s per shape
         enable_compilation_cache()
+        # ground-truth XLA compile counting (obs/instruments.py, idempotent);
+        # this constructor has already committed to importing jax
+        obs.install_jax_monitoring()
 
         self.sched_config = sched_config or DEFAULT_SCHEDULER_CONFIG
         self.score_w = kernels.ScoreWeights(**self.sched_config.weight_kwargs())
@@ -291,11 +304,15 @@ class Simulator:
         With uniform priorities preemption is provably inert — no victim can
         have strictly lower priority — so the single-pass batched run is used
         unchanged."""
-        if self._track_priorities(pods):
-            from .preemption import schedule_with_preemption
+        t0 = time.perf_counter()
+        try:
+            if self._track_priorities(pods):
+                from .preemption import schedule_with_preemption
 
-            return schedule_with_preemption(self, pods)
-        return self._schedule_pods_inner(pods)
+                return schedule_with_preemption(self, pods)
+            return self._schedule_pods_inner(pods)
+        finally:
+            obs.E2E_SECONDS.observe(time.perf_counter() - t0)
 
     def _track_priorities(self, pods: List[dict]) -> bool:
         """Arm the PostFilter when >1 distinct priority has been seen across
@@ -335,8 +352,11 @@ class Simulator:
                 # them from every report; we keep them findable on self.homeless.
                 pod.pop(SIG_MEMO_KEY, None)
                 self.homeless.append(pod)
+                obs.SCHED_ATTEMPTS.labels(result="homeless").inc()
             else:
                 self._commit_pod(pod, ni, scheduled=False)
+                obs.SCHED_ATTEMPTS.labels(result="bound").inc()
+                obs.COMMITS.inc()
         failed.extend(self._schedule_run(run))
         progress.close()
         if self.gpu_host.enabled:
@@ -525,24 +545,44 @@ class Simulator:
         return segs
 
     def _schedule_run(self, to_schedule: List[dict]) -> List[UnscheduledPod]:
+        from ..utils.trace import Span
+
         failed: List[UnscheduledPod] = []
         if not to_schedule:
             return failed
 
         if self.na.N == 0:
+            obs.SCHED_ATTEMPTS.labels(result="unschedulable").inc(len(to_schedule))
             return [
                 UnscheduledPod(pod, self._format_reason(pod, {}, 0))
                 for pod in to_schedule
             ]
 
-        bt = self.encode_batch(to_schedule)
-        tables, carry = self._to_device(bt)
+        with Span("schedule_run", log_if_longer=30.0) as span:
+            t_enc = time.perf_counter()
+            bt = self.encode_batch(to_schedule)
+            obs.ENCODE_SECONDS.observe(time.perf_counter() - t_enc)
+            obs.BATCH_PODS.observe(len(to_schedule))
+            span.step("encode")
+            tables, carry = self._to_device(bt)
+            span.step("to_device")
+            failed = self._dispatch_and_commit(to_schedule, bt, tables, carry,
+                                               span)
+        return failed
+
+    def _dispatch_and_commit(self, to_schedule: List[dict], bt: BatchTables,
+                             tables, carry, span) -> List[UnscheduledPod]:
+        failed: List[UnscheduledPod] = []
         enable_gpu, enable_storage = plugin_flags(bt)
         self._last_flags = (enable_gpu, enable_storage)
         jnp = _jax()
         P = len(to_schedule)
         choices = np.full(P, -1, np.int32)  # node indices; matches the kernels' i32 outputs
         segs = self._segments(bt, P) if self.use_waves else [("serial", 0, P)]
+        dims = self._dispatch_dims(bt)
+        for seg in segs:
+            obs.SEGMENTS.labels(kind=seg[0]).inc()
+            obs.SEGMENT_PODS.labels(kind=seg[0]).inc(seg[2])
         # Dispatch every segment asynchronously and fetch ONE concatenated
         # result at the end: the chip may sit behind a tunnel, so a per-segment
         # np.asarray costs a full round trip — 50 segments used to spend ~7s
@@ -559,6 +599,9 @@ class Simulator:
                 fn[:length] = bt.forced_node[start:start + length]
                 vd = np.zeros(pad, bool)
                 vd[:length] = True
+                obs.record_dispatch("schedule_batch", P=pad, zones=bt.n_zones,
+                                    gpu=enable_gpu, storage=enable_storage,
+                                    **dims)
                 carry, ch = kernels.schedule_batch(
                     tables, carry, jnp.asarray(pg), jnp.asarray(fn), jnp.asarray(vd),
                     n_zones=bt.n_zones, enable_gpu=enable_gpu,
@@ -571,17 +614,23 @@ class Simulator:
                 if spread_wave and not ss_live and not sa_live:
                     # DNS-only live spread: epoch-batched wave (many pods per
                     # device iteration) instead of one-pod-per-scan-step
+                    block = kernels.wave_block_for(length, self.na.N)
+                    obs.record_dispatch("schedule_spread_wave", block=block,
+                                        **dims)
                     carry, counts, _ = kernels.schedule_spread_wave(
                         tables, carry, jnp.int32(g), jnp.int32(length),
                         jnp.asarray(cap1), w=self.score_w,
                         filters=self.filter_flags,
-                        block=kernels.wave_block_for(length, self.na.N),
+                        block=block,
                     )
                     outs.append((seg, counts, carry))
                     continue
                 pad = bucket_capped(length, 2048)
                 vd = np.zeros(pad, bool)
                 vd[:length] = True
+                obs.record_dispatch("schedule_group_serial", P=pad, ss=ss_live,
+                                    sa=sa_live,
+                                    zones=bt.n_zones if ss_live else 2, **dims)
                 carry, counts, _ = kernels.schedule_group_serial(
                     tables, carry, jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1),
                     w=self.score_w, filters=self.filter_flags,
@@ -593,13 +642,17 @@ class Simulator:
                 outs.append((seg, counts, carry))
             else:
                 _, start, length, g, cap1, gpu_live = seg
+                block = kernels.wave_block_for(length, self.na.N)
+                obs.record_dispatch("schedule_wave", block=block,
+                                    gpu_live=gpu_live, **dims)
                 carry, counts, _ = kernels.schedule_wave(
                     tables, carry, jnp.int32(g), jnp.int32(length),
                     jnp.asarray(cap1), gpu_live=gpu_live,
                     w=self.score_w, filters=self.filter_flags,
-                    block=kernels.wave_block_for(length, self.na.N),
+                    block=block,
                 )
                 outs.append((seg, counts, carry))
+        span.step("dispatch")
         final_carry = carry
         seg_of = np.zeros(P, np.int32)
         if outs:
@@ -633,6 +686,7 @@ class Simulator:
             seg_carry_of = {}
         outs = None  # drop the per-segment carry references
         self._last_tables, self._last_carry = bt, final_carry
+        span.step("fetch")
 
         progress = getattr(self, "_progress", None)
         reason_cache: Dict[Tuple[int, int, int], Dict[str, int]] = {}
@@ -654,7 +708,14 @@ class Simulator:
                         seg_carry_of.get(int(seg_of[i]), final_carry)
                     )
                 pod.pop(SIG_MEMO_KEY, None)
+                obs.record_filter_reasons(reasons)
                 failed.append(UnscheduledPod(pod, self._format_reason(pod, reasons, self.na.N)))
+        placed_n = P - len(failed)
+        obs.SCHED_ATTEMPTS.labels(result="scheduled").inc(placed_n)
+        if failed:
+            obs.SCHED_ATTEMPTS.labels(result="unschedulable").inc(len(failed))
+        obs.COMMITS.inc(placed_n)
+        span.step("commit")
         return failed
 
     # ------------------------------------------------------------- probing -------
@@ -703,6 +764,7 @@ class Simulator:
         jnp = _jax()
         P = len(run)
         segs = self._segments(bt, P) if self.use_waves else [("serial", 0, P)]
+        dims = self._dispatch_dims(bt)
         placed_parts = []
         for seg in segs:
             if seg[0] == "serial":
@@ -714,6 +776,9 @@ class Simulator:
                 fn[:length] = bt.forced_node[start:start + length]
                 vd = np.zeros(pad, bool)
                 vd[:length] = True
+                obs.record_dispatch("schedule_batch", P=pad, zones=bt.n_zones,
+                                    gpu=enable_gpu, storage=enable_storage,
+                                    **dims)
                 carry, ch = kernels.schedule_batch(
                     tables, carry, jnp.asarray(pg), jnp.asarray(fn), jnp.asarray(vd),
                     n_zones=bt.n_zones, enable_gpu=enable_gpu,
@@ -724,17 +789,23 @@ class Simulator:
             elif seg[0] == "spread":
                 _, start, length, g, cap1, ss_live, sa_live, spread_wave = seg
                 if spread_wave and not ss_live and not sa_live:
+                    block = kernels.wave_block_for(length, self.na.N)
+                    obs.record_dispatch("schedule_spread_wave", block=block,
+                                        **dims)
                     carry, _, placed = kernels.schedule_spread_wave(
                         tables, carry, jnp.int32(g), jnp.int32(length),
                         jnp.asarray(cap1), w=self.score_w,
                         filters=self.filter_flags,
-                        block=kernels.wave_block_for(length, self.na.N),
+                        block=block,
                     )
                     placed_parts.append(placed)
                     continue
                 pad = bucket_capped(length, 2048)
                 vd = np.zeros(pad, bool)
                 vd[:length] = True
+                obs.record_dispatch("schedule_group_serial", P=pad, ss=ss_live,
+                                    sa=sa_live,
+                                    zones=bt.n_zones if ss_live else 2, **dims)
                 carry, _, placed = kernels.schedule_group_serial(
                     tables, carry, jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1),
                     w=self.score_w, filters=self.filter_flags,
@@ -746,11 +817,14 @@ class Simulator:
                 placed_parts.append(placed)
             else:
                 _, start, length, g, cap1, gpu_live = seg
+                block = kernels.wave_block_for(length, self.na.N)
+                obs.record_dispatch("schedule_wave", block=block,
+                                    gpu_live=gpu_live, **dims)
                 carry, _, placed = kernels.schedule_wave(
                     tables, carry, jnp.int32(g), jnp.int32(length),
                     jnp.asarray(cap1), gpu_live=gpu_live,
                     w=self.score_w, filters=self.filter_flags,
-                    block=kernels.wave_block_for(length, self.na.N),
+                    block=block,
                 )
                 placed_parts.append(placed)
         self._last_tables, self._last_carry = bt, carry
@@ -808,10 +882,26 @@ class Simulator:
         self._mesh = mesh
         return mesh
 
+    def _dispatch_dims(self, bt: BatchTables) -> Dict[str, object]:
+        """Static shape parts shared by every kernel dispatch over this
+        batch's tables — the compile-cache signature base for
+        obs.record_dispatch. Only static/shape-defining values belong here;
+        traced values never key a compile. `cfg` digests the score-weight and
+        filter-flag NamedTuples, which are jit statics on every kernel: two
+        simulators with different sched_configs must not alias signatures."""
+        return {
+            "N": int(bt.alloc.shape[0]),
+            "G": int(bt.static_mask.shape[0]),
+            "T": int(bt.counter_dom.shape[0]),
+            "mesh": self._mesh is not None and self._mesh is not _UNSET,
+            "cfg": f"{hash((self.score_w, self.filter_flags)) & 0xffffffff:08x}",
+        }
+
     def _to_device(self, bt: BatchTables):
         jnp = _jax()
         from ..parallel.mesh import tables_from_batch
 
+        obs.TRANSFER_BYTES.inc(batch_tables_nbytes(bt))
         mesh = self._resolve_mesh()
         if mesh is not None:
             from ..parallel.mesh import to_device_sharded

@@ -60,6 +60,7 @@ import numpy as np
 
 from ..core import constants as C
 from ..core.types import UnscheduledPod
+from ..obs import instruments as obs
 from ..ops import kernels
 from ..utils.objutil import labels_of, match_label_selector, name_of, namespace_of
 from .encode import (
@@ -134,6 +135,9 @@ def restore(sim, snap: dict) -> None:
             else:
                 anns[C.AnnoGpuAssumeTime] = prev_assume
         sim._sig_of.pop(id(pod), None)
+    rolled = len(sim._commits_prio) - snap["prio"]
+    if rolled > 0:
+        obs.COMMIT_ROLLBACKS.inc(rolled)
     del sim._commit_log[snap["log"]:]
     del sim._commits_prio[snap["prio"]:]
     del sim.preempted[snap["preempted"]:]
@@ -403,6 +407,7 @@ def evict(sim, victims: List[dict], node_i: int, preemptor: dict) -> None:
         sim.preempted.append({
             "pod": p, "node": sim.na.names[node_i], "by": name_of(preemptor),
         })
+    obs.PREEMPT_VICTIMS.inc(len(victims))
     if sim.gpu_host.enabled:
         sim.gpu_host.flush()
 
@@ -435,9 +440,12 @@ def schedule_with_preemption(sim, pods: List[dict]) -> List[UnscheduledPod]:
         if target is None:
             return recorded + failed
         restore(sim, snap)
+        obs.PREEMPT_REPLAY_PODS.inc(target)
         prefix_failed = sim._schedule_pods_inner(remaining[:target])
         pod = remaining[target]
         node_i, victims, reasons = try_preempt(sim, pod)
+        obs.PREEMPT_ATTEMPTS.labels(
+            outcome="nominated" if node_i >= 0 else "no_candidates").inc()
         if node_i >= 0:
             evict(sim, victims, node_i, pod)
             # evictions change the victim pool WITHOUT appending to
@@ -456,6 +464,7 @@ def schedule_with_preemption(sim, pods: List[dict]) -> List[UnscheduledPod]:
             pod, sim._format_reason(pod, reasons, sim.na.N)))
         remaining = remaining[target + 1:]
         snap = snapshot(sim)
+        obs.PREEMPT_REPLAY_PODS.inc(len(remaining))
         failed = sim._schedule_pods_inner(remaining)
         if not failed:
             return recorded

@@ -65,6 +65,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..models.fakenode import new_fake_nodes
+from ..obs import instruments as obs
 from ..ops.resources import CPU_I, MEM_I
 from .encode import (
     HOSTNAME,
@@ -189,6 +190,7 @@ class ProbeSession:
             self._bt_raw = None
             self._segs = []
             self.encode_s = time.perf_counter() - t0
+            self._record_build()
             return self
 
         # cheap census gate BEFORE the (dominant) batch encode: spread
@@ -219,7 +221,16 @@ class ProbeSession:
                       else [("serial", 0, P)])
         self._upload()
         self.encode_s = time.perf_counter() - t0
+        self._record_build()
         return self
+
+    def _record_build(self) -> None:
+        """Session-build accounting into the metrics registry (satellite to
+        the planner's stats dict: the registry survives the search, so
+        `capacity` CLI runs and the server report the same numbers)."""
+        obs.PROBE_SESSIONS.inc()
+        obs.PROBE_ENCODES.inc(self.encodes)
+        obs.PROBE_ENCODE_SECONDS.inc(self.encode_s)
 
     @staticmethod
     def _auto_mesh(fanout: int):
@@ -245,8 +256,11 @@ class ProbeSession:
     def _upload(self) -> None:
         """(Re-)pad and transfer the tables; rebuild per-segment batch arrays."""
         jnp = _jax()
+        from .engine import batch_tables_nbytes
+
         bt = pad_encoder_axes(self._bt_raw)
         bt = pad_batch_tables(bt, bucket_capped(self.n_base + self.n_new, 1024))
+        obs.TRANSFER_BYTES.inc(batch_tables_nbytes(bt))
         self._bt = bt
         self._n_pad = bt.alloc.shape[0]
         from ..parallel.mesh import tables_from_batch
@@ -289,6 +303,7 @@ class ProbeSession:
              np.repeat(self._alloc[self.n_base:self.n_base + 1], k, axis=0)])
         self.n_new += k
         self.extensions += 1
+        obs.PROBE_EXTENSIONS.inc()
         if self._bt_raw is not None:
             self._upload()
 
@@ -334,6 +349,7 @@ class ProbeSession:
         if bad:
             raise ValueError(f"candidates {bad} exceed capacity {self.n_new}")
 
+        obs.PROBE_PROBES.inc(len(order))
         if not self._segs:  # no unbound pods: pure host arithmetic
             return {n: (self.bound_scheduled, self.total_known,
                         self._utilization(n, None)) for n in order}
@@ -347,6 +363,8 @@ class ProbeSession:
         S = 1
         while S < len(order):
             S *= 2
+        obs.PROBE_DISPATCHES.inc()
+        obs.PROBE_FANOUT.observe(S)
         lanes = order + [order[-1]] * (S - len(order))
         active_s = np.zeros((S, self._n_pad), bool)
         for i, n in enumerate(lanes):
@@ -396,6 +414,12 @@ class ProbeSession:
         sim, bt = self._sim, self._bt
         enable_gpu, enable_storage = self._flags
         n_real = self.n_base + self.n_new
+        dims = {"S": int(active_s.shape[0]), "N": int(self._n_pad),
+                "G": int(bt.static_mask.shape[0]),
+                "T": int(bt.counter_dom.shape[0]),
+                "mesh": self._mesh is not None,
+                # w/filters are jit statics on the fan-out kernels too
+                "cfg": f"{hash((sim.score_w, sim.filter_flags)) & 0xffffffff:08x}"}
         placed_parts = []
         with ctx:
             for seg in self._segs:
@@ -408,6 +432,9 @@ class ProbeSession:
                     fn[:length] = bt.forced_node[start:start + length]
                     vd = np.zeros(pad, bool)
                     vd[:length] = True
+                    obs.record_dispatch(
+                        "probe_serial_fanout", P=pad, zones=bt.n_zones,
+                        gpu=enable_gpu, storage=enable_storage, **dims)
                     carry_s, placed = kernels.probe_serial_fanout(
                         self._tables, carry_s, active,
                         jnp.asarray(pg), jnp.asarray(fn), jnp.asarray(vd),
@@ -422,6 +449,9 @@ class ProbeSession:
                     pad = bucket_capped(length, 2048)
                     vd = np.zeros(pad, bool)
                     vd[:length] = True
+                    obs.record_dispatch(
+                        "probe_group_serial_fanout", P=pad, ss=ss_live,
+                        sa=sa_live, zones=bt.n_zones if ss_live else 2, **dims)
                     carry_s, placed = kernels.probe_group_serial_fanout(
                         self._tables, carry_s, active,
                         jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1),
@@ -431,12 +461,15 @@ class ProbeSession:
                     )
                 else:
                     _, start, length, g, cap1, gpu_live = seg
+                    block = kernels.wave_block_for(length, n_real)
+                    obs.record_dispatch("probe_wave_fanout", block=block,
+                                        gpu_live=gpu_live, **dims)
                     carry_s, placed = kernels.probe_wave_fanout(
                         self._tables, carry_s, active,
                         jnp.int32(g), jnp.int32(length), jnp.asarray(cap1),
                         gpu_live=gpu_live, w=sim.score_w,
                         filters=sim.filter_flags,
-                        block=kernels.wave_block_for(length, n_real),
+                        block=block,
                     )
                 placed_parts.append(placed)
             placed_s = np.asarray(jnp.sum(jnp.stack(placed_parts), axis=0))

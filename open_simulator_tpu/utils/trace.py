@@ -4,41 +4,66 @@ The reference instruments Simulate with k8s.io/utils/trace spans — e.g.
 `utiltrace.New("Simulate")` logged when a step exceeds 1s (pkg/simulator/
 core.go:67-73) and the live-cluster fetch spinner at 100ms
 (pkg/simulator/simulator.go:506-512). This is the same idea without the
-vendored package: nested steps, wall-clock per step, and a single log line
-(via `logging`) when the span outlives its threshold. Recent spans are kept in
-a small ring so the server's /debug/vars endpoint can expose them.
+vendored package, upgraded past it in three ways:
+
+- **Nestable.** A Span entered while another is active (same thread /
+  context) attaches to that parent as a child instead of registering as a
+  sibling, via a contextvar — `recent_spans()` and the Chrome trace export
+  (obs/chrome.py) show the hierarchy the way utiltrace's nestedSteps do.
+- **Exception-safe.** A body that raises still records its partial step list
+  and total, flagged `failed=True`, and the active-span stack unwinds
+  correctly (the reference's trace.LogIfLong runs in a defer).
+- **Collectable.** `start_collection()` retains every finished ROOT span
+  (children ride along) beyond the 32-entry ring, for `--trace-out`'s
+  Chrome trace-event dump.
+
+Recent root spans are kept in a small ring so the server's /debug/vars
+endpoint can expose them.
 """
 
 from __future__ import annotations
 
+import contextvars
 import logging
 import threading
 import time
 from collections import deque
-from typing import Deque, List, Tuple
+from typing import Deque, List, Optional, Tuple
 
 log = logging.getLogger("open_simulator_tpu.trace")
 
-# (name, total_seconds, [(step_name, seconds), ...], logged)
-_RECENT: Deque[tuple] = deque(maxlen=32)
+_RECENT: Deque["Span"] = deque(maxlen=32)
 _LOCK = threading.Lock()
+_COLLECTED: Optional[List["Span"]] = None  # None = collection off
+
+# The active parent span of the current thread/context. contextvars give
+# correct nesting per server-handler thread and per asyncio task alike.
+_ACTIVE: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "open_simulator_tpu_active_span", default=None)
 
 
 class Span:
     """One traced phase. Use as a context manager; `step(name)` marks interior
     progress like utiltrace's trace.Step. On exit, logs when total wall time
-    exceeds `log_if_longer` seconds."""
+    exceeds `log_if_longer` seconds; nested use attaches to the enclosing
+    Span instead of the ring."""
 
     def __init__(self, name: str, log_if_longer: float = 1.0) -> None:
         self.name = name
         self.threshold = log_if_longer
         self.steps: List[Tuple[str, float]] = []
-        self._t0 = 0.0
+        self.children: List["Span"] = []
+        self.failed = False
+        self.t0 = 0.0       # perf_counter at __enter__ (shared clock for export)
+        self.tid = 0        # thread id at __enter__
         self._last = 0.0
         self.total = 0.0
+        self._token: Optional[contextvars.Token] = None
 
     def __enter__(self) -> "Span":
-        self._t0 = self._last = time.perf_counter()
+        self.t0 = self._last = time.perf_counter()
+        self.tid = threading.get_ident()
+        self._token = _ACTIVE.set(self)
         return self
 
     def step(self, name: str) -> None:
@@ -46,27 +71,72 @@ class Span:
         self.steps.append((name, now - self._last))
         self._last = now
 
-    def __exit__(self, *exc) -> None:
-        self.total = time.perf_counter() - self._t0
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.total = time.perf_counter() - self.t0
+        self.failed = exc_type is not None
+        parent: Optional[Span] = None
+        if self._token is not None:
+            _ACTIVE.reset(self._token)
+            self._token = None
+            parent = _ACTIVE.get()
         logged = self.total >= self.threshold
         if logged:
             detail = "; ".join(f"{n}: {dt * 1000:.0f}ms" for n, dt in self.steps)
-            log.warning("Trace %r took %.3fs (threshold %.3fs)%s",
-                        self.name, self.total, self.threshold,
+            log.warning("Trace %r %stook %.3fs (threshold %.3fs)%s",
+                        self.name, "FAILED and " if self.failed else "",
+                        self.total, self.threshold,
                         f" — {detail}" if detail else "")
+        self.logged = logged
+        if parent is not None and parent.tid == self.tid:
+            # same-context nesting: ride the parent; a span whose parent lives
+            # on another thread (executor handoff) registers as a root
+            parent.children.append(self)
+            return
         with _LOCK:
-            _RECENT.append((self.name, self.total, list(self.steps), logged))
+            _RECENT.append(self)
+            if _COLLECTED is not None:
+                _COLLECTED.append(self)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "seconds": round(self.total, 6),
+            "logged": getattr(self, "logged", False),
+            "failed": self.failed,
+            "steps": [{"name": sn, "seconds": round(st, 6)}
+                      for sn, st in self.steps],
+            "children": [c.to_dict() for c in self.children],
+        }
+
+
+def current_span() -> Optional[Span]:
+    """The innermost active Span of this context, or None."""
+    return _ACTIVE.get()
 
 
 def recent_spans() -> List[dict]:
-    """Snapshot for /debug/vars: most recent first."""
+    """Snapshot for /debug/vars: most recent ROOT spans first, children
+    nested under their parents."""
     with _LOCK:
         items = list(_RECENT)
-    return [
-        {"name": n, "seconds": round(t, 6), "logged": lg,
-         "steps": [{"name": sn, "seconds": round(st, 6)} for sn, st in steps]}
-        for n, t, steps, lg in reversed(items)
-    ]
+    return [sp.to_dict() for sp in reversed(items)]
+
+
+def start_collection() -> None:
+    """Begin retaining every finished root span (for --trace-out). Clears any
+    previous collection."""
+    global _COLLECTED
+    with _LOCK:
+        _COLLECTED = []
+
+
+def stop_collection() -> List[Span]:
+    """End collection and return the retained root spans, oldest first."""
+    global _COLLECTED
+    with _LOCK:
+        out = _COLLECTED or []
+        _COLLECTED = None
+    return out
 
 
 class Progress:

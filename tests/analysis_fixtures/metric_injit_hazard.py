@@ -1,0 +1,69 @@
+"""simonlint fixture: metric-in-jit hazards. NEVER imported — analyzed as AST only."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+from open_simulator_tpu.obs.metrics import counter, histogram
+
+STEPS = counter("fixture_steps_total", "scan steps")
+LATENCY = histogram("fixture_latency_seconds", "latencies")
+
+
+@jax.jit
+def counts_per_compile(x):
+    STEPS.inc()  # FINDING: registry mutation under trace (runs once, at trace time)
+    return x + 1
+
+
+@jax.jit
+def bakes_a_timestamp(x):
+    t0 = time.perf_counter()  # FINDING: wall-clock read under trace
+    return x * t0
+
+
+@partial(jax.jit, static_argnames=("debug",))
+def observes_under_trace(x, debug):
+    y = jnp.sum(x)
+    LATENCY.observe(0.0)  # FINDING: histogram mutation under trace
+    return y
+
+
+@jax.jit
+def builds_metric_under_trace(x):
+    import open_simulator_tpu.obs.metrics as m
+
+    c = m.counter("fixture_inner_total", "constructed mid-trace")  # FINDING
+    return x
+
+
+def scan_user(xs):
+    def body(carry, x):
+        STEPS.inc()  # FINDING: mutation inside scan body
+        return carry + x, x
+
+    return jax.lax.scan(body, jnp.float32(0.0), xs)
+
+
+@jax.jit
+def at_set_is_fine(x):
+    # .set() via the functional-update idiom must NOT fire (the reason the
+    # rule's mutator list excludes bare .set)
+    return x.at[0].set(1.0)
+
+
+@jax.jit
+def suppressed_inc(x):
+    STEPS.inc()  # simonlint: ignore[metric-in-jit] -- fixture: tests suppression
+    return x
+
+
+def host_side_is_fine(x):
+    # not traced: dispatch-site instrumentation is exactly where this belongs
+    t0 = time.perf_counter()
+    out = at_set_is_fine(x)
+    LATENCY.observe(time.perf_counter() - t0)
+    STEPS.inc()
+    return out

@@ -81,16 +81,46 @@ def test_contract_spec_rule_fires():
     assert not any(f.line < 10 for f in hits)  # clean_kernel passes
 
 
+def test_metric_in_jit_rule_fires():
+    fr = analyze_file(str(FIXTURES / "metric_injit_hazard.py"))
+    hits = [f for f in fr.findings
+            if f.rule == "metric-in-jit" and not f.suppressed]
+    assert len(hits) == 5
+    msgs = "\n".join(f.message for f in hits)
+    assert ".inc()" in msgs
+    assert ".observe()" in msgs
+    assert "time.perf_counter()" in msgs
+    assert "open_simulator_tpu.obs.metrics.counter(...)" in msgs
+    # the waived inc is reported suppressed, not active
+    assert _counts("metric_injit_hazard.py", "metric-in-jit", suppressed=True) == 1
+
+
+def test_metric_in_jit_spares_at_set_and_host_code():
+    fr = analyze_file(str(FIXTURES / "metric_injit_hazard.py"))
+    hits = [f for f in fr.findings if f.rule == "metric-in-jit"]
+    # at_set_is_fine (the .at[].set functional-update idiom) and the
+    # host_side_is_fine dispatch-site instrumentation produce nothing
+    src = (FIXTURES / "metric_injit_hazard.py").read_text().splitlines()
+    ok_start = next(i for i, l in enumerate(src, 1)
+                    if "def at_set_is_fine" in l)
+    supp_start = next(i for i, l in enumerate(src, 1)
+                      if "def suppressed_inc" in l)
+    assert not any(ok_start <= f.line < supp_start for f in hits)
+    host_start = next(i for i, l in enumerate(src, 1)
+                      if "def host_side_is_fine" in l)
+    assert not any(f.line >= host_start for f in hits)
+
+
 def test_clean_module_is_clean():
     fr = analyze_file(str(FIXTURES / "clean_module.py"))
     assert fr.findings == []
 
 
-def test_fixture_tree_reports_all_four_families_and_fails():
+def test_fixture_tree_reports_all_families_and_fails():
     report = analyze_paths([str(FIXTURES)])
     fired = {f.rule for f in report.findings if not f.suppressed}
     assert {"host-sync-in-jit", "recompile-trigger",
-            "dtype-drift", "carry-contract"} <= fired
+            "dtype-drift", "carry-contract", "metric-in-jit"} <= fired
     assert report.active(Severity.WARNING)
     rc = run_lint([str(FIXTURES)])
     assert rc == 1

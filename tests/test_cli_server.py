@@ -68,6 +68,51 @@ def test_cli_apply_missing_config(capsys):
     assert "apply error" in capsys.readouterr().err
 
 
+def test_cli_apply_trace_and_metrics_out(tmp_path, monkeypatch):
+    """--trace-out writes a perfetto-loadable Chrome trace with nested engine
+    spans and the metrics snapshot; --metrics-out writes the snapshot alone;
+    `simon metrics` renders either as Prometheus text."""
+    monkeypatch.chdir(REPO)
+    trace_f = tmp_path / "trace.json"
+    metrics_f = tmp_path / "metrics.json"
+    rc = cli_main([
+        "apply", "-f", "examples/simon-smoke-config.yaml",
+        "--output-file", str(tmp_path / "report.txt"),
+        "--trace-out", str(trace_f), "--metrics-out", str(metrics_f),
+    ])
+    assert rc == 0
+    doc = json.loads(trace_f.read_text())  # valid JSON end to end
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and evs
+    names = {e["name"] for e in evs}
+    assert "Simulate" in names and "schedule_run" in names  # nested engine spans
+    assert all(e.get("ph") == "X" and "ts" in e and "dur" in e for e in evs)
+    snap = json.loads(metrics_f.read_text())
+    assert "simon_scheduling_attempts_total" in snap
+    assert doc["metadata"]["metrics"].keys() == snap.keys()
+
+
+def test_cli_metrics_renders_snapshot(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(REPO)
+    metrics_f = tmp_path / "metrics.json"
+    assert cli_main([
+        "apply", "-f", "examples/simon-smoke-config.yaml",
+        "--output-file", str(tmp_path / "report.txt"),
+        "--metrics-out", str(metrics_f),
+    ]) == 0
+    capsys.readouterr()
+    assert cli_main(["metrics", str(metrics_f)]) == 0
+    out = capsys.readouterr().out
+    assert "# TYPE simon_scheduling_attempts_total counter" in out
+    assert "simon_commits_total" in out
+    assert cli_main(["metrics", "/nonexistent.json"]) == 1
+    # a trace file WITHOUT an embedded snapshot is an error, not silent success
+    bare = tmp_path / "bare_trace.json"
+    bare.write_text('{"traceEvents": []}')
+    assert cli_main(["metrics", str(bare)]) == 1
+    assert "no metrics snapshot" in capsys.readouterr().err
+
+
 # -------------------------------------------------------------------- server --------
 
 
@@ -166,6 +211,43 @@ def test_http_round_trip():
         resp = conn.getresponse()
         assert resp.status == 400
         assert "fail to unmarshal" in json.loads(resp.read())
+    finally:
+        httpd.shutdown()
+
+
+def test_metrics_scrape_smoke():
+    """GET /metrics: Prometheus text with the scheduler-parity counters the
+    deploy request just moved."""
+    nodes = [make_node("n1"), make_node("n2")]
+    server = Server(snapshot_fn=lambda: _snapshot(nodes=nodes))
+    httpd = server.build_httpd(port=0, host="127.0.0.1")
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        deploy = make_deployment("scrape", replicas=2, cpu="1", memory="1Gi")
+        conn.request("POST", "/api/deploy-apps",
+                     body=json.dumps({"deployments": [deploy]}),
+                     headers={"Content-Type": "application/json"})
+        assert conn.getresponse().status == 200
+
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type").startswith("text/plain")
+        text = resp.read().decode()
+        assert "# TYPE simon_scheduling_attempts_total counter" in text
+        assert 'simon_scheduling_attempts_total{result="scheduled"}' in text
+        assert "# TYPE simon_e2e_scheduling_duration_seconds histogram" in text
+        assert "simon_commits_total" in text
+
+        # /debug/vars carries the flat view next to the recent traces
+        conn.request("GET", "/debug/vars")
+        body = json.loads(conn.getresponse().read())
+        assert "metrics" in body
+        assert any(k.startswith("simon_scheduling_attempts_total")
+                   for k in body["metrics"])
     finally:
         httpd.shutdown()
 

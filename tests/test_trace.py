@@ -24,6 +24,59 @@ def test_span_logs_only_over_threshold(caplog):
     assert spans[1]["name"] == "fast phase" and not spans[1]["logged"]
 
 
+def test_span_nesting_attaches_children_to_parent():
+    with Span("outer", log_if_longer=99.0) as outer:
+        with Span("inner", log_if_longer=99.0) as inner:
+            inner.step("work")
+        with Span("inner2", log_if_longer=99.0):
+            pass
+    assert [c.name for c in outer.children] == ["inner", "inner2"]
+    spans = recent_spans()
+    # only the ROOT registers in the ring; children nest under it
+    assert spans[0]["name"] == "outer"
+    assert [c["name"] for c in spans[0]["children"]] == ["inner", "inner2"]
+    assert spans[0]["children"][0]["steps"][0]["name"] == "work"
+    assert all(s["name"] != "inner" for s in spans)
+
+
+def test_span_exception_safety_records_partial_and_failed():
+    import pytest
+
+    with pytest.raises(RuntimeError):
+        with Span("outer", log_if_longer=99.0):
+            with pytest.raises(RuntimeError):
+                with Span("dies", log_if_longer=99.0) as sp:
+                    sp.step("before")
+                    raise RuntimeError("boom")
+            raise RuntimeError("outer dies too")
+    spans = recent_spans()
+    assert spans[0]["name"] == "outer" and spans[0]["failed"]
+    child = spans[0]["children"][0]
+    assert child["name"] == "dies" and child["failed"]
+    assert child["steps"][0]["name"] == "before"  # partial steps survive
+    # the active-span stack unwound: a fresh span is a root again
+    with Span("clean", log_if_longer=99.0):
+        pass
+    assert recent_spans()[0]["name"] == "clean"
+    assert not recent_spans()[0]["failed"]
+
+
+def test_span_collection_for_trace_export():
+    from open_simulator_tpu.utils.trace import start_collection, stop_collection
+
+    start_collection()
+    with Span("collected", log_if_longer=99.0):
+        with Span("kid", log_if_longer=99.0):
+            pass
+    out = stop_collection()
+    assert [s.name for s in out] == ["collected"]
+    assert [c.name for c in out[0].children] == ["kid"]
+    # collection is off again: nothing accumulates
+    with Span("later", log_if_longer=99.0):
+        pass
+    assert stop_collection() == []
+
+
 def test_simulate_emits_span():
     from open_simulator_tpu.core.types import AppResource, ResourceTypes
     from open_simulator_tpu.simulator.core import simulate
@@ -99,20 +152,42 @@ def test_server_debug_vars():
         httpd.shutdown()
 
 
-def test_server_debug_pprof_profile():
+def test_server_debug_pprof_profile_samples_other_threads():
+    """The sampler must see application work on OTHER threads — the bug this
+    replaces: cProfile around a sleep only ever profiled the sleeping
+    handler thread, so dumps were empty of application work."""
     import threading
     import urllib.request
 
     from open_simulator_tpu.server.http import Server
 
+    stop = threading.Event()
+
+    def busy_app_work():
+        while not stop.is_set():
+            sum(i * i for i in range(1000))
+
+    worker = threading.Thread(target=busy_app_work, daemon=True)
+    worker.start()
     srv = Server.__new__(Server)
     httpd = srv.build_httpd(port=0, host="127.0.0.1")
     port = httpd.server_address[1]
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     try:
         with urllib.request.urlopen(
-                f"http://127.0.0.1:{port}/debug/pprof/profile?seconds=0.1") as r:
+                f"http://127.0.0.1:{port}/debug/pprof/profile?seconds=0.3") as r:
             text = r.read().decode()
-        assert "cumulative" in text  # a pstats table came back
     finally:
+        stop.set()
         httpd.shutdown()
+    assert "stack samples:" in text
+    assert "busy_app_work" in text  # the application thread was captured
+
+
+def test_sample_stacks_excludes_caller_and_counts():
+    from open_simulator_tpu.server.http import sample_stacks
+
+    text = sample_stacks(0.05, interval=0.01)
+    assert text.startswith("stack samples:")
+    # the profiling thread itself never appears
+    assert "sample_stacks" not in text.split("\n", 1)[1]
