@@ -8,6 +8,7 @@ Rule ids (stable — they appear in suppression comments and CI output):
   carry-contract     lax.scan carry without (or violating) a NamedTuple contract
   contract-spec      malformed @shaped contract annotation
   metric-in-jit      metrics-registry mutation or wall-clock read under trace
+  swallowed-exception  broad except that neither re-raises, returns, logs, nor counts
 
 Every rule is a pure function ModuleContext -> List[Finding]; file IO,
 suppressions, and exit-code policy live in runner.py.
@@ -410,6 +411,85 @@ def rule_metric_in_jit(ctx: ModuleContext) -> List[Finding]:
                     f"must stay host-side of the device boundary (move the "
                     f"registry update / clock read to the dispatch site)",
                 ))
+    return out
+
+
+# -------------------------------------------------------- swallowed-exception --
+
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log", "log_message"}
+_COUNT_METHODS = {"inc", "observe", "set", "labels"}
+_REPORT_CALLS = {"print"}  # plus sys.exit / os._exit via resolve below
+_EXIT_CALLS = {"sys.exit", "os._exit", "os.abort"}
+
+
+def _walk_no_defs(stmts):
+    """Walk statements without descending into nested defs/lambdas (a nested
+    function that raises is a definition, not handling)."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except:
+    elems = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any(isinstance(e, ast.Name) and e.id in ("Exception", "BaseException")
+               for e in elems)
+
+
+def _handler_handles(ctx: ModuleContext, handler: ast.ExceptHandler) -> bool:
+    for node in _walk_no_defs(handler.body):
+        if isinstance(node, (ast.Raise, ast.Return)):
+            return True
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in _REPORT_CALLS:
+            return True
+        if isinstance(f, ast.Attribute) and (
+                f.attr in _LOG_METHODS or f.attr in _COUNT_METHODS):
+            return True
+        if (ctx.resolve(f) or "") in _EXIT_CALLS:
+            return True
+    return False
+
+
+@register(
+    "swallowed-exception", Severity.WARNING,
+    "A broad exception handler (bare except / except Exception/BaseException) "
+    "that neither re-raises, returns, logs, nor moves a metric. Silent "
+    "swallowing is how retryable failures, injected faults, and corrupted "
+    "state disappear from every observability surface — handle narrowly, or "
+    "whitelist deliberate best-effort blocks with "
+    "`# simonlint: ignore[swallowed-exception] -- <why>`.",
+)
+def rule_swallowed_exception(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            if not _handler_is_broad(handler):
+                continue
+            if _handler_handles(ctx, handler):
+                continue
+            what = ("bare except:" if handler.type is None
+                    else "except Exception" if not isinstance(handler.type, ast.Tuple)
+                    else "broad except tuple")
+            out.append(Finding(
+                "swallowed-exception", Severity.WARNING, ctx.path,
+                handler.lineno, handler.col_offset,
+                f"{what} swallows the error: the handler neither re-raises, "
+                f"returns, logs, nor counts — failures vanish silently "
+                f"(narrow the type, or log/count and whitelist)",
+            ))
     return out
 
 

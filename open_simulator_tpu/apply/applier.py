@@ -26,6 +26,7 @@ from ..core import constants as C
 from ..obs import instruments as obs
 from ..core.types import AppResource, NodeStatus, ResourceTypes, SimulateResult
 from ..models.fakenode import new_fake_nodes
+from ..resilience.policy import Deadline, check_deadline
 from ..simulator.core import simulate
 from ..utils.objutil import annotations_of, labels_of, name_of, namespace_of, pod_resource_requests
 from ..utils.quantity import format_quantity, parse_milli, parse_quantity
@@ -277,6 +278,9 @@ class CapacityPlanner:
         m = max(lb, 1)
 
         def eval_many(cands):
+            # every probe round re-checks the --deadline budget: a search that
+            # cannot finish dies between dispatches, never mid-kernel
+            check_deadline("capacity_search")
             session.ensure_capacity(max(cands))
             res = session.probe_many(cands)
             self.stats["probes"] += len(res)
@@ -350,6 +354,7 @@ class CapacityPlanner:
         self.stats["path"] = "fresh"
 
         def probe(n):
+            check_deadline("capacity_search")  # per-candidate budget check
             self.stats["probes"] += 1
             self.stats["dispatches"] += 1
             return self.probe(n)
@@ -391,6 +396,9 @@ class Options:
     interactive: bool = False
     extended_resources: List[str] = field(default_factory=list)
     output_file: str = ""
+    # wall-clock budget for the whole run (0 = unbounded): the capacity
+    # search and every full simulation slice it via the Deadline contextvar
+    deadline: float = 0.0
 
 
 class Applier:
@@ -447,6 +455,12 @@ class Applier:
     # ------------------------------------------------------------------- run ------
 
     def run(self) -> Optional[SimulateResult]:
+        if self.opts.deadline > 0:
+            with Deadline(self.opts.deadline):
+                return self._run_with_output()
+        return self._run_with_output()
+
+    def _run_with_output(self) -> Optional[SimulateResult]:
         # The output file is opened (and closed) per run so a reused Applier never
         # writes to a closed stream; without --output-file, self.out stays stdout.
         if self.opts.output_file:
@@ -486,6 +500,7 @@ class Applier:
         return result
 
     def _simulate_with(self, cluster, apps, new_node, n, patch_funcs) -> SimulateResult:
+        check_deadline("simulate")  # full runs slice the --deadline budget too
         trial = cluster.copy()
         trial.nodes = list(trial.nodes) + new_fake_nodes(new_node, n)
         return simulate(trial, apps, patch_pod_funcs=patch_funcs,

@@ -8,6 +8,7 @@ subcommands, flags (including shorthands), and the `LogLevel` env knob
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import os
 import sys
@@ -86,6 +87,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--metrics-out", default="", metavar="FILE.json",
         help="write the metrics-registry snapshot of the run as JSON "
              "(render later with `simon metrics FILE.json`)")
+    p_apply.add_argument(
+        "--deadline", type=float, default=0.0, metavar="SECONDS",
+        help="wall-clock budget for the whole run; the capacity search and "
+             "every simulation slice the remaining budget and the run fails "
+             "cleanly when it expires (0 = unbounded)")
+    p_apply.add_argument(
+        "--fault-plan", default="", metavar="SPEC",
+        help="activate a deterministic fault-injection plan for the run: a "
+             "JSON file, inline JSON, 'seed=N', or "
+             "'site=S,attempt=K,error=E[;...]' (sites: see "
+             "open_simulator_tpu.resilience.SITES). Testing/CI only.")
 
     p_metrics = sub.add_parser(
         "metrics", help="Render a saved metrics snapshot (--metrics-out / "
@@ -117,6 +129,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--grpc-port", type=int, default=0, metavar="PORT",
         help="also serve the gRPC bridge (server/proto/simon.proto) on PORT "
              "(0 = disabled)")
+    p_server.add_argument(
+        "--drain-deadline", type=float, default=None, metavar="SECONDS",
+        help="graceful-drain budget on SIGTERM: stop accepting (503), let "
+             "in-flight requests finish up to SECONDS, then exit "
+             "(default 25)")
+    p_server.add_argument(
+        "--debug-faults", action="store_true",
+        help="enable the POST /debug/fault-plan injection endpoint "
+             "(testing/CI only; never enable on a production server)")
 
     sub.add_parser("version", help="Print the version of simon")
 
@@ -139,7 +160,12 @@ def cmd_apply(args) -> int:
     ext = [e.strip() for e in (args.extended_resources or "").split(",") if e.strip()]
     trace_out = getattr(args, "trace_out", "")
     metrics_out = getattr(args, "metrics_out", "")
+    fault_plan = None
     try:
+        if getattr(args, "fault_plan", ""):
+            from ..resilience import FaultPlan, install_plan
+
+            fault_plan = install_plan(FaultPlan.parse(args.fault_plan))
         applier = Applier(Options(
             simon_config=args.simon_config,
             default_scheduler_config=args.default_scheduler_config,
@@ -147,6 +173,7 @@ def cmd_apply(args) -> int:
             interactive=args.interactive,
             extended_resources=ext,
             output_file=args.output_file,
+            deadline=getattr(args, "deadline", 0.0) or 0.0,
         ))
         if trace_out:
             from ..utils.trace import start_collection
@@ -175,8 +202,6 @@ def cmd_apply(args) -> int:
                     write_chrome_trace(trace_out, stop_collection(),
                                        metrics=REGISTRY.snapshot())
                 if metrics_out:
-                    import json
-
                     with open(metrics_out, "w") as f:
                         json.dump(REGISTRY.snapshot(), f, indent=1)
                         f.write("\n")
@@ -187,6 +212,15 @@ def cmd_apply(args) -> int:
     except Exception as e:  # mirror `apply error: ...` + exit 1 (cmd/apply/apply.go:17-24)
         print(f"apply error: {e}", file=sys.stderr)
         return 1
+    finally:
+        if fault_plan is not None:
+            from ..resilience import clear_plan
+
+            clear_plan()
+            # the fired-injection trace on stderr: the replay-equality
+            # artifact CI diffs across identical runs
+            print(f"fault plan trace: {json.dumps(fault_plan.to_json()['trace'])}",
+                  file=sys.stderr)
     # None = planning failed / user exited without a schedulable outcome; scripts
     # need a nonzero exit to distinguish it from success.
     return 0 if result is not None else 1
@@ -207,7 +241,8 @@ def cmd_server(args) -> int:
     ensure_responsive_backend()
 
     try:
-        server = Server(kubeconfig=args.kubeconfig, master=args.master)
+        server = Server(kubeconfig=args.kubeconfig, master=args.master,
+                        debug_faults=True if args.debug_faults else None)
         if args.grpc_port:
             # same Server object behind both surfaces: the TryLock busy
             # semantics hold across REST and gRPC clients
@@ -217,7 +252,8 @@ def cmd_server(args) -> int:
             grpc_server, bound = bridge.build_grpc_server(args.grpc_port)
             grpc_server.start()
             print(f"simon grpc bridge listening on :{bound}")
-        server.start(port=args.port)
+        server.start(port=args.port,
+                     drain_deadline=getattr(args, "drain_deadline", None))
     except KeyboardInterrupt:
         return 0
     except Exception as e:
@@ -229,8 +265,6 @@ def cmd_server(args) -> int:
 def cmd_metrics(args) -> int:
     """Render a saved registry snapshot (apply --metrics-out, or the metadata
     of a --trace-out Chrome trace) as Prometheus text on stdout."""
-    import json
-
     from ..obs import render_text_from_snapshot
 
     try:

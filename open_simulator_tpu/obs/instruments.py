@@ -19,7 +19,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Set, Tuple
 
-from .metrics import PODS_BUCKETS, SECONDS_BUCKETS, counter, histogram
+from .metrics import PODS_BUCKETS, SECONDS_BUCKETS, counter, gauge, histogram
 
 # ------------------------------------------------------------------ engine ----
 
@@ -130,6 +130,34 @@ PREEMPT_REPLAY_PODS = counter(
     "Pods re-scheduled by rewind/replay passes — the simulator-specific "
     "cost of exact mid-batch preemption (PARITY.md cost envelope).")
 
+# ---------------------------------------------------------------- resilience --
+
+RETRIES = counter(
+    "simon_retries_total",
+    "Retried attempts by fault site (resilience/policy.py RetryPolicy; "
+    "counts each retry, not first attempts).",
+    ("site",))
+DEADLINE_EXCEEDED = counter(
+    "simon_deadline_exceeded_total",
+    "Operations abandoned because the contextvar deadline budget ran out, "
+    "by the site that noticed.",
+    ("site",))
+BREAKER_STATE = gauge(
+    "simon_breaker_state",
+    "Circuit-breaker state: 0 closed, 1 half-open, 2 open "
+    "(resilience/policy.py CircuitBreaker).",
+    ("name",))
+FAULTS_INJECTED = counter(
+    "simon_faults_injected_total",
+    "Injected failures fired by the active FaultPlan, by site "
+    "(resilience/faults.py; zero in production).",
+    ("site",))
+HTTP_ERRORS = counter(
+    "simon_http_errors_total",
+    "Server request failures by endpoint and HTTP status code "
+    "(structured JSON error bodies, server/http.py).",
+    ("endpoint", "code"))
+
 # ---------------------------------------------------------- capacity search ---
 
 CAPACITY_SEARCHES = counter(
@@ -192,5 +220,8 @@ def install_jax_monitoring() -> None:
                 XLA_COMPILE_SECONDS.inc(duration)
 
         monitoring.register_event_duration_secs_listener(_on_duration)
-    except Exception:  # monitoring is diagnostics; never break the engine
+    # simonlint: ignore[swallowed-exception] -- diagnostics-only listener; a
+    # jax too old for monitoring must never break the engine, and there is
+    # nothing to count into (this IS the metrics bootstrap)
+    except Exception:
         pass

@@ -1,0 +1,62 @@
+"""simonfault: first-party robustness layer — policies, fault injection,
+crash-consistent simulation state.
+
+The reference inherits its failure behavior from client-go and kube-scheduler
+for free (informer relists, rate-limited retries, the scheduler's error
+funnel); this rebuild owns every network call and device dispatch itself, so
+it owns the failure semantics too. Three parts:
+
+- `policy` — composable `RetryPolicy` (exponential backoff, deterministic
+  seeded jitter, max-attempts/max-elapsed), `Deadline` (contextvar-propagated
+  budget that callees slice), and a `CircuitBreaker` for the live-cluster
+  client. All instrumented via obs/instruments.py.
+- `faults` — named fault sites threaded through the hot paths with a seeded
+  `FaultPlan` (fail arrival k at site s with error class e), activatable from
+  tests, `simon apply --fault-plan`, and the server's /debug/fault-plan
+  endpoint. Injection is reproducible bit-for-bit: a seeded plan fires the
+  same (site, arrival) pairs on every replay.
+- crash consistency lives in the engine itself (simulator/engine.py
+  `Simulator._transaction`): any failure — injected or real — after partial
+  device work rolls host-visible state (placements, census, commit/rollback
+  metric reconciliation) back to exactly the pre-call state.
+"""
+
+from .faults import (
+    SITES,
+    FaultInjected,
+    FaultPlan,
+    FaultSpec,
+    active_plan,
+    clear_plan,
+    install_plan,
+    installed,
+    maybe_fail,
+)
+from .policy import (
+    BreakerOpen,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    check_deadline,
+    deadline_remaining,
+)
+
+__all__ = [
+    "SITES",
+    "FaultInjected",
+    "FaultPlan",
+    "FaultSpec",
+    "active_plan",
+    "clear_plan",
+    "install_plan",
+    "installed",
+    "maybe_fail",
+    "BreakerOpen",
+    "CircuitBreaker",
+    "Deadline",
+    "DeadlineExceeded",
+    "RetryPolicy",
+    "check_deadline",
+    "deadline_remaining",
+]

@@ -1,0 +1,235 @@
+"""Deterministic fault injection: named sites threaded through the hot paths.
+
+A `FaultPlan` is a set of `(site, attempt, error-class)` triples: the k-th
+arrival at site s raises an error of class e. Plans are either explicit or
+seeded (`FaultPlan.seeded(seed)` derives the triples from a PRNG), and every
+firing is recorded on `plan.trace`, so a replay with the same plan produces
+the identical trace bit-for-bit — the property the fault-smoke CI asserts.
+
+Sites (SITES) cover each stage a scheduling run can die in:
+
+  live_get        one HTTP GET against the kube-apiserver (simulator/live.py)
+  encode          pod-batch encoding into device tables (engine.encode_batch_raw)
+  to_device       host->device table/carry transfer (engine._to_device)
+  dispatch        one compiled kernel dispatch (engine/probe segment loops)
+  fetch           device->host result fetch (the np.asarray sync points)
+  commit          one pod commit onto host cluster state (engine._commit_pod)
+  preempt_evict   preemption eviction (preemption.evict)
+
+Activation is process-global (`install_plan` / `clear_plan`): tests use the
+context manager form, the CLI wires `simon apply --fault-plan`, and the
+server exposes POST /debug/fault-plan. The no-plan fast path is a single
+global None check, so production hot paths pay nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..obs import instruments as obs
+
+SITES: Tuple[str, ...] = (
+    "live_get", "encode", "to_device", "dispatch", "fetch", "commit",
+    "preempt_evict",
+)
+
+ERROR_CLASSES: Tuple[str, ...] = ("runtime", "transient", "auth", "protocol")
+
+
+class FaultInjected(RuntimeError):
+    """An injected failure with no HTTP analog (engine/device sites)."""
+
+    def __init__(self, site: str, attempt: int) -> None:
+        super().__init__(f"injected fault at {site} (attempt {attempt})")
+        self.site = site
+        self.attempt = attempt
+        self.injected = True
+
+
+def _raise_for(site: str, attempt: int, error: str) -> None:
+    if error == "runtime":
+        raise FaultInjected(site, attempt)
+    # HTTP-shaped classes come from the live client's typed hierarchy so the
+    # retry policy discriminates injected faults exactly like real ones.
+    # Imported lazily: live.py itself calls into this module.
+    from ..simulator.live import AuthError, ProtocolError, TransientError
+
+    cls = {"transient": TransientError, "auth": AuthError,
+           "protocol": ProtocolError}[error]
+    e = cls(f"injected {error} fault at {site} (attempt {attempt})")
+    e.injected = True
+    raise e
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """Fail the `attempt`-th arrival (1-based) at `site` with `error`."""
+
+    site: str
+    attempt: int = 1
+    error: str = "runtime"
+
+    def __post_init__(self) -> None:
+        if self.site not in SITES:
+            raise ValueError(f"unknown fault site {self.site!r}; sites: {SITES}")
+        if self.attempt < 1:
+            raise ValueError(f"attempt is 1-based, got {self.attempt}")
+        if self.error not in ERROR_CLASSES:
+            raise ValueError(
+                f"unknown error class {self.error!r}; classes: {ERROR_CLASSES}")
+
+
+class FaultPlan:
+    """A deterministic set of FaultSpecs plus per-site arrival counters."""
+
+    def __init__(self, specs: Sequence[FaultSpec], seed: Optional[int] = None) -> None:
+        self.specs = tuple(specs)
+        self.seed = seed
+        self._by_site: Dict[str, Dict[int, str]] = {}
+        for s in self.specs:
+            self._by_site.setdefault(s.site, {})[s.attempt] = s.error
+        self._lock = threading.Lock()
+        self.arrivals: Dict[str, int] = {}
+        self.trace: List[Tuple[str, int, str]] = []  # fired (site, attempt, error)
+
+    # ----------------------------------------------------------- construct ----
+
+    @classmethod
+    def seeded(cls, seed: int, n_faults: int = 1,
+               sites: Sequence[str] = SITES, max_attempt: int = 3,
+               error_classes: Sequence[str] = ("runtime",)) -> "FaultPlan":
+        """Derive `n_faults` specs from a PRNG — the fault-soak generator.
+        Pure function of its arguments: seeded(s) twice is the same plan."""
+        rng = random.Random(seed)
+        specs = []
+        seen = set()
+        for _ in range(n_faults):
+            for _ in range(64):  # resample collisions, bounded
+                s = FaultSpec(rng.choice(list(sites)),
+                              rng.randint(1, max_attempt),
+                              rng.choice(list(error_classes)))
+                if (s.site, s.attempt) not in seen:
+                    seen.add((s.site, s.attempt))
+                    specs.append(s)
+                    break
+        return cls(specs, seed=seed)
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        """CLI/server plan syntax. Accepts, in order of trial:
+        a JSON file path; an inline JSON object ({"seed": ..} or
+        {"faults": [{"site": ..., "attempt": ..., "error": ...}]});
+        `seed=N`; or `;`-separated clauses `site=S,attempt=K,error=E`."""
+        text = text.strip()
+        if os.path.exists(text):
+            with open(text) as f:
+                return cls.from_json(json.load(f))
+        if text.startswith("{"):
+            return cls.from_json(json.loads(text))
+        if text.startswith("seed="):
+            return cls.seeded(int(text[len("seed="):]))
+        specs = []
+        for clause in text.split(";"):
+            clause = clause.strip()
+            if not clause:
+                continue
+            kv = {}
+            for part in clause.split(","):
+                k, _, v = part.partition("=")
+                kv[k.strip()] = v.strip()
+            unknown = set(kv) - {"site", "attempt", "error"}
+            if unknown or "site" not in kv:
+                raise ValueError(f"bad fault clause {clause!r} "
+                                 f"(want site=S[,attempt=K][,error=E])")
+            specs.append(FaultSpec(kv["site"], int(kv.get("attempt", 1)),
+                                   kv.get("error", "runtime")))
+        if not specs:
+            raise ValueError(f"empty fault plan spec {text!r}")
+        return cls(specs)
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "FaultPlan":
+        if not isinstance(doc, dict):
+            raise ValueError("fault plan JSON must be an object")
+        if "seed" in doc and not doc.get("faults"):
+            return cls.seeded(int(doc["seed"]),
+                              n_faults=int(doc.get("n_faults", 1)))
+        specs = [FaultSpec(f["site"], int(f.get("attempt", 1)),
+                           f.get("error", "runtime"))
+                 for f in doc.get("faults") or []]
+        if not specs:
+            raise ValueError("fault plan JSON names no faults")
+        return cls(specs, seed=doc.get("seed"))
+
+    def to_json(self) -> dict:
+        return {
+            "seed": self.seed,
+            "faults": [{"site": s.site, "attempt": s.attempt, "error": s.error}
+                       for s in self.specs],
+            "arrivals": dict(self.arrivals),
+            "trace": [list(t) for t in self.trace],
+        }
+
+    # -------------------------------------------------------------- firing ----
+
+    def on_arrival(self, site: str) -> None:
+        """Count one arrival at `site`; raise when a spec names it."""
+        with self._lock:
+            n = self.arrivals.get(site, 0) + 1
+            self.arrivals[site] = n
+            error = self._by_site.get(site, {}).get(n)
+            if error is not None:
+                self.trace.append((site, n, error))
+        if error is not None:
+            obs.FAULTS_INJECTED.labels(site=site).inc()
+            _raise_for(site, n, error)
+
+
+# ---------------------------------------------------------------- activation ---
+
+_PLAN: Optional[FaultPlan] = None
+_PLAN_LOCK = threading.Lock()
+
+
+def install_plan(plan: FaultPlan) -> FaultPlan:
+    """Activate `plan` process-wide (replacing any previous one)."""
+    global _PLAN
+    with _PLAN_LOCK:
+        _PLAN = plan
+    return plan
+
+
+def clear_plan() -> None:
+    global _PLAN
+    with _PLAN_LOCK:
+        _PLAN = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+class installed:
+    """Context-manager activation for tests: `with installed(plan): ...`."""
+
+    def __init__(self, plan: FaultPlan) -> None:
+        self.plan = plan
+
+    def __enter__(self) -> FaultPlan:
+        install_plan(self.plan)
+        return self.plan
+
+    def __exit__(self, *exc) -> None:
+        clear_plan()
+
+
+def maybe_fail(site: str) -> None:
+    """The per-site hook the hot paths call. Free when no plan is active."""
+    plan = _PLAN
+    if plan is not None:
+        plan.on_arrival(site)

@@ -1,0 +1,250 @@
+"""Retry, deadline, and circuit-breaker policies (host-side, jax-free).
+
+Design constraints, in order:
+
+- **Deterministic.** Backoff jitter comes from a seeded PRNG so a retry
+  trace replays bit-for-bit: `RetryPolicy(seed=s).schedule()` is a pure
+  function of the policy parameters. Fail-fast/crash-only style — a policy
+  either succeeds within its bounds or raises; nothing retries forever.
+- **Composable budgets.** `Deadline` is a contextvar-propagated ABSOLUTE
+  deadline: entering a nested `Deadline` can only tighten the budget, and
+  every callee (live GETs, capacity-search rounds) slices the remainder
+  instead of owning a private timeout.
+- **Instrumented.** Every retry, deadline expiry, and breaker transition
+  moves a counter/gauge in obs/instruments.py, so the PR-3 metrics surface
+  can verify failure behavior end to end.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import random
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..obs import instruments as obs
+
+
+class DeadlineExceeded(Exception):
+    """A contextvar deadline ran out before the work finished."""
+
+
+class BreakerOpen(Exception):
+    """A CircuitBreaker is open: the protected dependency is presumed down."""
+
+
+# ---------------------------------------------------------------- deadlines ----
+
+# Absolute time.monotonic() deadline of the current context, or None (no
+# budget). Contextvars propagate per server-handler thread and asyncio task.
+_DEADLINE: contextvars.ContextVar[Optional[float]] = contextvars.ContextVar(
+    "open_simulator_tpu_deadline", default=None)
+
+
+class Deadline:
+    """A wall-clock budget for everything under this context manager.
+
+    Nested deadlines only tighten: `with Deadline(60): with Deadline(5): ...`
+    gives the inner block min(5s, whatever remains of the 60s). Callees read
+    the remainder via `deadline_remaining()` / `check_deadline(site)` and
+    slice it into their own timeouts.
+    """
+
+    def __init__(self, seconds: float, clock: Callable[[], float] = time.monotonic) -> None:
+        if seconds <= 0:
+            raise ValueError(f"deadline must be positive, got {seconds}")
+        self.seconds = float(seconds)
+        self._clock = clock
+        self._token: Optional[contextvars.Token] = None
+
+    def __enter__(self) -> "Deadline":
+        mine = self._clock() + self.seconds
+        outer = _DEADLINE.get()
+        self._token = _DEADLINE.set(mine if outer is None else min(mine, outer))
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._token is not None:
+            _DEADLINE.reset(self._token)
+            self._token = None
+
+    def remaining(self) -> Optional[float]:
+        return deadline_remaining(self._clock)
+
+
+def deadline_remaining(clock: Callable[[], float] = time.monotonic) -> Optional[float]:
+    """Seconds left on the current context's deadline, or None (unbounded).
+    Can be negative once expired — callers usually want check_deadline."""
+    at = _DEADLINE.get()
+    return None if at is None else at - clock()
+
+
+def check_deadline(site: str, clock: Callable[[], float] = time.monotonic) -> None:
+    """Raise DeadlineExceeded (and count it against `site`) when the current
+    context's budget is spent. No-op without an active deadline."""
+    rem = deadline_remaining(clock)
+    if rem is not None and rem <= 0:
+        obs.DEADLINE_EXCEEDED.labels(site=site).inc()
+        raise DeadlineExceeded(f"deadline exceeded at {site} ({-rem:.3f}s over)")
+
+
+# ------------------------------------------------------------------ retries ----
+
+
+class RetryPolicy:
+    """Exponential backoff with deterministic seeded jitter and hard bounds.
+
+    The attempt-k sleep is `min(cap, base * mult**k) * (1 + jitter * u_k)`
+    where u_k ∈ [0, 1) comes from `random.Random(seed)` — the whole schedule
+    is a pure function of the constructor arguments, so a failure trace
+    replays identically (the fault-injection acceptance criterion). Bounds:
+    at most `max_attempts` calls AND at most `max_elapsed` seconds of
+    cumulative sleep; whichever trips first re-raises the last error.
+    """
+
+    def __init__(self, max_attempts: int = 4, base: float = 0.1,
+                 mult: float = 2.0, cap: float = 5.0, jitter: float = 0.2,
+                 max_elapsed: float = 30.0, seed: int = 0) -> None:
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.base = float(base)
+        self.mult = float(mult)
+        self.cap = float(cap)
+        self.jitter = float(jitter)
+        self.max_elapsed = float(max_elapsed)
+        self.seed = int(seed)
+
+    def schedule(self) -> List[float]:
+        """The sleeps between attempts (len == max_attempts - 1),
+        deterministic for a given policy."""
+        rng = random.Random(self.seed)
+        out: List[float] = []
+        for k in range(self.max_attempts - 1):
+            d = min(self.cap, self.base * self.mult ** k)
+            out.append(d * (1.0 + self.jitter * rng.random()))
+        return out
+
+    def call(self, fn: Callable[[], object], *, site: str,
+             retryable: Callable[[BaseException], bool],
+             breaker: Optional["CircuitBreaker"] = None,
+             sleep: Callable[[float], None] = time.sleep,
+             clock: Callable[[], float] = time.monotonic):
+        """Run `fn` under this policy. Retries only errors `retryable` says
+        yes to; honors an error's `retry_after` attribute (Retry-After) as a
+        sleep floor; slices the contextvar deadline (never sleeps past it);
+        feeds the breaker, when given, with every outcome."""
+        delays = self.schedule()
+        t0 = clock()
+        attempt = 0
+        while True:
+            check_deadline(site, clock)
+            if breaker is not None:
+                breaker.before_call()
+            try:
+                result = fn()
+            except BaseException as e:
+                is_retryable = not isinstance(e, BreakerOpen) and retryable(e)
+                if breaker is not None:
+                    # only dependency-level (retryable) failures feed the
+                    # breaker: a 401 means the DEPENDENCY is alive — opening
+                    # on it would mask the actionable auth error
+                    if is_retryable:
+                        breaker.record_failure()
+                    elif not isinstance(e, BreakerOpen):
+                        breaker.record_success()
+                if not is_retryable or attempt >= len(delays):
+                    raise
+                delay = max(delays[attempt],
+                            float(getattr(e, "retry_after", 0.0) or 0.0))
+                if clock() - t0 + delay > self.max_elapsed:
+                    raise
+                rem = deadline_remaining(clock)
+                if rem is not None and delay >= rem:
+                    obs.DEADLINE_EXCEEDED.labels(site=site).inc()
+                    raise DeadlineExceeded(
+                        f"deadline at {site} leaves {rem:.3f}s, "
+                        f"next retry needs {delay:.3f}s") from e
+                obs.RETRIES.labels(site=site).inc()
+                attempt += 1
+                sleep(delay)
+            else:
+                if breaker is not None:
+                    breaker.record_success()
+                return result
+
+
+# ---------------------------------------------------------- circuit breaker ----
+
+# Gauge encoding (PARITY.md "Failure handling"): matches the conventional
+# three-state numeric export so dashboards can alert on state != 0.
+_CLOSED, _HALF_OPEN, _OPEN = 0, 1, 2
+_STATE_NAMES = {_CLOSED: "closed", _HALF_OPEN: "half_open", _OPEN: "open"}
+
+
+class CircuitBreaker:
+    """Classic three-state breaker for a flaky dependency (the live-cluster
+    apiserver): `failure_threshold` consecutive failures open it; after
+    `reset_after` seconds one probe call is let through (half-open); a probe
+    success closes it, a probe failure re-opens. Thread-safe (the server's
+    handler threads share one client)."""
+
+    def __init__(self, name: str, failure_threshold: int = 5,
+                 reset_after: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.name = name
+        self.failure_threshold = int(failure_threshold)
+        self.reset_after = float(reset_after)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._state = _CLOSED
+        self._opened_at = 0.0
+        self._probing = False
+        self._set_gauge()
+
+    def _set_gauge(self) -> None:
+        obs.BREAKER_STATE.labels(name=self.name).set(self._state)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return _STATE_NAMES[self._state]
+
+    def before_call(self) -> None:
+        """Gate a call: raises BreakerOpen while open; lets exactly one
+        probe through once `reset_after` has elapsed (half-open)."""
+        with self._lock:
+            if self._state == _CLOSED:
+                return
+            if self._state == _OPEN:
+                if self._clock() - self._opened_at < self.reset_after:
+                    raise BreakerOpen(
+                        f"circuit {self.name!r} open "
+                        f"({self._failures} consecutive failures)")
+                self._state = _HALF_OPEN
+                self._probing = False
+                self._set_gauge()
+            # half-open: admit one probe at a time
+            if self._probing:
+                raise BreakerOpen(f"circuit {self.name!r} half-open, probe in flight")
+            self._probing = True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probing = False
+            if self._state != _CLOSED:
+                self._state = _CLOSED
+                self._set_gauge()
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            self._probing = False
+            if (self._state == _HALF_OPEN
+                    or self._failures >= self.failure_threshold):
+                self._state = _OPEN
+                self._opened_at = self._clock()
+                self._set_gauge()

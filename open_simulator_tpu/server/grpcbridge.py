@@ -160,16 +160,29 @@ class GrpcBridge:
     # handlers: bytes-in/bytes-out via the wire codec
 
     def _simulate(self, handler, request: bytes, context) -> bytes:
+        from .http import count_http_error, error_body
+
+        # the gRPC surface shares the REST drain gate: requests arriving
+        # after SIGTERM get the same in-band structured 503
+        if not self.server._begin_request():
+            count_http_error("drain", 503)
+            return encode_simulate_response(
+                503, json.dumps(error_body(503, "server is draining")).encode())
         try:
-            req = json.loads(decode_simulate_request(request) or b"{}")
-        except ValueError as e:
-            # covers JSONDecodeError, invalid-UTF-8 UnicodeDecodeError, and
-            # malformed protobuf framing from the decoder — the contract
-            # keeps unmarshal errors in-band as code=400
-            code, body = 400, f"fail to unmarshal content: {e}"
-        else:
-            code, body = handler(req)
-        return encode_simulate_response(code, json.dumps(body).encode())
+            try:
+                req = json.loads(decode_simulate_request(request) or b"{}")
+            except ValueError as e:
+                # covers JSONDecodeError, invalid-UTF-8 UnicodeDecodeError, and
+                # malformed protobuf framing from the decoder — the contract
+                # keeps unmarshal errors in-band as structured code=400
+                count_http_error("grpc", 400)
+                code, body = 400, error_body(
+                    400, f"fail to unmarshal content: {e}")
+            else:
+                code, body = handler(req)
+            return encode_simulate_response(code, json.dumps(body).encode())
+        finally:
+            self.server._end_request()
 
     def _deploy(self, request: bytes, context) -> bytes:
         return self._simulate(self.server.handle_deploy_apps, request, context)

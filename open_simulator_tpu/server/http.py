@@ -13,6 +13,17 @@ Mirrors /root/reference/pkg/server/server.go (gin REST façade):
 Built on http.server (stdlib) instead of gin; the live snapshot uses the REST
 KubeClient (simulator/live.py) instead of informer listers — each request re-lists,
 which trades the informer cache for zero dependencies.
+
+Failure semantics (simonfault, README "Failure handling"):
+- every error response is structured JSON `{"error": ..., "code": ...}` and
+  counted in `simon_http_errors_total{endpoint,code}`;
+- the per-endpoint lock is released on every path that acquired it (and only
+  those), so one failed request can never wedge an endpoint;
+- graceful drain: SIGTERM (or `Server.drain()`) stops accepting work — new
+  requests get 503 — lets in-flight requests finish inside a bounded drain
+  deadline, then stops the listener;
+- POST /debug/fault-plan activates a deterministic resilience.FaultPlan for
+  reproducing failure behavior against a running server.
 """
 
 from __future__ import annotations
@@ -27,8 +38,18 @@ from typing import Callable, List, Optional, Tuple
 from ..core import constants as C
 from ..core.types import AppResource, ResourceTypes, SimulateResult
 from ..models.fakenode import new_fake_node
+from ..obs import instruments as obs
 from ..simulator.core import simulate
 from ..utils.objutil import labels_of, name_of, namespace_of, owner_references
+
+
+def error_body(code: int, message: str) -> dict:
+    """The structured error contract every non-2xx response follows."""
+    return {"error": message, "code": code}
+
+
+def count_http_error(endpoint: str, code: int) -> None:
+    obs.HTTP_ERRORS.labels(endpoint=endpoint, code=str(code)).inc()
 
 
 def owned_by_workload(refs: List[dict], kind: str, name: str) -> bool:
@@ -89,7 +110,7 @@ class ClusterSnapshot:
 def snapshot_from_client(client) -> ClusterSnapshot:
     """getCurrentClusterResource + getPendingPods (:317-402): Running pods only in
     the cluster resource, Pending pods separated, DaemonSet-owned skipped."""
-    from ..simulator.live import _split_pods
+    from ..simulator.live import LiveClusterError, _split_pods
 
     rt = ResourceTypes()
     rt.nodes = client.list("/api/v1/nodes")
@@ -97,7 +118,7 @@ def snapshot_from_client(client) -> ClusterSnapshot:
     rt.pods = running
     try:
         rt.pod_disruption_budgets = client.list("/apis/policy/v1/poddisruptionbudgets")
-    except Exception:
+    except LiveClusterError:  # pre-1.21 cluster: policy/v1 not served
         rt.pod_disruption_budgets = client.list("/apis/policy/v1beta1/poddisruptionbudgets")
     rt.services = client.list("/api/v1/services")
     rt.storage_classes = client.list("/apis/storage.k8s.io/v1/storageclasses")
@@ -136,7 +157,15 @@ class Server:
         kubeconfig: str = "",
         master: str = "",
         snapshot_fn: Optional[Callable[[], ClusterSnapshot]] = None,
+        debug_faults: Optional[bool] = None,
     ) -> None:
+        # /debug/fault-plan is a process-global WRITE endpoint (testing/CI):
+        # never enabled by default on a production server. Opt in explicitly
+        # (constructor / `simon server --debug-faults`) or via env.
+        if debug_faults is None:
+            debug_faults = os.environ.get(
+                "OPEN_SIMULATOR_DEBUG_FAULTS", "") not in ("", "0", "false", "no")
+        self.debug_faults = debug_faults
         if snapshot_fn is None:
             from ..simulator.live import create_kube_client
 
@@ -145,12 +174,21 @@ class Server:
         self.snapshot_fn = snapshot_fn
         self.deploy_lock = threading.Lock()
         self.scale_lock = threading.Lock()
+        # drain/in-flight accounting (graceful SIGTERM semantics)
+        self._inflight = 0
+        self._state_cv = threading.Condition()
+        self._draining = False
+        self._httpd: Optional[ThreadingHTTPServer] = None
 
     # ------------------------------------------------------------- handlers -------
 
     def handle_deploy_apps(self, req: dict) -> Tuple[int, object]:
+        # TryLock BEFORE the try: the busy path must not release a lock it
+        # never held; every path below the acquire releases in the finally.
         if not self.deploy_lock.acquire(blocking=False):
-            return 503, "The server is busy, please try again later"
+            count_http_error("deploy-apps", 503)
+            return 503, error_body(
+                503, "The server is busy, please try again later")
         try:
             snap = self.snapshot_fn()
             # copy: an injectable snapshot_fn may return shared lists, and the
@@ -170,13 +208,18 @@ class Server:
             result = simulate(cluster, [AppResource(name="test", resource=app)])
             return 200, simulate_response(result)
         except Exception as e:
-            return 500, str(e)
+            # the engine's transaction already rolled simulator state back;
+            # report structured + counted (never a bare 500 string)
+            count_http_error("deploy-apps", 500)
+            return 500, error_body(500, str(e))
         finally:
             self.deploy_lock.release()
 
     def handle_scale_apps(self, req: dict) -> Tuple[int, object]:
         if not self.scale_lock.acquire(blocking=False):
-            return 503, "The server is busy, please try again later"
+            count_http_error("scale-apps", 503)
+            return 503, error_body(
+                503, "The server is busy, please try again later")
         try:
             snap = self.snapshot_fn()
             cluster = snap.resource.copy()  # see handle_deploy_apps
@@ -198,7 +241,8 @@ class Server:
             result = simulate(cluster, [AppResource(name="test", resource=app)])
             return 200, simulate_response(result)
         except Exception as e:
-            return 500, str(e)
+            count_http_error("scale-apps", 500)
+            return 500, error_body(500, str(e))
         finally:
             self.scale_lock.release()
 
@@ -223,11 +267,72 @@ class Server:
 
     # --------------------------------------------------------------- serving ------
 
-    def start(self, port: int = 8080, host: str = "") -> None:
+    # Default bounded drain: long enough for a worst-case cold-compile
+    # simulation, short enough for a kube terminationGracePeriod.
+    DRAIN_DEADLINE = 25.0
+
+    def start(self, port: int = 8080, host: str = "",
+              drain_deadline: Optional[float] = None) -> None:
         self._t_start = time.time()
         httpd = self.build_httpd(port, host)
+        self.install_sigterm_handler(drain_deadline)
         print(f"simon server listening on :{port}")
         httpd.serve_forever()
+
+    def install_sigterm_handler(self, drain_deadline: Optional[float] = None) -> None:
+        """SIGTERM → graceful drain (kube pod-termination semantics)."""
+        import signal
+
+        def _on_term(signum, frame):
+            # never drain on the signal frame itself: serve_forever must keep
+            # running until the drain thread shuts it down
+            threading.Thread(target=self.drain, args=(drain_deadline,),
+                             daemon=True).start()
+
+        try:
+            signal.signal(signal.SIGTERM, _on_term)
+        except ValueError:
+            pass  # not the main thread (embedded use); the embedder owns signals
+
+    # ------------------------------------------------------- drain machinery ------
+
+    def _begin_request(self) -> bool:
+        """Admit one request, or refuse (False) once draining started."""
+        with self._state_cv:
+            if self._draining:
+                return False
+            self._inflight += 1
+            return True
+
+    def _end_request(self) -> None:
+        with self._state_cv:
+            self._inflight -= 1
+            self._state_cv.notify_all()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, deadline: Optional[float] = None) -> int:
+        """Graceful shutdown: stop admitting requests (new ones get 503),
+        wait for in-flight requests up to `deadline` seconds, then stop the
+        listener. Returns the number of requests still in flight when the
+        deadline expired (0 = clean drain). Idempotent."""
+        if deadline is None:
+            deadline = self.DRAIN_DEADLINE
+        until = time.monotonic() + max(0.0, deadline)
+        with self._state_cv:
+            self._draining = True
+            while self._inflight > 0:
+                left = until - time.monotonic()
+                if left <= 0:
+                    break
+                self._state_cv.wait(timeout=min(left, 0.1))
+            stranded = self._inflight
+        httpd = self._httpd
+        if httpd is not None:
+            httpd.shutdown()
+        return stranded
 
     def build_httpd(self, port: int = 8080, host: str = "") -> ThreadingHTTPServer:
         server = self
@@ -244,7 +349,30 @@ class Server:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _send_err(self, code: int, message: str, endpoint: str) -> None:
+                count_http_error(endpoint, code)
+                self._send(code, error_body(code, message))
+
             def do_GET(self):
+                # the drain gate: in-flight requests finish, new ones get 503
+                if not server._begin_request():
+                    self._send_err(503, "server is draining", "drain")
+                    return
+                try:
+                    self._get_routes()
+                finally:
+                    server._end_request()
+
+            def do_POST(self):
+                if not server._begin_request():
+                    self._send_err(503, "server is draining", "drain")
+                    return
+                try:
+                    self._post_routes()
+                finally:
+                    server._end_request()
+
+            def _get_routes(self):
                 if self.path == "/healthz":
                     self._send(200, {"message": "ok"})
                 elif self.path == "/metrics" or self.path.startswith("/metrics?"):
@@ -295,29 +423,69 @@ class Server:
                         "recent_traces": recent_spans(),
                         "metrics": REGISTRY.values(),
                     })
+                elif self.path == "/debug/fault-plan":
+                    if not server.debug_faults:
+                        self._send_err(403, "fault-plan endpoint disabled "
+                                       "(start with --debug-faults)",
+                                       "fault-plan")
+                        return
+                    from ..resilience import active_plan
+
+                    plan = active_plan()
+                    self._send(200, plan.to_json() if plan is not None else {})
                 elif self.path == "/test":
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
                     self.end_headers()
                     self.wfile.write(b"test")
                 else:
-                    self._send(404, {"message": "not found"})
+                    self._send_err(404, "not found", "other")
 
-            def do_POST(self):
+            def _post_routes(self):
                 length = int(self.headers.get("Content-Length") or 0)
                 raw = self.rfile.read(length)
                 try:
                     req = json.loads(raw or b"{}")
                 except ValueError as e:  # JSONDecodeError + invalid-UTF-8
-                    self._send(400, f"fail to unmarshal content: {e}")
+                    endpoint = self.path.rsplit("/", 1)[-1] or "other"
+                    self._send_err(400, f"fail to unmarshal content: {e}",
+                                   endpoint)
                     return
                 if self.path == "/api/deploy-apps":
                     code, body = server.handle_deploy_apps(req)
                 elif self.path == "/api/scale-apps":
                     code, body = server.handle_scale_apps(req)
+                elif self.path == "/debug/fault-plan":
+                    if not server.debug_faults:
+                        self._send_err(403, "fault-plan endpoint disabled "
+                                       "(start with --debug-faults)",
+                                       "fault-plan")
+                        return
+                    code, body = server.handle_fault_plan(req)
                 else:
-                    self._send(404, {"message": "not found"})
+                    self._send_err(404, "not found", "other")
                     return
                 self._send(code, body)
 
-        return ThreadingHTTPServer((host, port), Handler)
+        httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = httpd
+        return httpd
+
+    # -------------------------------------------------------- debug fault plan ----
+
+    def handle_fault_plan(self, req: dict) -> Tuple[int, object]:
+        """POST /debug/fault-plan: install a deterministic FaultPlan for the
+        next requests ({"seed": N} or {"faults": [{site, attempt, error}]});
+        an empty object clears it. Returns the active plan as JSON — GETting
+        the endpoint later shows the fired-injection trace."""
+        from ..resilience import FaultPlan, clear_plan, install_plan
+
+        if not req:
+            clear_plan()
+            return 200, {}
+        try:
+            plan = install_plan(FaultPlan.from_json(req))
+        except (ValueError, KeyError, TypeError) as e:
+            count_http_error("fault-plan", 400)
+            return 400, error_body(400, f"bad fault plan: {e}")
+        return 200, plan.to_json()

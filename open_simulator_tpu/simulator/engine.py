@@ -23,6 +23,7 @@ Behavioral parity notes:
 
 from __future__ import annotations
 
+import contextlib
 import copy
 import dataclasses
 import time
@@ -32,6 +33,7 @@ import numpy as np
 
 from ..core import constants as C
 from ..obs import instruments as obs
+from ..resilience import faults
 from ..core.types import AppResource, NodeStatus, ResourceTypes, SimulateResult, UnscheduledPod
 from ..algo.queues import sort_affinity, sort_toleration
 from ..models.workloads import generate_valid_pods_from_app
@@ -196,8 +198,19 @@ class Simulator:
         self.preempted: List[dict] = []   # {pod, node, by} eviction records
         self._sig_of: Dict[int, tuple] = {}   # id(pod) → (sig, node_i, seq)
         self._commits_prio: List[int] = []    # spec.priority per commit, in order
-        self._commit_log: List[tuple] = []    # (pod, prev_gpu_index, prev_assume)
+        # (pod, prev_gpu_index, prev_assume, prev_node_name, prev_status)
+        self._commit_log: List[tuple] = []
+        # nominatedNodeName writes on failed preemptors (not commits):
+        # (pod, had_status, prev_value, had_key) — undone by restore()
+        self._nominate_log: List[tuple] = []
         self._preempt_armed = False
+        # Crash consistency (resilience/): _transaction() arms full commit
+        # logging so ANY failure rolls host state back; the two counters keep
+        # the commits−rollbacks−victims metric reconciliation exact when a
+        # batch dies between its commits and its batch-end COMMITS increment.
+        self._txn_armed = False
+        self._commit_events = 0    # _commit_pod calls, monotone
+        self._commits_counted = 0  # commit events already in obs.COMMITS
         self._priority_seen: set = set()
         self.match_cache: Dict[Tuple[int, object], bool] = {}  # (counter id, sched signature)
         self.disable_progress = disable_progress
@@ -223,12 +236,28 @@ class Simulator:
     # ------------------------------------------------------------- state ----------
 
     def _commit_pod(self, pod: dict, node_i: int, scheduled: bool = True) -> None:
-        if scheduled and self._preempt_armed:
-            # rewind info BEFORE reserve() mutates the pod (preemption.restore)
-            anns = (pod.get("metadata") or {}).get("annotations") or {}
+        faults.maybe_fail("commit")
+        self._commit_events += 1
+        spec = pod.get("spec")
+        if spec is None:
+            spec = pod["spec"] = {}
+        if self._preempt_armed or self._txn_armed:
+            # rewind info BEFORE reserve() mutates the pod (preemption.restore
+            # and the crash-consistency rollback share the log; pre-bound
+            # commits are logged too so a rollback restores their status).
+            # Annotation undo info only matters when gpushare reserve() will
+            # write annotations — restore() skips them otherwise, so the
+            # common path pays no metadata lookups.
+            if self.gpu_host.enabled:
+                anns = (pod.get("metadata") or {}).get("annotations") or {}
+                prev_idx = anns.get(C.AnnoGpuIndex)
+                prev_assume = anns.get(C.AnnoGpuAssumeTime)
+            else:
+                prev_idx = prev_assume = None
             self._commit_log.append((
-                pod, anns.get(C.AnnoGpuIndex), anns.get(C.AnnoGpuAssumeTime)))
-        pod.setdefault("spec", {})["nodeName"] = self.na.names[node_i]
+                pod, prev_idx, prev_assume,
+                spec.get("nodeName"), pod.get("status")))
+        spec["nodeName"] = self.na.names[node_i]
         pod["status"] = {"phase": "Running"}
         # Snapshot the signature BEFORE reserve() writes gpu-index/assume-time
         # annotations, so identical pods keep one signature (match-cache key).
@@ -303,16 +332,68 @@ class Simulator:
         reference's default plugin set (algorithmprovider/registry.go:106-110).
         With uniform priorities preemption is provably inert — no victim can
         have strictly lower priority — so the single-pass batched run is used
-        unchanged."""
+        unchanged.
+
+        The whole call is transactional (_transaction): any failure — an
+        injected fault, a device error, a KeyboardInterrupt — rolls
+        placements, census, and pod dicts back to the pre-call state."""
         t0 = time.perf_counter()
         try:
-            if self._track_priorities(pods):
-                from .preemption import schedule_with_preemption
+            with self._transaction(memo_pods=pods):
+                if self._track_priorities(pods):
+                    from .preemption import schedule_with_preemption
 
-                return schedule_with_preemption(self, pods)
-            return self._schedule_pods_inner(pods)
+                    return schedule_with_preemption(self, pods)
+                return self._schedule_pods_inner(pods)
         finally:
             obs.E2E_SECONDS.observe(time.perf_counter() - t0)
+
+    def _count_commits(self, n: int = 1) -> None:
+        """The one COMMITS increment path: tracks how many commit events are
+        already counted so _transaction can reconcile a partial batch."""
+        obs.COMMITS.inc(n)
+        self._commits_counted += n
+
+    @contextlib.contextmanager
+    def _transaction(self, memo_pods: Optional[List[dict]] = None):
+        """Crash consistency for one scheduling/probe call: snapshot host
+        state, arm full commit logging, and on ANY failure (1) count the
+        partial batch's commits that died before their batch-end COMMITS
+        increment, then (2) roll everything back — restore() counts the
+        rolled commits as simon_commit_rollbacks_total and re-materialized
+        eviction victims as commits, so commits − rollbacks − victims is
+        bit-identical to the pre-call value. Placements, census, pod dicts,
+        and the gpushare/open-local ledgers all return to the snapshot.
+
+        `memo_pods`: pods to strip SIG_MEMO_KEY from on rollback — a schedule
+        call never leaves the internal marker behind on any path, success or
+        failure. Probe calls pass None: their pods keep memos BY DESIGN
+        (repeated probes skip re-encoding), on success and failure alike."""
+        from .preemption import restore, snapshot
+
+        snap = snapshot(self)
+        base_events = self._commit_events
+        base_counted = self._commits_counted
+        prev = self._txn_armed
+        self._txn_armed = True
+        try:
+            yield
+        except BaseException:
+            uncounted = ((self._commit_events - base_events)
+                         - (self._commits_counted - base_counted))
+            if uncounted > 0:
+                obs.COMMITS.inc(uncounted)
+            restore(self, snap)
+            for p in memo_pods or ():
+                p.pop(SIG_MEMO_KEY, None)
+            raise
+        else:
+            # rollback info is only reachable within this call's restores;
+            # drop it so the logs never grow across successful calls
+            del self._commit_log[snap["log"]:]
+            del self._nominate_log[snap["nominate"]:]
+        finally:
+            self._txn_armed = prev
 
     def _track_priorities(self, pods: List[dict]) -> bool:
         """Arm the PostFilter when >1 distinct priority has been seen across
@@ -356,7 +437,7 @@ class Simulator:
             else:
                 self._commit_pod(pod, ni, scheduled=False)
                 obs.SCHED_ATTEMPTS.labels(result="bound").inc()
-                obs.COMMITS.inc()
+                self._count_commits()
         failed.extend(self._schedule_run(run))
         progress.close()
         if self.gpu_host.enabled:
@@ -382,6 +463,7 @@ class Simulator:
         The incremental capacity prober (simulator/probe.py) holds this form so
         its node-axis extension path can append template columns before the
         bucketed pads are applied."""
+        faults.maybe_fail("encode")
         batch: List[Tuple[int, int]] = []
         for pod in to_schedule:
             # strip_daemon_pin can only fire on pods with node affinity; the
@@ -590,6 +672,7 @@ class Simulator:
         # host as sum(counts), never fetched separately.
         outs: List[tuple] = []  # (seg, device array, carry AFTER the segment)
         for seg in segs:
+            faults.maybe_fail("dispatch")
             if seg[0] == "serial":
                 _, start, length = seg
                 pad = bucket_capped(length, 2048)
@@ -656,6 +739,7 @@ class Simulator:
         final_carry = carry
         seg_of = np.zeros(P, np.int32)
         if outs:
+            faults.maybe_fail("fetch")
             flat = np.asarray(jnp.concatenate([a.astype(jnp.int32) for _, a, _ in outs]))
             off = 0
             for k, (seg, a, _) in enumerate(outs):
@@ -714,7 +798,7 @@ class Simulator:
         obs.SCHED_ATTEMPTS.labels(result="scheduled").inc(placed_n)
         if failed:
             obs.SCHED_ATTEMPTS.labels(result="unschedulable").inc(len(failed))
-        obs.COMMITS.inc(placed_n)
+        self._count_commits(placed_n)
         span.step("commit")
         return failed
 
@@ -737,7 +821,13 @@ class Simulator:
 
         The capacity planner's probe loop (apply.go:203-259 re-simulates the
         whole workload per candidate node count) is the intended caller; the
-        authoritative placement run remains schedule_pods."""
+        authoritative placement run remains schedule_pods. Transactional like
+        schedule_pods: a failure rolls back the pre-bound commits (and their
+        pod-dict status writes — probe pods belong to the CALLER)."""
+        with self._transaction():
+            return self._probe_pods_inner(pods)
+
+    def _probe_pods_inner(self, pods: List[dict]) -> Tuple[int, int]:
         run: List[dict] = []
         scheduled = 0
         homeless = 0
@@ -767,6 +857,7 @@ class Simulator:
         dims = self._dispatch_dims(bt)
         placed_parts = []
         for seg in segs:
+            faults.maybe_fail("dispatch")
             if seg[0] == "serial":
                 _, start, length = seg
                 pad = bucket_capped(length, 2048)
@@ -828,6 +919,7 @@ class Simulator:
                 )
                 placed_parts.append(placed)
         self._last_tables, self._last_carry = bt, carry
+        faults.maybe_fail("fetch")
         total = int(np.asarray(jnp.sum(jnp.stack(placed_parts))))  # one fetch
         return scheduled + total, total_known
 
@@ -898,6 +990,7 @@ class Simulator:
         }
 
     def _to_device(self, bt: BatchTables):
+        faults.maybe_fail("to_device")
         jnp = _jax()
         from ..parallel.mesh import tables_from_batch
 

@@ -7,26 +7,62 @@ Pending); PDBs; services; storage classes; PVCs; config maps; daemon sets.
 Implemented against the REST API with the standard library (no kubernetes client
 dependency): kubeconfig parsing supports bearer tokens, client certificates (inline
 data or files), CA bundles, and insecure-skip-tls-verify.
+
+Failure semantics (README "Failure handling", PARITY.md for the client-go
+mapping): every GET classifies into the typed hierarchy below and runs under
+a RetryPolicy + CircuitBreaker — transient failures (429/5xx/network) retry
+with seeded-jitter backoff honoring Retry-After; auth failures (401/403)
+never retry; LIST pagination restarts from scratch on 410 Gone exactly like
+a client-go reflector relist on an expired continue token.
 """
 
 from __future__ import annotations
 
 import base64
+import http.client
 import json
 import os
 import ssl
 import tempfile
+import urllib.error
 import urllib.request
 from typing import List, Optional, Tuple
 
 import yaml
 
 from ..core.types import ResourceTypes
+from ..resilience import faults
+from ..resilience.policy import CircuitBreaker, RetryPolicy
 from ..utils.objutil import is_owned_by_kind
 
 
 class LiveClusterError(RuntimeError):
-    pass
+    """Base class for live-cluster failures (kept as the catch-all name for
+    compatibility; new code should catch the typed subclasses)."""
+
+
+class AuthError(LiveClusterError):
+    """401/403 or a failed credential plugin — retrying cannot help."""
+
+
+class TransientError(LiveClusterError):
+    """429/5xx/network/timeouts — retry with backoff. `retry_after` carries
+    the server's Retry-After hint (seconds, 0 when absent)."""
+
+    def __init__(self, msg: str, retry_after: float = 0.0,
+                 code: Optional[int] = None) -> None:
+        super().__init__(msg)
+        self.retry_after = float(retry_after)
+        self.code = code
+
+
+class ProtocolError(LiveClusterError):
+    """The apiserver answered but not usably (unexpected 4xx, bad JSON).
+    410 Gone carries `code=410` — the LIST path restarts pagination on it."""
+
+    def __init__(self, msg: str, code: Optional[int] = None) -> None:
+        super().__init__(msg)
+        self.code = code
 
 
 def _b64_to_tempfile(data: str, suffix: str) -> str:
@@ -42,6 +78,15 @@ def _text_to_tempfile(text: str, suffix: str) -> str:
     f.write(text)
     f.close()
     return f.name
+
+
+def _retry_after(headers) -> float:
+    """Parse a Retry-After header as delay-seconds (the apiserver's 429s use
+    the seconds form; an unparsable/absent value means no hint)."""
+    try:
+        return max(0.0, float(headers.get("Retry-After", "")))
+    except (TypeError, ValueError):
+        return 0.0
 
 
 def _run_exec_credential(exec_cfg: dict):
@@ -65,7 +110,7 @@ def _run_exec_credential(exec_cfg: dict):
                              timeout=60, check=True)
         cred = json.loads(out.stdout)
     except Exception as e:
-        raise LiveClusterError(
+        raise AuthError(
             f"exec credential plugin {cmd[0]!r} failed: {e}") from e
     status = cred.get("status") or {}
     token = status.get("token")
@@ -128,17 +173,65 @@ class KubeClient:
             cert_file, key_file = self._exec_cert
         if cert_file and key_file:
             self.ssl_ctx.load_cert_chain(certfile=cert_file, keyfile=key_file)
+        self._init_policies()
 
-    def get(self, path: str, timeout: float = 30.0) -> dict:
+    # Failure-policy knobs, overridable per client (tests pin tiny sleeps).
+    # The breaker is per-client: the server's handler threads share one
+    # KubeClient, so five consecutive apiserver failures fail the NEXT
+    # request fast instead of stacking 30s timeout pile-ups.
+    RETRY = RetryPolicy(max_attempts=4, base=0.25, mult=2.0, cap=5.0,
+                        jitter=0.2, max_elapsed=30.0, seed=0)
+    BREAKER_THRESHOLD = 5
+    BREAKER_RESET_AFTER = 15.0
+    # Bounded 410-Gone relists per LIST call (client-go reflectors relist
+    # forever; a snapshotting client must eventually fail loudly instead).
+    MAX_RELISTS = 2
+
+    def _init_policies(self) -> None:
+        self.retry = self.RETRY
+        self.breaker = CircuitBreaker(
+            "live_cluster", failure_threshold=self.BREAKER_THRESHOLD,
+            reset_after=self.BREAKER_RESET_AFTER)
+
+    def _get_once(self, path: str, timeout: float) -> dict:
+        from ..resilience.policy import deadline_remaining
+
+        faults.maybe_fail("live_get")
         req = urllib.request.Request(self.server + path)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
         req.add_header("Accept", "application/json")
+        # an active Deadline slices the socket timeout: a callee never blocks
+        # past the caller's remaining budget
+        rem = deadline_remaining()
+        if rem is not None:
+            timeout = min(timeout, max(rem, 0.001))
         try:
             with urllib.request.urlopen(req, timeout=timeout, context=self.ssl_ctx) as r:
                 return json.loads(r.read())
-        except Exception as e:  # urllib raises a zoo of types; wrap them all
-            raise LiveClusterError(f"GET {path} failed: {e}") from e
+        except urllib.error.HTTPError as e:
+            msg = f"GET {path} failed: HTTP {e.code} {e.reason}"
+            if e.code in (401, 403):
+                raise AuthError(msg) from e
+            if e.code == 429 or e.code >= 500:
+                raise TransientError(
+                    msg, retry_after=_retry_after(e.headers), code=e.code) from e
+            raise ProtocolError(msg, code=e.code) from e
+        except (OSError, http.client.HTTPException) as e:
+            # URLError/timeouts/resets subclass OSError; a connection dropped
+            # mid-body surfaces as IncompleteRead/BadStatusLine
+            # (HTTPException, NOT OSError) — both classes are transient
+            raise TransientError(f"GET {path} failed: {e}") from e
+        except ValueError as e:  # undecodable body: answered, but not usably
+            raise ProtocolError(f"GET {path} returned bad JSON: {e}") from e
+
+    def get(self, path: str, timeout: float = 30.0) -> dict:
+        """One logical GET: retried on TransientError (Retry-After honored,
+        401/403 never retried), deadline-budgeted, breaker-gated."""
+        return self.retry.call(
+            lambda: self._get_once(path, timeout), site="live_get",
+            retryable=lambda e: isinstance(e, TransientError),
+            breaker=self.breaker)
 
     # Chunk size per LIST request: apiserver-friendly paging so 3,000+-node
     # clusters (the reference's claimed scale, changelogs/v0.1.3.md) never
@@ -146,6 +239,23 @@ class KubeClient:
     PAGE_LIMIT = 500
 
     def list(self, path: str, **params) -> List[dict]:
+        """Paginated LIST. A 410 Gone mid-pagination (continue token expired
+        under churn) throws away the partial result and restarts from scratch
+        — the observable behavior of a client-go reflector relist — at most
+        MAX_RELISTS times before failing loudly."""
+        from ..obs import instruments as obs
+
+        restarts = 0
+        while True:
+            try:
+                return self._list_pages(path, **params)
+            except ProtocolError as e:
+                if e.code != 410 or restarts >= self.MAX_RELISTS:
+                    raise
+                restarts += 1
+                obs.RETRIES.labels(site="live_list_relist").inc()
+
+    def _list_pages(self, path: str, **params) -> List[dict]:
         from urllib.parse import urlencode
 
         items: List[dict] = []
@@ -214,8 +324,11 @@ def _create_cluster_resource_from_client(client_or_path, master: str = "") -> Re
 
 
 __all__ = [
+    "AuthError",
     "KubeClient",
     "LiveClusterError",
+    "ProtocolError",
+    "TransientError",
     "create_kube_client",
     "create_cluster_resource_from_client",
 ]

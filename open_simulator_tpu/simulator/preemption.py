@@ -111,6 +111,7 @@ def snapshot(sim) -> dict:
         "pods_on_node": [list(l) for l in sim.pods_on_node],
         "homeless": len(sim.homeless),
         "log": len(sim._commit_log),
+        "nominate": len(sim._nominate_log),
         "prio": len(sim._commits_prio),
         "preempted": len(sim.preempted),
         "gpu": sim.gpu_host.snapshot() if sim.gpu_host.enabled else None,
@@ -120,24 +121,57 @@ def snapshot(sim) -> dict:
 
 def restore(sim, snap: dict) -> None:
     # undo pod-dict mutations from commits after the snapshot (replayed
-    # prefixes re-commit the same pods identically)
-    for pod, prev_idx, prev_assume in sim._commit_log[snap["log"]:]:
-        (pod.get("spec") or {}).pop("nodeName", None)
-        pod.pop("status", None)
-        anns = (pod.get("metadata") or {}).get("annotations")
-        if anns is not None:
-            if prev_idx is None:
-                anns.pop(C.AnnoGpuIndex, None)
+    # prefixes re-commit the same pods identically); pre-bound pods get their
+    # original nodeName/status objects back (the crash-consistency rollback
+    # must leave CALLER-owned pod dicts bit-identical)
+    gpu_enabled = sim.gpu_host.enabled  # commit only logs annotations then
+    for pod, prev_idx, prev_assume, prev_nn, prev_status in sim._commit_log[snap["log"]:]:
+        spec = pod.get("spec")
+        if spec is not None:
+            if prev_nn is None:
+                spec.pop("nodeName", None)
             else:
-                anns[C.AnnoGpuIndex] = prev_idx
-            if prev_assume is None:
-                anns.pop(C.AnnoGpuAssumeTime, None)
-            else:
-                anns[C.AnnoGpuAssumeTime] = prev_assume
+                spec["nodeName"] = prev_nn
+        if prev_status is None:
+            pod.pop("status", None)
+        else:
+            pod["status"] = prev_status
+        if gpu_enabled:
+            anns = (pod.get("metadata") or {}).get("annotations")
+            if anns is not None:
+                if prev_idx is None:
+                    anns.pop(C.AnnoGpuIndex, None)
+                else:
+                    anns[C.AnnoGpuIndex] = prev_idx
+                if prev_assume is None:
+                    anns.pop(C.AnnoGpuAssumeTime, None)
+                else:
+                    anns[C.AnnoGpuAssumeTime] = prev_assume
         sim._sig_of.pop(id(pod), None)
+    # undo nominatedNodeName writes on failed preemptors (crash-consistency
+    # rollbacks only: the normal loop re-snapshots after each nomination)
+    for pod, had_status, prev_value, had_key in reversed(
+            sim._nominate_log[snap["nominate"]:]):
+        if not had_status:
+            pod.pop("status", None)
+        else:
+            st = pod.get("status")
+            if st is not None:
+                if had_key:
+                    st["nominatedNodeName"] = prev_value
+                else:
+                    st.pop("nominatedNodeName", None)
+    del sim._nominate_log[snap["nominate"]:]
     rolled = len(sim._commits_prio) - snap["prio"]
     if rolled > 0:
         obs.COMMIT_ROLLBACKS.inc(rolled)
+    unevicted = len(sim.preempted) - snap["preempted"]
+    if unevicted > 0:
+        # Only a crash-consistency rollback un-evicts (the preemption loop
+        # always re-snapshots after evict): the restored victims re-enter the
+        # census, so count them as commits — simon_commits_total −
+        # rollbacks − victims stays bit-identical to the pre-call value.
+        obs.COMMITS.inc(unevicted)
     del sim._commit_log[snap["log"]:]
     del sim._commits_prio[snap["prio"]:]
     del sim.preempted[snap["preempted"]:]
@@ -387,6 +421,9 @@ def evict(sim, victims: List[dict], node_i: int, preemptor: dict) -> None:
     deleted from the fake cluster (util.DeletePod), freeing their capacity
     for every later pod. Ledger releases keep the gpushare/open-local node
     annotations consistent (the engine treats pods_on_node as truth)."""
+    from ..resilience import faults
+
+    faults.maybe_fail("preempt_evict")
     lst = sim.pods_on_node[node_i]
     for p in victims:
         sig = sim._sig_of[id(p)][0]
@@ -454,7 +491,13 @@ def schedule_with_preemption(sim, pods: List[dict]) -> List[UnscheduledPod]:
             # same-signature pod that could now preempt.
             attempted.clear()
             # recordSchedulingFailure sets status.nominatedNodeName before
-            # Simon deletes the pod; keep it visible on the record
+            # Simon deletes the pod; keep it visible on the record (logged
+            # for the crash-consistency rollback — not a commit)
+            st = pod.get("status")
+            sim._nominate_log.append((
+                pod, st is not None,
+                st.get("nominatedNodeName") if st is not None else None,
+                st is not None and "nominatedNodeName" in st))
             pod.setdefault("status", {})["nominatedNodeName"] = sim.na.names[node_i]
         else:
             attempted[(scheduling_signature(pod), pod_priority(pod))] = len(

@@ -66,6 +66,7 @@ import numpy as np
 
 from ..models.fakenode import new_fake_nodes
 from ..obs import instruments as obs
+from ..resilience import faults
 from ..ops.resources import CPU_I, MEM_I
 from .encode import (
     HOSTNAME,
@@ -143,6 +144,16 @@ class ProbeSession:
         if any((n.get("status") or {}).get("images") for n in sim.na.nodes):
             return None  # ImageLocality divides by the TOTAL node count
 
+        # The rest mutates caller-owned pods (bound commits write status) and
+        # runs the faultable encode/upload path: transactional, so a failure
+        # mid-build rolls the pods back before propagating (crash
+        # consistency for the capacity search).
+        with sim._transaction():
+            return cls._try_build_encoded(sim, t0, n_base, n0, pods, fanout,
+                                          mesh)
+
+    @classmethod
+    def _try_build_encoded(cls, sim, t0, n_base, n0, pods, fanout, mesh):
         # Bound pods commit once (they are cluster state every candidate
         # shares); the unbound remainder becomes the one encoded run.
         from ..utils.objutil import pod_resource_requests
@@ -255,6 +266,7 @@ class ProbeSession:
 
     def _upload(self) -> None:
         """(Re-)pad and transfer the tables; rebuild per-segment batch arrays."""
+        faults.maybe_fail("to_device")
         jnp = _jax()
         from .engine import batch_tables_nbytes
 
@@ -423,6 +435,7 @@ class ProbeSession:
         placed_parts = []
         with ctx:
             for seg in self._segs:
+                faults.maybe_fail("dispatch")
                 if seg[0] == "serial":
                     _, start, length = seg
                     pad = bucket_capped(length, 2048)
@@ -472,6 +485,7 @@ class ProbeSession:
                         block=block,
                     )
                 placed_parts.append(placed)
+            faults.maybe_fail("fetch")
             placed_s = np.asarray(jnp.sum(jnp.stack(placed_parts), axis=0))
             requested_s = np.asarray(carry_s.requested)
         return placed_s[:S], requested_s[:S]

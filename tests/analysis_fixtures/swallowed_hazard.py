@@ -1,0 +1,81 @@
+"""simonlint fixture: swallowed-exception hazards. NEVER imported — AST only."""
+
+import logging
+import sys
+
+log = logging.getLogger(__name__)
+
+
+def swallow_pass():
+    try:
+        risky()  # noqa: F821 - fixture
+    except Exception:  # FINDING: the classic silent swallow
+        pass
+
+
+def swallow_bare():
+    try:
+        risky()  # noqa: F821 - fixture
+    except:  # noqa: E722 - fixture  # FINDING: bare except, fallback only
+        value = None
+    return value
+
+
+def swallow_tuple():
+    try:
+        risky()  # noqa: F821 - fixture
+    except (ValueError, Exception):  # FINDING: Exception hides in the tuple
+        value = 0
+    return value
+
+
+def swallow_waived():
+    try:
+        risky()  # noqa: F821 - fixture
+    except Exception:  # simonlint: ignore[swallowed-exception] -- best-effort cleanup, fixture
+        pass
+
+
+def ok_narrow():
+    try:
+        risky()  # noqa: F821 - fixture
+    except ValueError:  # narrow type: a typed decision, not a swallow
+        value = 0
+    return value
+
+
+def ok_reraise():
+    try:
+        risky()  # noqa: F821 - fixture
+    except Exception as e:
+        raise RuntimeError("wrapped") from e
+
+
+def ok_logged():
+    try:
+        risky()  # noqa: F821 - fixture
+    except Exception as e:
+        log.warning("risky failed: %s", e)
+
+
+def ok_counted(metric):
+    try:
+        risky()  # noqa: F821 - fixture
+    except Exception:
+        metric.labels(reason="boom").inc()
+
+
+def ok_returns_error():
+    try:
+        risky()  # noqa: F821 - fixture
+    except Exception as e:
+        return 500, str(e)
+    return 200, "ok"
+
+
+def ok_exits():
+    try:
+        risky()  # noqa: F821 - fixture
+    except Exception as e:
+        print(f"fatal: {e}", file=sys.stderr)
+        sys.exit(1)

@@ -111,6 +111,30 @@ def test_metric_in_jit_spares_at_set_and_host_code():
     assert not any(f.line >= host_start for f in hits)
 
 
+def test_swallowed_exception_rule_fires():
+    fr = analyze_file(str(FIXTURES / "swallowed_hazard.py"))
+    hits = [f for f in fr.findings
+            if f.rule == "swallowed-exception" and not f.suppressed]
+    assert len(hits) == 3
+    msgs = "\n".join(f.message for f in hits)
+    assert "bare except:" in msgs
+    assert "except Exception" in msgs
+    assert "broad except tuple" in msgs
+    # the whitelisted best-effort block is reported suppressed, not active
+    assert _counts("swallowed_hazard.py", "swallowed-exception",
+                   suppressed=True) == 1
+
+
+def test_swallowed_exception_spares_handled_paths():
+    # narrow types, re-raise, logging, metric counting, error returns, and
+    # sys.exit all count as handling — the ok_* half of the fixture is clean
+    fr = analyze_file(str(FIXTURES / "swallowed_hazard.py"))
+    src = (FIXTURES / "swallowed_hazard.py").read_text().splitlines()
+    ok_start = next(i for i, l in enumerate(src, 1) if "def ok_narrow" in l)
+    assert not any(f.line >= ok_start for f in fr.findings
+                   if f.rule == "swallowed-exception")
+
+
 def test_clean_module_is_clean():
     fr = analyze_file(str(FIXTURES / "clean_module.py"))
     assert fr.findings == []
@@ -120,7 +144,8 @@ def test_fixture_tree_reports_all_families_and_fails():
     report = analyze_paths([str(FIXTURES)])
     fired = {f.rule for f in report.findings if not f.suppressed}
     assert {"host-sync-in-jit", "recompile-trigger",
-            "dtype-drift", "carry-contract", "metric-in-jit"} <= fired
+            "dtype-drift", "carry-contract", "metric-in-jit",
+            "swallowed-exception"} <= fired
     assert report.active(Severity.WARNING)
     rc = run_lint([str(FIXTURES)])
     assert rc == 1

@@ -68,6 +68,36 @@ def test_cli_apply_missing_config(capsys):
     assert "apply error" in capsys.readouterr().err
 
 
+def test_cli_apply_fault_plan_fails_cleanly(tmp_path, monkeypatch, capsys):
+    """--fault-plan injects deterministically: the run fails with the
+    injected site in the error, prints the replayable trace, and clears the
+    plan for later runs in the same process."""
+    from open_simulator_tpu.resilience import active_plan
+
+    monkeypatch.chdir(REPO)
+    rc = cli_main([
+        "apply", "-f", "examples/simon-smoke-config.yaml",
+        "--output-file", str(tmp_path / "report.txt"),
+        "--fault-plan", "site=encode,attempt=1",
+    ])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "apply error" in err and "injected fault at encode" in err
+    assert 'fault plan trace: [["encode", 1, "runtime"]]' in err
+    assert active_plan() is None  # cleared even on failure
+
+
+def test_cli_apply_deadline_expires_cleanly(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(REPO)
+    rc = cli_main([
+        "apply", "-f", "examples/simon-smoke-config.yaml",
+        "--output-file", str(tmp_path / "report.txt"),
+        "--deadline", "0.0001",
+    ])
+    assert rc == 1
+    assert "deadline exceeded" in capsys.readouterr().err
+
+
 def test_cli_apply_trace_and_metrics_out(tmp_path, monkeypatch):
     """--trace-out writes a perfetto-loadable Chrome trace with nested engine
     spans and the metrics snapshot; --metrics-out writes the snapshot alone;
@@ -159,9 +189,14 @@ def test_deploy_apps_busy_returns_503():
     server.deploy_lock.acquire()
     try:
         code, body = server.handle_deploy_apps({})
-        assert code == 503 and "busy" in body
+        # structured error contract: {"error": ..., "code": ...}
+        assert code == 503 and "busy" in body["error"] and body["code"] == 503
     finally:
         server.deploy_lock.release()
+    # the busy path never released a lock it didn't hold: the endpoint
+    # works again immediately
+    code, _body = server.handle_deploy_apps({})
+    assert code == 200
 
 
 def test_scale_apps_removes_owned_pods():
@@ -205,12 +240,13 @@ def test_http_round_trip():
         body = json.loads(resp.read())
         assert sum(len(ns["pods"]) for ns in body["nodeStatus"]) == 2
 
-        # invalid UTF-8 body → in-band 400, identical to the gRPC bridge
+        # invalid UTF-8 body → in-band structured 400
         conn.request("POST", "/api/deploy-apps", body=b"\x80abc",
                      headers={"Content-Type": "application/json"})
         resp = conn.getresponse()
         assert resp.status == 400
-        assert "fail to unmarshal" in json.loads(resp.read())
+        body = json.loads(resp.read())
+        assert "fail to unmarshal" in body["error"] and body["code"] == 400
     finally:
         httpd.shutdown()
 
@@ -250,6 +286,195 @@ def test_metrics_scrape_smoke():
                    for k in body["metrics"])
     finally:
         httpd.shutdown()
+
+
+def test_handler_exception_is_structured_counted_and_releases_lock():
+    """A raising snapshot_fn yields a structured 500 (never a bare string),
+    moves simon_http_errors_total, and leaves the endpoint lock released."""
+    from open_simulator_tpu.obs import REGISTRY
+
+    def boom():
+        raise RuntimeError("apiserver exploded")
+
+    server = Server(snapshot_fn=boom)
+
+    def err_count():
+        return sum(v for k, v in REGISTRY.values().items()
+                   if k.startswith("simon_http_errors_total")
+                   and 'endpoint="deploy-apps"' in k and '"500"' in k)
+
+    before = err_count()
+    code, body = server.handle_deploy_apps({})
+    assert code == 500
+    assert body["code"] == 500 and "apiserver exploded" in body["error"]
+    assert err_count() == before + 1
+    assert not server.deploy_lock.locked()
+
+
+def test_debug_fault_plan_endpoint():
+    """POST /debug/fault-plan installs a deterministic plan; the next deploy
+    fails with a structured 500 naming the injected site; empty POST clears."""
+    from open_simulator_tpu.resilience import active_plan, clear_plan
+
+    nodes = [make_node("n1")]
+    # the endpoint is a process-global write: strictly opt-in
+    server = Server(snapshot_fn=lambda: _snapshot(nodes=nodes),
+                    debug_faults=True)
+    httpd = server.build_httpd(port=0, host="127.0.0.1")
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        plan = {"faults": [{"site": "encode", "attempt": 1, "error": "runtime"}]}
+        conn.request("POST", "/debug/fault-plan", body=json.dumps(plan),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read())["faults"] == plan["faults"]
+
+        deploy = make_deployment("web", replicas=2, cpu="1", memory="1Gi")
+        conn.request("POST", "/api/deploy-apps",
+                     body=json.dumps({"deployments": [deploy]}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 500
+        assert "injected fault at encode" in json.loads(resp.read())["error"]
+
+        # GET shows the fired trace; empty POST clears the plan
+        conn.request("GET", "/debug/fault-plan")
+        trace = json.loads(conn.getresponse().read())["trace"]
+        assert ["encode", 1, "runtime"] in trace
+        conn.request("POST", "/debug/fault-plan", body=b"{}",
+                     headers={"Content-Type": "application/json"})
+        assert conn.getresponse().status == 200
+        assert active_plan() is None
+
+        conn.request("POST", "/api/deploy-apps",
+                     body=json.dumps({"deployments": [deploy]}),
+                     headers={"Content-Type": "application/json"})
+        assert conn.getresponse().status == 200
+    finally:
+        clear_plan()
+        httpd.shutdown()
+
+
+def test_debug_fault_plan_endpoint_disabled_by_default():
+    """Without the explicit opt-in, the write endpoint refuses with 403 —
+    a reachable port must never be a one-request DoS."""
+    server = Server(snapshot_fn=lambda: _snapshot(nodes=[make_node("n1")]))
+    assert server.debug_faults is False
+    httpd = server.build_httpd(port=0, host="127.0.0.1")
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("POST", "/debug/fault-plan",
+                     body=json.dumps({"faults": [{"site": "encode"}]}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 403 and "disabled" in body["error"]
+        conn.request("GET", "/debug/fault-plan")
+        assert conn.getresponse().status == 403
+    finally:
+        httpd.shutdown()
+    from open_simulator_tpu.resilience import active_plan
+
+    assert active_plan() is None
+
+
+def test_graceful_drain_finishes_inflight_and_rejects_new():
+    """Server.drain (the SIGTERM path): a slow in-flight request completes
+    200 while requests arriving after drain started get structured 503s."""
+    import time
+
+    nodes = [make_node("n1")]
+    release = threading.Event()
+    entered = threading.Event()
+
+    def slow_snapshot():
+        entered.set()
+        assert release.wait(timeout=30)
+        return _snapshot(nodes=nodes)
+
+    server = Server(snapshot_fn=slow_snapshot)
+    httpd = server.build_httpd(port=0, host="127.0.0.1")
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    results = {}
+
+    def inflight():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        deploy = make_deployment("web", replicas=1, cpu="1", memory="1Gi")
+        conn.request("POST", "/api/deploy-apps",
+                     body=json.dumps({"deployments": [deploy]}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        results["inflight"] = (resp.status, json.loads(resp.read()))
+
+    t = threading.Thread(target=inflight)
+    t.start()
+    assert entered.wait(timeout=10)  # the slow request is now in flight
+
+    drained = {}
+    dt = threading.Thread(target=lambda: drained.update(
+        stranded=server.drain(deadline=20.0)))
+    dt.start()
+    # draining flips synchronously; new requests are refused with 503
+    deadline = time.monotonic() + 5
+    while not server.draining and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert server.draining
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", "/healthz")
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    assert resp.status == 503 and body["code"] == 503
+    assert "draining" in body["error"]
+
+    release.set()  # let the in-flight request finish
+    t.join(timeout=30)
+    dt.join(timeout=30)
+    assert results["inflight"][0] == 200
+    assert drained["stranded"] == 0
+
+
+def test_drain_deadline_bounds_stuck_requests():
+    """A request that never finishes cannot hold the drain hostage: the
+    bounded deadline expires and reports the stranded request."""
+    nodes = [make_node("n1")]
+    stuck = threading.Event()
+
+    def stuck_snapshot():
+        stuck.wait(timeout=60)
+        return _snapshot(nodes=nodes)
+
+    server = Server(snapshot_fn=stuck_snapshot)
+    httpd = server.build_httpd(port=0, host="127.0.0.1")
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    def hang():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        deploy = make_deployment("web", replicas=1, cpu="1", memory="1Gi")
+        try:
+            conn.request("POST", "/api/deploy-apps",
+                         body=json.dumps({"deployments": [deploy]}),
+                         headers={"Content-Type": "application/json"})
+            conn.getresponse()
+        except OSError:
+            pass  # the drain may sever the connection
+
+    t = threading.Thread(target=hang, daemon=True)
+    t.start()
+    import time
+    deadline = time.monotonic() + 5
+    while not server._inflight and time.monotonic() < deadline:
+        time.sleep(0.01)
+    stranded = server.drain(deadline=0.3)
+    assert stranded == 1
+    stuck.set()
 
 
 @pytest.mark.skipif(sys.platform != "linux", reason="reads /proc/self/status")

@@ -176,7 +176,7 @@ def test_grpc_scale_and_busy(grpc_mod):
                                          response_deserializer=ident)
             code, body = decode_simulate_response(deploy(encode_simulate_request(b"{}")))
             assert code == 503
-            assert "busy" in json.loads(body)
+            assert "busy" in json.loads(body)["error"]  # structured error contract
         finally:
             http_server.deploy_lock.release()
     finally:

@@ -1,21 +1,37 @@
-"""Live-cluster client: paginated LIST (limit/continue) and exec-credential
-auth, against an in-process fake apiserver — the hardening behind the
-reference's 3,000+-node claim (changelogs/v0.1.3.md)."""
+"""Live-cluster client: paginated LIST (limit/continue), exec-credential
+auth, and the simonfault failure policies (retry/backoff with Retry-After,
+401-never-retry, 410-Gone relist, circuit breaker, deadline slicing) against
+an in-process fake apiserver — the hardening behind the reference's
+3,000+-node claim (changelogs/v0.1.3.md)."""
 
 import json
 import os
 import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 import pytest
 
+from open_simulator_tpu.obs import REGISTRY
+from open_simulator_tpu.resilience.policy import (
+    BreakerOpen,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+)
 from open_simulator_tpu.simulator.live import (
+    AuthError,
     KubeClient,
     LiveClusterError,
+    TransientError,
     create_cluster_resource_from_client,
 )
+
+FAST_RETRY = RetryPolicy(max_attempts=4, base=0.001, mult=2.0, cap=0.01,
+                         jitter=0.0, max_elapsed=10.0, seed=0)
 
 
 def fake_apiserver(n_nodes=7, page=3, require_token=None):
@@ -132,6 +148,204 @@ def test_exec_credential_token(tmp_path):
 def test_exec_credential_failure_is_loud(tmp_path):
     user = {"exec": {"command": sys.executable,
                      "args": ["-c", "import sys; sys.exit(3)"]}}
-    with pytest.raises(LiveClusterError) as e:
+    with pytest.raises(AuthError) as e:  # typed: retrying cannot help
         KubeClient(write_kubeconfig(tmp_path, 1, user=user))
     assert "exec credential" in str(e.value)
+    assert isinstance(e.value, LiveClusterError)  # compat: old name still catches
+
+
+# ------------------------------------------------- failure-policy behavior ----
+
+
+def scripted_apiserver(n_nodes=5, script=None):
+    """Like fake_apiserver, but each request first consults `script`: a
+    mutable list of {"status": int, "headers": {...}, "require_continue":
+    bool} entries. The first matching entry is popped and served as the
+    response; with no match the normal paginated answer goes out. Returns
+    (httpd, port, seen)."""
+    nodes = [{"metadata": {"name": f"n{i}"},
+              "status": {"allocatable": {"cpu": "1", "memory": "1Gi", "pods": "10"}}}
+             for i in range(n_nodes)]
+    script = script if script is not None else []
+    seen = []
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            u = urlparse(self.path)
+            q = {k: v[0] for k, v in parse_qs(u.query).items()}
+            seen.append((u.path, q))
+            for i, entry in enumerate(script):
+                if entry.get("require_continue") and "continue" not in q:
+                    continue
+                script.pop(i)
+                if entry.get("truncate"):
+                    # promise a body and drop the connection mid-read:
+                    # the client sees http.client.IncompleteRead
+                    self.send_response(200)
+                    self.send_header("Content-Length", "100")
+                    self.end_headers()
+                    self.wfile.write(b"x")
+                    self.wfile.flush()
+                    self.connection.close()
+                    return
+                self.send_response(entry["status"])
+                for k, v in (entry.get("headers") or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            if u.path == "/api/v1/nodes":
+                limit = int(q.get("limit", 0)) or len(nodes)
+                start = int(q.get("continue", 0))
+                items = nodes[start:start + limit]
+                nxt = start + limit
+                body = {"kind": "NodeList", "apiVersion": "v1", "items": items,
+                        "metadata": ({"continue": str(nxt)} if nxt < len(nodes) else {})}
+            else:
+                body = {"kind": "List", "apiVersion": "v1", "items": [],
+                        "metadata": {}}
+            data = json.dumps(body).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, httpd.server_address[1], seen
+
+
+def _retry_count(site):
+    return sum(v for k, v in REGISTRY.values().items()
+               if k.startswith("simon_retries_total") and f'"{site}"' in k)
+
+
+def test_transient_5xx_retried_then_succeeds(tmp_path):
+    httpd, port, seen = scripted_apiserver(
+        n_nodes=3, script=[{"status": 503}, {"status": 500}])
+    try:
+        client = KubeClient(write_kubeconfig(tmp_path, port))
+        client.retry = FAST_RETRY
+        before = _retry_count("live_get")
+        nodes = client.list("/api/v1/nodes")
+        assert len(nodes) == 3
+        assert len(seen) == 3  # 503, 500, then the successful page
+        assert _retry_count("live_get") - before == 2
+    finally:
+        httpd.shutdown()
+
+
+def test_connection_dropped_mid_body_is_transient_and_retried(tmp_path):
+    # IncompleteRead is an http.client.HTTPException, NOT an OSError: it must
+    # still classify TransientError (and so stay catchable as LiveClusterError)
+    httpd, port, seen = scripted_apiserver(
+        n_nodes=2, script=[{"truncate": True}])
+    try:
+        client = KubeClient(write_kubeconfig(tmp_path, port))
+        client.retry = FAST_RETRY
+        nodes = client.list("/api/v1/nodes")
+        assert len(nodes) == 2 and len(seen) == 2
+    finally:
+        httpd.shutdown()
+
+
+def test_429_honors_retry_after_floor(tmp_path):
+    httpd, port, seen = scripted_apiserver(
+        n_nodes=1, script=[{"status": 429, "headers": {"Retry-After": "0.3"}}])
+    try:
+        client = KubeClient(write_kubeconfig(tmp_path, port))
+        client.retry = FAST_RETRY  # backoff alone would sleep ~1ms
+        t0 = time.perf_counter()
+        nodes = client.list("/api/v1/nodes")
+        elapsed = time.perf_counter() - t0
+        assert len(nodes) == 1 and len(seen) == 2
+        assert elapsed >= 0.3, f"Retry-After not honored ({elapsed:.3f}s)"
+    finally:
+        httpd.shutdown()
+
+
+def test_auth_errors_never_retried(tmp_path):
+    for status in (401, 403):
+        httpd, port, seen = scripted_apiserver(
+            n_nodes=1, script=[{"status": status}, {"status": status}])
+        try:
+            client = KubeClient(write_kubeconfig(tmp_path, port))
+            client.retry = FAST_RETRY
+            with pytest.raises(AuthError):
+                client.list("/api/v1/nodes")
+            assert len(seen) == 1, f"{status} must fail on the FIRST attempt"
+        finally:
+            httpd.shutdown()
+
+
+def test_410_gone_restarts_pagination_from_scratch(tmp_path):
+    # the continue token "expires" once mid-pagination: the partial result is
+    # discarded and the LIST restarts — no duplicates, no gaps (client-go
+    # reflector relist semantics)
+    httpd, port, seen = scripted_apiserver(
+        n_nodes=5, script=[{"status": 410, "require_continue": True}])
+    try:
+        client = KubeClient(write_kubeconfig(tmp_path, port))
+        client.retry = FAST_RETRY
+        client.PAGE_LIMIT = 2
+        nodes = client.list("/api/v1/nodes")
+        assert [n["metadata"]["name"] for n in nodes] == [f"n{i}" for i in range(5)]
+        # first pass: page + failed continue; restart: 3 clean pages
+        assert len(seen) == 5
+    finally:
+        httpd.shutdown()
+
+
+def test_410_gone_relists_are_bounded(tmp_path):
+    httpd, port, _seen = scripted_apiserver(
+        n_nodes=5,
+        script=[{"status": 410, "require_continue": True}] * 10)
+    try:
+        client = KubeClient(write_kubeconfig(tmp_path, port))
+        client.retry = FAST_RETRY
+        client.PAGE_LIMIT = 2
+        with pytest.raises(LiveClusterError):
+            client.list("/api/v1/nodes")  # MAX_RELISTS exhausted: loud failure
+    finally:
+        httpd.shutdown()
+
+
+def test_breaker_opens_after_consecutive_failures_and_fails_fast(tmp_path):
+    httpd, port, seen = scripted_apiserver(
+        n_nodes=1, script=[{"status": 500}] * 10)
+    try:
+        client = KubeClient(write_kubeconfig(tmp_path, port))
+        client.retry = RetryPolicy(max_attempts=1, base=0.001)
+        client.breaker = CircuitBreaker("live_test", failure_threshold=2,
+                                        reset_after=60.0)
+        for _ in range(2):
+            with pytest.raises(TransientError):
+                client.get("/api/v1/nodes")
+        n_before = len(seen)
+        with pytest.raises(BreakerOpen):
+            client.get("/api/v1/nodes")
+        assert len(seen) == n_before, "open breaker must not touch the server"
+    finally:
+        httpd.shutdown()
+
+
+def test_deadline_bounds_live_gets(tmp_path):
+    httpd, port, seen = scripted_apiserver(n_nodes=1)
+    try:
+        client = KubeClient(write_kubeconfig(tmp_path, port))
+        client.retry = FAST_RETRY
+        with Deadline(30.0):
+            assert len(client.list("/api/v1/nodes")) == 1  # budget left: works
+        time.sleep(0.002)
+        with Deadline(0.001):
+            time.sleep(0.005)  # budget gone before the call
+            n_before = len(seen)
+            with pytest.raises(DeadlineExceeded):
+                client.get("/api/v1/nodes")
+            assert len(seen) == n_before
+    finally:
+        httpd.shutdown()

@@ -1,0 +1,540 @@
+"""simonfault tests: policy determinism (backoff/jitter/deadline/breaker),
+seeded fault plans, and crash-consistent rollback under every engine fault
+site — census, pod dicts, and the commits−rollbacks−victims reconciliation
+must be bit-identical to the pre-call state after any injected failure."""
+
+import copy
+
+import pytest
+
+from open_simulator_tpu.obs import REGISTRY
+from open_simulator_tpu.resilience import (
+    SITES,
+    BreakerOpen,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    FaultInjected,
+    FaultPlan,
+    FaultSpec,
+    RetryPolicy,
+    check_deadline,
+    deadline_remaining,
+    installed,
+)
+from open_simulator_tpu.simulator.engine import Simulator
+from open_simulator_tpu.simulator.encode import scheduling_signature
+from open_simulator_tpu.utils.synth import synth_cluster
+
+from fixtures import make_node, make_pod
+
+
+def prio_pod(name, priority, **kw):
+    p = make_pod(name, **kw)
+    p["spec"]["priority"] = priority
+    return p
+
+ENGINE_SITES = ("encode", "to_device", "dispatch", "fetch", "commit")
+
+
+def test_engine_sites_are_registered():
+    assert set(ENGINE_SITES) <= set(SITES)
+    assert {"live_get", "preempt_evict"} <= set(SITES)
+
+
+# --------------------------------------------------------------- helpers -----
+
+
+def census(sim):
+    out = {}
+    for i, nps in enumerate(sim.pods_on_node):
+        for p in nps:
+            k = (i, scheduling_signature(p))
+            out[k] = out.get(k, 0) + 1
+    return out
+
+
+def _sum(prefix):
+    return sum(v for k, v in REGISTRY.values().items() if k.startswith(prefix))
+
+
+def reconciliation():
+    """commits − rollbacks − victims: the PR-3 invariant that must survive
+    any rollback bit-identically."""
+    return (_sum("simon_commits_total")
+            - _sum("simon_commit_rollbacks_total")
+            - _sum("simon_preemption_victims_total"))
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------- RetryPolicy ------
+
+
+def test_backoff_schedule_is_deterministic_and_seeded():
+    p = RetryPolicy(max_attempts=5, base=0.1, mult=2.0, cap=1.0,
+                    jitter=0.3, seed=42)
+    s1, s2 = p.schedule(), p.schedule()
+    assert s1 == s2  # pure function of the policy
+    assert s1 == RetryPolicy(max_attempts=5, base=0.1, mult=2.0, cap=1.0,
+                             jitter=0.3, seed=42).schedule()
+    # a different seed jitters differently; the un-jittered base is shared
+    s3 = RetryPolicy(max_attempts=5, base=0.1, mult=2.0, cap=1.0,
+                     jitter=0.3, seed=43).schedule()
+    assert s1 != s3
+    for d, d3, base in zip(s1, s3, (0.1, 0.2, 0.4, 0.8)):
+        assert base <= d <= base * 1.3
+        assert base <= d3 <= base * 1.3
+
+
+def test_backoff_cap_and_zero_jitter():
+    p = RetryPolicy(max_attempts=6, base=1.0, mult=10.0, cap=3.0, jitter=0.0)
+    assert p.schedule() == [1.0, 3.0, 3.0, 3.0, 3.0]
+
+
+def test_retry_call_retries_transient_then_succeeds():
+    clock = FakeClock()
+    sleeps = []
+    calls = []
+    p = RetryPolicy(max_attempts=4, base=0.1, jitter=0.0, seed=0)
+    before = _sum("simon_retries_total")
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            e = RuntimeError("transient")
+            e.transient = True
+            raise e
+        return "ok"
+
+    out = p.call(flaky, site="test_site",
+                 retryable=lambda e: getattr(e, "transient", False),
+                 sleep=sleeps.append, clock=clock)
+    assert out == "ok" and len(calls) == 3
+    assert sleeps == p.schedule()[:2]
+    assert _sum("simon_retries_total") - before == 2
+
+
+def test_retry_call_honors_retry_after_floor():
+    sleeps = []
+    p = RetryPolicy(max_attempts=2, base=0.01, jitter=0.0)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            e = RuntimeError("429")
+            e.transient, e.retry_after = True, 7.5
+            raise e
+        return "ok"
+
+    assert p.call(flaky, site="t", retryable=lambda e: True,
+                  sleep=sleeps.append, clock=FakeClock()) == "ok"
+    assert sleeps == [7.5]  # the Retry-After hint floors the backoff
+
+
+def test_retry_call_gives_up_and_never_retries_unretryable():
+    p = RetryPolicy(max_attempts=3, base=0.001, jitter=0.0)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError):
+        p.call(always, site="t", retryable=lambda e: False,
+               sleep=lambda s: None, clock=FakeClock())
+    assert len(calls) == 1  # unretryable: exactly one attempt
+
+    calls.clear()
+    with pytest.raises(ValueError):
+        p.call(always, site="t", retryable=lambda e: True,
+               sleep=lambda s: None, clock=FakeClock())
+    assert len(calls) == 3  # retryable: bounded by max_attempts
+
+
+def test_retry_call_bounded_by_max_elapsed():
+    clock = FakeClock()
+    p = RetryPolicy(max_attempts=10, base=1.0, mult=1.0, jitter=0.0,
+                    max_elapsed=2.5)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise RuntimeError("transient")
+
+    with pytest.raises(RuntimeError):
+        p.call(always, site="t", retryable=lambda e: True,
+               sleep=clock.sleep, clock=clock)
+    assert len(calls) == 3  # attempts at t=0, 1, 2; a 4th would pass 2.5s
+
+
+# -------------------------------------------------------------- Deadline -----
+
+
+def test_deadline_slices_and_nested_only_tightens():
+    clock = FakeClock()
+    assert deadline_remaining(clock) is None
+    with Deadline(10.0, clock=clock):
+        assert deadline_remaining(clock) == pytest.approx(10.0)
+        clock.sleep(4.0)
+        assert deadline_remaining(clock) == pytest.approx(6.0)
+        with Deadline(2.0, clock=clock):  # tighter: wins
+            assert deadline_remaining(clock) == pytest.approx(2.0)
+        with Deadline(100.0, clock=clock):  # looser: outer budget still caps
+            assert deadline_remaining(clock) == pytest.approx(6.0)
+        assert deadline_remaining(clock) == pytest.approx(6.0)
+    assert deadline_remaining(clock) is None
+
+
+def test_deadline_propagates_into_callees_and_check_raises():
+    clock = FakeClock()
+    before = _sum("simon_deadline_exceeded_total")
+
+    def callee():
+        check_deadline("callee_site", clock=clock)
+        return deadline_remaining(clock)
+
+    with Deadline(1.0, clock=clock):
+        assert callee() == pytest.approx(1.0)
+        clock.sleep(1.5)
+        with pytest.raises(DeadlineExceeded):
+            callee()
+    assert _sum("simon_deadline_exceeded_total") - before == 1
+
+
+def test_retry_never_sleeps_past_the_deadline():
+    clock = FakeClock()
+    p = RetryPolicy(max_attempts=5, base=10.0, jitter=0.0)
+
+    def always():
+        raise RuntimeError("transient")
+
+    with Deadline(5.0, clock=clock):
+        with pytest.raises(DeadlineExceeded):
+            p.call(always, site="t", retryable=lambda e: True,
+                   sleep=clock.sleep, clock=clock)
+
+
+# --------------------------------------------------------- CircuitBreaker ----
+
+
+def test_breaker_open_half_open_close_transitions():
+    clock = FakeClock()
+    br = CircuitBreaker("t1", failure_threshold=3, reset_after=10.0,
+                        clock=clock)
+    assert br.state == "closed"
+    for _ in range(2):
+        br.before_call()
+        br.record_failure()
+    assert br.state == "closed"  # below threshold
+    br.before_call()
+    br.record_failure()
+    assert br.state == "open"  # threshold consecutive failures
+    with pytest.raises(BreakerOpen):
+        br.before_call()
+
+    clock.sleep(10.1)  # cooldown elapsed: one probe admitted
+    br.before_call()
+    assert br.state == "half_open"
+    with pytest.raises(BreakerOpen):
+        br.before_call()  # second concurrent probe refused
+    br.record_success()
+    assert br.state == "closed"
+
+    # a successful call resets the consecutive-failure count
+    br.before_call()
+    br.record_failure()
+    br.before_call()
+    br.record_success()
+    for _ in range(2):
+        br.before_call()
+        br.record_failure()
+    assert br.state == "closed"
+
+
+def test_breaker_ignores_non_retryable_failures():
+    """AuthError-class failures prove the dependency is ALIVE: they must not
+    open the breaker (which would mask the actionable 401 behind BreakerOpen)."""
+    br = CircuitBreaker("t_auth", failure_threshold=2, reset_after=60.0,
+                        clock=FakeClock())
+    p = RetryPolicy(max_attempts=1)
+
+    def auth_fail():
+        raise PermissionError("401")
+
+    for _ in range(5):
+        with pytest.raises(PermissionError):
+            p.call(auth_fail, site="t", retryable=lambda e: False,
+                   sleep=lambda s: None, clock=FakeClock(), breaker=br)
+    assert br.state == "closed"
+
+
+def test_breaker_probe_failure_reopens():
+    clock = FakeClock()
+    br = CircuitBreaker("t2", failure_threshold=1, reset_after=5.0, clock=clock)
+    br.before_call()
+    br.record_failure()
+    assert br.state == "open"
+    clock.sleep(5.1)
+    br.before_call()  # the half-open probe
+    br.record_failure()
+    assert br.state == "open"
+    with pytest.raises(BreakerOpen):
+        br.before_call()
+
+
+def test_breaker_state_gauge_exported():
+    CircuitBreaker("gauge_check", failure_threshold=1, reset_after=5.0)
+    vals = REGISTRY.values()
+    assert vals['simon_breaker_state{name="gauge_check"}'] == 0
+
+
+# -------------------------------------------------------------- FaultPlan ----
+
+
+def test_fault_plan_seeded_is_deterministic():
+    a = FaultPlan.seeded(7, n_faults=3, max_attempt=5)
+    b = FaultPlan.seeded(7, n_faults=3, max_attempt=5)
+    assert a.specs == b.specs
+    assert FaultPlan.seeded(8, n_faults=3, max_attempt=5).specs != a.specs
+
+
+def test_fault_plan_parse_forms(tmp_path):
+    p = FaultPlan.parse("site=commit,attempt=3,error=transient;site=encode")
+    assert p.specs == (FaultSpec("commit", 3, "transient"),
+                       FaultSpec("encode", 1, "runtime"))
+    assert FaultPlan.parse("seed=5").specs == FaultPlan.seeded(5).specs
+    assert FaultPlan.parse('{"seed": 5}').specs == FaultPlan.seeded(5).specs
+    f = tmp_path / "plan.json"
+    f.write_text('{"faults": [{"site": "fetch", "attempt": 2}]}')
+    assert FaultPlan.parse(str(f)).specs == (FaultSpec("fetch", 2, "runtime"),)
+    with pytest.raises(ValueError):
+        FaultPlan.parse("site=not_a_site")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("site=commit,attempt=0")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("site=commit,error=nonsense")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("bogus clause")
+
+
+def test_fault_plan_error_classes_map_to_live_hierarchy():
+    from open_simulator_tpu.simulator.live import (
+        AuthError, LiveClusterError, ProtocolError, TransientError)
+
+    for err, cls in (("transient", TransientError), ("auth", AuthError),
+                     ("protocol", ProtocolError)):
+        plan = FaultPlan([FaultSpec("encode", 1, err)])
+        with installed(plan), pytest.raises(cls) as ei:
+            plan.on_arrival("encode")
+        assert isinstance(ei.value, LiveClusterError)
+        assert ei.value.injected
+
+
+# ------------------------------------------- engine fault-site sweep ---------
+
+
+@pytest.fixture(scope="module")
+def small_cluster():
+    return synth_cluster(8, 40)
+
+
+@pytest.mark.parametrize("site", ENGINE_SITES)
+def test_fault_site_rollback_invariance(site, small_cluster):
+    """The acceptance criterion: an injected failure at every engine site
+    leaves census, placements, caller pod dicts, and the metric
+    reconciliation bit-identical to the pre-call state."""
+    nodes, pods = small_cluster
+    sim = Simulator(copy.deepcopy(nodes))
+    p = copy.deepcopy(pods)
+    pre_pods = copy.deepcopy(p)
+    pre_recon = reconciliation()
+    plan = FaultPlan([FaultSpec(site, 1)])
+    with installed(plan), pytest.raises(FaultInjected):
+        sim.schedule_pods(p)
+    assert census(sim) == {}
+    assert sim.placed == {}
+    assert p == pre_pods
+    assert reconciliation() == pre_recon
+    assert plan.trace == [(site, 1, "runtime")]
+    # the simulator is NOT poisoned: the same call now succeeds and matches
+    # a fresh simulator bit-for-bit
+    failed = sim.schedule_pods(p)
+    fresh = Simulator(copy.deepcopy(nodes))
+    fresh_failed = fresh.schedule_pods(copy.deepcopy(pods))
+    assert census(sim) == census(fresh)
+    assert len(failed) == len(fresh_failed)
+
+
+def test_partial_commit_rolls_back_earlier_commits(small_cluster):
+    """A commit fault mid-batch (after 19 pods committed) must undo all 19."""
+    nodes, pods = small_cluster
+    sim = Simulator(copy.deepcopy(nodes))
+    p = copy.deepcopy(pods)
+    pre_recon = reconciliation()
+    with installed(FaultPlan([FaultSpec("commit", 20)])), \
+            pytest.raises(FaultInjected):
+        sim.schedule_pods(p)
+    assert census(sim) == {}
+    assert all("nodeName" not in (q.get("spec") or {}) for q in p)
+    assert all("status" not in q for q in p)
+    assert reconciliation() == pre_recon
+
+
+def test_fault_replay_trace_is_identical(small_cluster):
+    """Seeded plan + identical workload → bit-identical injection traces and
+    arrival counts across two independent runs."""
+    nodes, pods = small_cluster
+    traces = []
+    for _ in range(2):
+        sim = Simulator(copy.deepcopy(nodes))
+        plan = FaultPlan.seeded(1234, n_faults=2, sites=ENGINE_SITES,
+                                max_attempt=3)
+        try:
+            with installed(plan):
+                sim.schedule_pods(copy.deepcopy(pods))
+        except Exception:
+            pass
+        traces.append((plan.trace, dict(plan.arrivals)))
+    assert traces[0] == traces[1]
+    assert traces[0][0], "the seeded plan must actually fire on this workload"
+
+
+def test_prebound_pod_status_restored_exactly():
+    """Pre-bound pods carry caller-owned status objects; a rollback must put
+    the ORIGINAL contents back, not a synthesized one."""
+    nodes = [make_node("n1"), make_node("n2")]
+    bound = make_pod("bound-0", cpu="100m", memory="128Mi", node_name="n1")
+    bound["status"] = {"phase": "Running", "conditions": [{"type": "Ready"}]}
+    free = make_pod("free-0", cpu="100m", memory="128Mi")
+    pods = [bound, free]
+    pre = copy.deepcopy(pods)
+    sim = Simulator(nodes)
+    with installed(FaultPlan([FaultSpec("dispatch", 1)])), \
+            pytest.raises(FaultInjected):
+        sim.schedule_pods(pods)
+    assert pods == pre
+    assert census(sim) == {}
+
+
+def test_preemption_eviction_fault_rolls_back_everything():
+    """A fault during a preemption eviction: victims return to their nodes,
+    the preemptor stays unplaced, reconciliation holds."""
+    nodes = [make_node("n1", cpu="2000m", memory="4Gi", pods="10")]
+    low = [prio_pod(f"low-{i}", cpu="900m", memory="1Gi", priority=0)
+           for i in range(2)]
+    high = [prio_pod("high-0", cpu="1800m", memory="2Gi", priority=100)]
+    pods = low + high
+
+    # baseline: preemption evicts both low pods and nominates the node
+    base = Simulator(copy.deepcopy(nodes))
+    base.schedule_pods(copy.deepcopy(pods))
+    assert len(base.preempted) == 2
+
+    sim = Simulator(copy.deepcopy(nodes))
+    p = copy.deepcopy(pods)
+    pre_pods = copy.deepcopy(p)
+    pre_recon = reconciliation()
+    with installed(FaultPlan([FaultSpec("preempt_evict", 1)])), \
+            pytest.raises(FaultInjected):
+        sim.schedule_pods(p)
+    assert census(sim) == {}
+    assert sim.preempted == []
+    assert reconciliation() == pre_recon
+    # the two low pods' dicts are rolled back; the preemptor never mutated
+    assert [q for q in p if "nodeName" in (q.get("spec") or {})] == []
+    assert p[2] == pre_pods[2]
+    # and the run completes normally afterwards, matching the baseline
+    sim.schedule_pods(p)
+    assert len(sim.preempted) == 2
+    assert census(sim) == census(base)
+
+
+def test_preemption_mid_flow_commit_fault_reconciles():
+    """Commit faults DURING the preemption rewind/replay machinery (late
+    arrivals hit replayed commits) still roll back to a clean slate."""
+    nodes = [make_node("n1", cpu="2000m", memory="4Gi", pods="10")]
+    pods = ([prio_pod(f"low-{i}", cpu="900m", memory="1Gi", priority=0)
+             for i in range(2)]
+            + [prio_pod("high-0", cpu="1800m", memory="2Gi", priority=100)])
+    pre_recon = reconciliation()
+    sim = Simulator(copy.deepcopy(nodes))
+    p = copy.deepcopy(pods)
+    pre_pods = copy.deepcopy(p)
+    # arrival 3 = the first replayed commit inside the preemption flow
+    with installed(FaultPlan([FaultSpec("commit", 3)])), \
+            pytest.raises(FaultInjected):
+        sim.schedule_pods(p)
+    assert census(sim) == {}
+    assert sim.preempted == []
+    assert p == pre_pods
+    assert reconciliation() == pre_recon
+
+
+def test_probe_pods_rollback_restores_bound_commits():
+    """probe_pods commits pre-bound pods; a dispatch fault must roll those
+    back (probe pods belong to the planner and are reused across probes)."""
+    nodes = [make_node("n1"), make_node("n2")]
+    bound = make_pod("bound-0", cpu="100m", memory="128Mi", node_name="n1")
+    free = [make_pod(f"f-{i}", cpu="100m", memory="128Mi") for i in range(3)]
+    pods = [bound] + free
+    sim = Simulator(nodes)
+    pre_recon = reconciliation()
+    with installed(FaultPlan([FaultSpec("dispatch", 1)])), \
+            pytest.raises(FaultInjected):
+        sim.probe_pods(pods)
+    assert census(sim) == {}
+    assert "status" not in bound
+    assert reconciliation() == pre_recon
+    scheduled, total = sim.probe_pods(pods)  # works after the rollback
+    assert (scheduled, total) == (4, 4)
+
+
+def test_probe_session_build_fault_rolls_back_bound_pods():
+    """A fault during ProbeSession build (after bound pods committed, during
+    encode) must roll the caller's pod dicts back before propagating."""
+    from open_simulator_tpu.simulator.probe import ProbeSession
+
+    base = [make_node("n1")]
+    template = make_node("template")
+    bound = make_pod("bound-0", cpu="100m", memory="128Mi", node_name="n1")
+    free = [make_pod(f"f-{i}", cpu="100m", memory="128Mi") for i in range(3)]
+    pods = [bound] + free
+    pre = copy.deepcopy(pods)
+    with installed(FaultPlan([FaultSpec("encode", 1)])), \
+            pytest.raises(FaultInjected):
+        ProbeSession.try_build(base, template, pods)
+    assert pods == pre
+    # and the identical build succeeds afterwards
+    session = ProbeSession.try_build(base, template, pods)
+    assert session is not None
+
+
+# --------------------------------------------- capacity search deadline ------
+
+
+def test_capacity_search_respects_deadline():
+    from open_simulator_tpu.apply.applier import CapacityPlanner
+
+    nodes = [make_node("n1", cpu="1000m", memory="2Gi")]
+    new_node = make_node("template", cpu="1000m", memory="2Gi")
+    pods = [make_pod(f"p-{i}", cpu="800m", memory="1Gi") for i in range(6)]
+    planner = CapacityPlanner([copy.deepcopy(n) for n in nodes],
+                              new_node, copy.deepcopy(pods))
+    before = _sum("simon_deadline_exceeded_total")
+    with Deadline(1e-4), pytest.raises(DeadlineExceeded):
+        planner.search()
+    assert _sum("simon_deadline_exceeded_total") > before
+    # without a deadline the identical search completes
+    found, n, _hist = planner.search()
+    assert found and n >= 5

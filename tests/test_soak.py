@@ -5,6 +5,11 @@ validated the wave/epoch kernels during development, preserved so future
 kernel work can re-run it. Each seed builds a random cluster/workload and
 asserts per-(node, scheduling-signature) census and failure equality between
 the batched paths and the pure serial scan.
+
+The fault-soak half (same opt-in) drives random seeded FaultPlans through
+random workloads and asserts zero state divergence: a faulted-and-rolled-back
+simulator must afterwards produce placements bit-identical to a simulator
+that never saw the fault.
 """
 
 import copy
@@ -67,6 +72,57 @@ def test_soak_zone_spread(seed):
             }]
             pods.append(p)
     assert _run(nodes, pods, True) == _run(nodes, pods, False)
+
+
+@pytest.mark.parametrize("seed", range(600, 630))
+def test_fault_soak_no_state_divergence(seed):
+    """Random seeded FaultPlans against random workloads: when the plan
+    fires, the rollback must leave the simulator able to reproduce the
+    fault-free placements bit-for-bit (and the caller's pods unmutated)."""
+    from open_simulator_tpu.resilience import FaultPlan, installed
+
+    rng = random.Random(seed)
+    nodes = [make_node(f"n{i}", cpu=f"{rng.randint(1000, 6000)}m",
+                       memory=str(rng.randint(2, 10) << 30),
+                       pods=str(rng.randint(3, 20)))
+             for i in range(rng.randint(3, 12))]
+    pods = []
+    for b in range(rng.randint(1, 3)):
+        app = f"fs{b}"
+        n_prio = rng.choice([0, 0, 100])  # some seeds arm preemption
+        for _ in range(rng.randint(5, 40)):
+            p = make_pod(f"{app}-{len(pods)}", cpu=f"{rng.randint(100, 900)}m",
+                         memory=str(rng.randint(64, 900) << 20),
+                         labels={"app": app})
+            if n_prio and rng.random() < 0.5:
+                p["spec"]["priority"] = n_prio
+            pods.append(p)
+
+    baseline, base_failed = _run(nodes, pods, True)
+
+    plan = FaultPlan.seeded(
+        seed, n_faults=rng.randint(1, 3), max_attempt=rng.randint(1, 6),
+        sites=("encode", "to_device", "dispatch", "fetch", "commit",
+               "preempt_evict"))
+    sim = Simulator(copy.deepcopy(nodes))
+    p2 = copy.deepcopy(pods)
+    pre_pods = copy.deepcopy(p2)
+    fired = False
+    try:
+        with installed(plan):
+            sim.schedule_pods(p2)
+    except Exception:
+        fired = True
+        assert plan.trace, "raised without a recorded injection?"
+        assert _census(sim) == {}, "rollback left census residue"
+        assert p2 == pre_pods, "rollback left pod-dict residue"
+    # with or without a fault, the same simulator must converge to the
+    # fault-free baseline exactly
+    if fired:
+        failed = sim.schedule_pods(p2)
+        assert (_census(sim), len(failed)) == (baseline, base_failed)
+    else:
+        assert _census(sim) == baseline  # plan never fired: plain parity
 
 
 @pytest.mark.parametrize("seed", range(400, 430))

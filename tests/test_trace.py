@@ -138,7 +138,7 @@ def test_server_debug_vars():
 
     from open_simulator_tpu.server.http import Server
 
-    srv = Server.__new__(Server)  # endpoint needs no cluster client
+    srv = Server(snapshot_fn=lambda: None)  # endpoint needs no cluster client
     httpd = srv.build_httpd(port=0, host="127.0.0.1")
     port = httpd.server_address[1]
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
@@ -169,7 +169,7 @@ def test_server_debug_pprof_profile_samples_other_threads():
 
     worker = threading.Thread(target=busy_app_work, daemon=True)
     worker.start()
-    srv = Server.__new__(Server)
+    srv = Server(snapshot_fn=lambda: None)
     httpd = srv.build_httpd(port=0, host="127.0.0.1")
     port = httpd.server_address[1]
     threading.Thread(target=httpd.serve_forever, daemon=True).start()

@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""CI smoke for simonfault (fast, CPU-only).
+
+For EVERY named fault site, one bounded run asserting the acceptance
+criteria of the robustness layer:
+
+- engine sites (encode / to_device / dispatch / fetch / commit /
+  preempt_evict): the injected failure surfaces as a clean exception, the
+  census and the caller's pod dicts are bit-identical to the pre-call state,
+  and the commits − rollbacks − victims metric reconciliation is unchanged;
+- live_get: an injected transient fault is retried per the policy — the
+  retry counter moves by exactly the injected-fault count and the request
+  then succeeds;
+- every seeded plan replays an IDENTICAL injection trace on a second
+  identical run (bit-for-bit reproducibility), and the retry/backoff
+  schedule is a pure function of the policy.
+
+Plus a server drain smoke: start a server, park a slow request in flight,
+deliver a real SIGTERM, and assert the in-flight request completes 200 while
+requests arriving mid-drain get structured 503s.
+
+Prints one JSON line with the measured numbers.
+"""
+
+import copy
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from open_simulator_tpu.obs import REGISTRY  # noqa: E402
+from open_simulator_tpu.resilience import (  # noqa: E402
+    FaultPlan,
+    FaultSpec,
+    RetryPolicy,
+    installed,
+)
+from open_simulator_tpu.simulator.encode import scheduling_signature  # noqa: E402
+from open_simulator_tpu.simulator.engine import Simulator  # noqa: E402
+from open_simulator_tpu.utils.synth import synth_cluster  # noqa: E402
+
+ENGINE_SITES = ("encode", "to_device", "dispatch", "fetch", "commit")
+
+
+def _sum(prefix):
+    return sum(v for k, v in REGISTRY.values().items() if k.startswith(prefix))
+
+
+def reconciliation():
+    return (_sum("simon_commits_total")
+            - _sum("simon_commit_rollbacks_total")
+            - _sum("simon_preemption_victims_total"))
+
+
+def census(sim):
+    out = {}
+    for i, nps in enumerate(sim.pods_on_node):
+        for p in nps:
+            k = (i, scheduling_signature(p))
+            out[k] = out.get(k, 0) + 1
+    return out
+
+
+def engine_site_sweep(row):
+    nodes, pods = synth_cluster(16, 120)
+    traces = {}
+    for site in ENGINE_SITES:
+        for rep in range(2):  # twice: the replay-equality criterion
+            sim = Simulator(copy.deepcopy(nodes))
+            p = copy.deepcopy(pods)
+            pre_pods = copy.deepcopy(p)
+            pre_recon = reconciliation()
+            # commit at arrival 40: a mid-batch partial commit must roll back
+            # the 39 already-committed pods; other sites fire on first arrival
+            plan = FaultPlan([FaultSpec(site, 40 if site == "commit" else 1)])
+            raised = False
+            try:
+                with installed(plan):
+                    sim.schedule_pods(p)
+            except Exception:
+                raised = True
+            assert raised, f"{site}: injected fault did not surface"
+            assert census(sim) == {}, f"{site}: census residue after rollback"
+            assert p == pre_pods, f"{site}: pod dicts mutated after rollback"
+            assert reconciliation() == pre_recon, \
+                f"{site}: commits-rollbacks-victims drifted"
+            assert plan.trace, f"{site}: no injection recorded"
+            traces.setdefault(site, []).append(list(plan.trace))
+        assert traces[site][0] == traces[site][1], \
+            f"{site}: replay produced a different trace"
+    row["engine_sites"] = len(ENGINE_SITES)
+
+
+def preempt_evict_smoke(row):
+    def node(name):
+        return {"apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": name, "labels": {}},
+                "status": {"allocatable": {"cpu": "2000m", "memory": str(4 << 30),
+                                           "pods": "10"}}}
+
+    def pod(name, cpu, mem, prio):
+        return {"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"priority": prio, "containers": [{
+                    "name": "c", "image": "x",
+                    "resources": {"requests": {"cpu": cpu, "memory": mem}}}]}}
+
+    pods = [pod("low-0", "900m", str(1 << 30), 0),
+            pod("low-1", "900m", str(1 << 30), 0),
+            pod("high-0", "1800m", str(2 << 30), 100)]
+    pre_recon = reconciliation()
+    sim = Simulator([node("n1")])
+    p = copy.deepcopy(pods)
+    pre_pods = copy.deepcopy(p)
+    raised = False
+    try:
+        with installed(FaultPlan([FaultSpec("preempt_evict", 1)])):
+            sim.schedule_pods(p)
+    except Exception:
+        raised = True
+    assert raised, "preempt_evict fault did not surface"
+    assert census(sim) == {} and sim.preempted == []
+    assert p == pre_pods, "preempt_evict rollback left pod residue"
+    assert reconciliation() == pre_recon
+    # the same simulator then preempts normally
+    sim.schedule_pods(p)
+    assert len(sim.preempted) == 2
+    row["preempt_evict_ok"] = True
+
+
+def live_get_smoke(row):
+    """Injected transient live_get fault: retried per policy, counters match
+    the plan, and the seeded backoff schedule is replay-identical."""
+    import http.server
+    import yaml
+
+    from open_simulator_tpu.simulator.live import KubeClient
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps({"kind": "NodeList", "apiVersion": "v1",
+                               "items": [{"metadata": {"name": "n0"}}],
+                               "metadata": {}}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+    cfg = {"current-context": "c",
+           "contexts": [{"name": "c", "context": {"cluster": "cl", "user": "u"}}],
+           "clusters": [{"name": "cl",
+                         "cluster": {"server": f"http://127.0.0.1:{port}"}}],
+           "users": [{"name": "u", "user": {}}]}
+    path = "/tmp/fault_smoke_kubeconfig.yaml"
+    with open(path, "w") as f:
+        yaml.safe_dump(cfg, f)
+    try:
+        policy = RetryPolicy(max_attempts=3, base=0.001, cap=0.01,
+                             jitter=0.2, seed=7)
+        assert policy.schedule() == policy.schedule(), "backoff not pure"
+        client = KubeClient(path)
+        client.retry = policy
+        plan = FaultPlan([FaultSpec("live_get", 1, "transient"),
+                          FaultSpec("live_get", 2, "transient")])
+        r0 = _sum("simon_retries_total")
+        f0 = _sum("simon_faults_injected_total")
+        with installed(plan):
+            nodes = client.list("/api/v1/nodes")
+        assert len(nodes) == 1, "list failed despite retries"
+        retries = _sum("simon_retries_total") - r0
+        injected = _sum("simon_faults_injected_total") - f0
+        assert injected == 2, f"expected 2 injected faults, saw {injected}"
+        assert retries == 2, f"retry counters must match the plan, saw {retries}"
+        assert plan.trace == [("live_get", 1, "transient"),
+                              ("live_get", 2, "transient")]
+        row["live_get_retries"] = retries
+    finally:
+        httpd.shutdown()
+        os.unlink(path)
+
+
+def server_drain_smoke(row):
+    """Real-SIGTERM drain: in-flight completes 200, mid-drain requests 503."""
+    import http.client
+    import signal
+    import time
+
+    from open_simulator_tpu.core.types import ResourceTypes
+    from open_simulator_tpu.server.http import ClusterSnapshot, Server
+
+    release = threading.Event()
+    entered = threading.Event()
+
+    def slow_snapshot():
+        entered.set()
+        assert release.wait(timeout=30)
+        node = {"apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": "n1", "labels": {}},
+                "status": {"allocatable": {"cpu": "8", "memory": str(16 << 30),
+                                           "pods": "110"}}}
+        return ClusterSnapshot(ResourceTypes(nodes=[node]), [], [], [])
+
+    server = Server(snapshot_fn=slow_snapshot)
+    httpd = server.build_httpd(port=0, host="127.0.0.1")
+    port = httpd.server_address[1]
+    server.install_sigterm_handler(drain_deadline=20.0)
+    serve_t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    serve_t.start()
+
+    results = {}
+
+    def inflight():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        deploy = {"apiVersion": "apps/v1", "kind": "Deployment",
+                  "metadata": {"name": "web", "namespace": "default"},
+                  "spec": {"replicas": 1,
+                           "selector": {"matchLabels": {"app": "web"}},
+                           "template": {
+                               "metadata": {"labels": {"app": "web"}},
+                               "spec": {"containers": [{
+                                   "name": "c", "image": "x",
+                                   "resources": {"requests": {
+                                       "cpu": "1", "memory": "1Gi"}}}]}}}}
+        conn.request("POST", "/api/deploy-apps",
+                     body=json.dumps({"deployments": [deploy]}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        results["inflight"] = resp.status
+        resp.read()
+
+    t = threading.Thread(target=inflight)
+    t.start()
+    assert entered.wait(timeout=15), "slow request never reached the handler"
+
+    os.kill(os.getpid(), signal.SIGTERM)  # the real signal path
+    deadline = time.monotonic() + 10
+    while not server.draining and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert server.draining, "SIGTERM did not start the drain"
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", "/healthz")
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    assert resp.status == 503 and "draining" in body["error"], \
+        f"mid-drain request got {resp.status}: {body}"
+
+    release.set()
+    t.join(timeout=30)
+    serve_t.join(timeout=30)
+    assert results.get("inflight") == 200, \
+        f"in-flight request did not complete cleanly: {results}"
+    assert not serve_t.is_alive(), "listener still running after drain"
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    row["drain_ok"] = True
+
+
+def main() -> int:
+    row = {"metric": "fault_smoke"}
+    engine_site_sweep(row)
+    preempt_evict_smoke(row)
+    live_get_smoke(row)
+    server_drain_smoke(row)
+    row["faults_injected_total"] = _sum("simon_faults_injected_total")
+    print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
