@@ -9,6 +9,7 @@ Rule ids (stable — they appear in suppression comments and CI output):
   contract-spec      malformed @shaped contract annotation
   metric-in-jit      metrics-registry mutation or wall-clock read under trace
   swallowed-exception  broad except that neither re-raises, returns, logs, nor counts
+  naked-dispatch     device-computation call site bypassing the simonguard watchdog
 
 Every rule is a pure function ModuleContext -> List[Finding]; file IO,
 suppressions, and exit-code policy live in runner.py.
@@ -21,7 +22,7 @@ from typing import List, Optional, Set
 
 from ..ops.contracts import parse_spec
 from .base import Finding, Severity, register
-from .context import ModuleContext
+from .context import PARTIAL_NAMES, ModuleContext
 
 # ----------------------------------------------------------------- helpers ----
 
@@ -489,6 +490,111 @@ def rule_swallowed_exception(ctx: ModuleContext) -> List[Finding]:
                 f"{what} swallows the error: the handler neither re-raises, "
                 f"returns, logs, nor counts — failures vanish silently "
                 f"(narrow the type, or log/count and whitelist)",
+            ))
+    return out
+
+
+# -------------------------------------------------------------- naked-dispatch --
+
+# The compiled scheduling/probe kernels whose dispatch (or the fetch of whose
+# results) can block forever on a wedged backend. Every call site in hot-path
+# code must run under guard.supervised so the watchdog can contain it.
+_DISPATCH_KERNELS = {
+    "schedule_batch", "schedule_wave", "schedule_spread_wave",
+    "schedule_group_serial", "probe_serial_fanout",
+    "probe_group_serial_fanout", "probe_wave_fanout", "feasibility_jit",
+}
+
+
+def _is_kernel_dispatch(ctx: ModuleContext, call: ast.Call) -> Optional[str]:
+    """The kernel name when `call` invokes a dispatch kernel of the kernels
+    module (attribute form `kernels.X(...)` via any alias, or a name imported
+    absolutely from open_simulator_tpu.ops.kernels), else None."""
+    r = ctx.resolve(call.func)
+    if r is None:
+        return None
+    parts = r.split(".")
+    if parts[-1] not in _DISPATCH_KERNELS:
+        return None
+    if "kernels" in parts[:-1]:
+        return parts[-1]
+    return None
+
+
+def _supervised_functions(ctx: ModuleContext) -> Set[ast.AST]:
+    """Function/lambda nodes whose BODY is executed under guard.supervised:
+    the first argument of a supervised(...) call, resolved through a direct
+    name, a functools.partial wrapper, or a method attribute."""
+    out: Set[ast.AST] = set()
+
+    def mark(expr: Optional[ast.expr]) -> None:
+        if expr is None:
+            return
+        if isinstance(expr, ast.Lambda):
+            out.add(expr)
+            return
+        fn = ctx.lookup_function(expr)
+        if fn is not None:
+            out.add(fn)
+            return
+        if isinstance(expr, ast.Call):
+            r = ctx.resolve(expr.func) or ""
+            if r in PARTIAL_NAMES or r.endswith(".partial"):
+                mark(expr.args[0] if expr.args else None)
+            return
+        if isinstance(expr, ast.Attribute):
+            # self._dispatch_round and friends: methods register by name
+            for fn in ctx.functions.get(expr.attr, []):
+                out.add(fn)
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        r = ctx.resolve(node.func) or ""
+        if r == "supervised" or r.endswith(".supervised"):
+            mark(node.args[0] if node.args else None)
+    return out
+
+
+@register(
+    "naked-dispatch", Severity.WARNING,
+    "A compiled scheduling/probe kernel is dispatched directly, outside "
+    "guard.supervised (resilience/guard.py). An unsupervised dispatch on a "
+    "wedged backend blocks the process forever — the exact failure mode the "
+    "dispatch watchdog exists to contain. Route the call through "
+    "guard.supervised (directly, via functools.partial, or by passing the "
+    "enclosing function), or whitelist deliberate harness/offline code with "
+    "`# simonlint: ignore[naked-dispatch] -- <why>`.",
+)
+def rule_naked_dispatch(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    guarded = _supervised_functions(ctx)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kernel = _is_kernel_dispatch(ctx, node)
+        if kernel is None:
+            continue
+        covered = False
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if cur in guarded:
+                covered = True
+                break
+            if isinstance(cur, ast.Call):
+                r = ctx.resolve(cur.func) or ""
+                if r == "supervised" or r.endswith(".supervised"):
+                    covered = True
+                    break
+            cur = ctx.parents.get(cur)
+        if not covered:
+            out.append(Finding(
+                "naked-dispatch", Severity.WARNING, ctx.path,
+                node.lineno, node.col_offset,
+                f"kernels.{kernel}(...) dispatched outside guard.supervised "
+                f"— a wedged backend would hang here with no watchdog, "
+                f"quarantine, or failover (wrap the dispatch, or whitelist "
+                f"non-hot-path harness code)",
             ))
     return out
 

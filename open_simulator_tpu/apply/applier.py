@@ -15,7 +15,9 @@ Mirrors /root/reference/pkg/apply/apply.go:
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
 import sys
 from dataclasses import dataclass, field
@@ -26,6 +28,7 @@ from ..core import constants as C
 from ..obs import instruments as obs
 from ..core.types import AppResource, NodeStatus, ResourceTypes, SimulateResult
 from ..models.fakenode import new_fake_nodes
+from ..resilience import guard
 from ..resilience.policy import Deadline, check_deadline
 from ..simulator.core import simulate
 from ..utils.objutil import annotations_of, labels_of, name_of, namespace_of, pod_resource_requests
@@ -91,8 +94,12 @@ class CapacityPlanner:
         self.sched_config = sched_config
         # filled by search(): path ("incremental"/"fresh"), probes (candidate
         # evaluations), dispatches (device round-trips), encode_s (one-time
-        # pod-encoding wall), encodes (must stay 1 on the incremental path)
+        # pod-encoding wall), encodes (must stay 1 on the incremental path),
+        # journal_hits (verdicts replayed from --resume-journal)
         self.stats: Dict[str, object] = {}
+        # crash-consistent probe-verdict journal (resilience/guard.py
+        # SearchJournal), attached via attach_journal for --resume-journal
+        self.journal = None
 
     @classmethod
     def try_build(cls, cluster: ResourceTypes, apps: List[AppResource],
@@ -128,6 +135,62 @@ class CapacityPlanner:
         return cls(cluster.nodes, new_node, pods,
                    cluster_objects=cluster, app_objects=[a.resource for a in apps],
                    sched_config=sched_config)
+
+    # --------------------------------------------------------------- journal ----
+
+    def options_digest(self) -> str:
+        """Canonical digest of everything that determines this search's
+        verdicts: the FULL base-node and template-node objects (allocatable,
+        labels, taints — not just names), every pod's identity + full spec
+        (requests, affinity, priority, binding), the scheduler config's
+        semantic fields (sorted — never repr, whose set ordering is
+        hash-randomized across processes), and the envelope percentages.
+        The journal's header guard — a journal whose digest differs belongs
+        to a DIFFERENT search and must not steer this one
+        (guard.SearchJournal rejects it)."""
+        h = hashlib.sha256()
+
+        def upd(obj) -> None:
+            h.update(json.dumps(obj, sort_keys=True, default=str).encode())
+            h.update(b"\x00")
+
+        for n in sorted(self.base_nodes, key=name_of):
+            upd(n)
+        upd(self.new_node)
+        for p in self.pods:  # incremental: no giant host string at 100k pods
+            upd((namespace_of(p), name_of(p), p.get("spec") or {}))
+        sc = self.sched_config
+        upd({
+            "weights": sc.weight_kwargs() if sc is not None else None,
+            "kernel_filters": sorted(
+                getattr(sc, "disabled_kernel_filters", None) or ()),
+            "encoder_filters": sorted(
+                getattr(sc, "disabled_encoder_filters", None) or ()),
+            "preemption_disabled": bool(
+                getattr(sc, "preemption_disabled", False)),
+        })
+        upd({"max_cpu": self._env_pct(C.EnvMaxCPU),
+             "max_memory": self._env_pct(C.EnvMaxMemory)})
+        return "sha256:" + h.hexdigest()
+
+    def attach_journal(self, path: str) -> None:
+        """Open (or resume) the fsync'd probe-verdict journal at `path`.
+        Raises guard.JournalMismatch when the file was written by a search
+        with different options."""
+        self.journal = guard.SearchJournal.open(path, self.options_digest())
+
+    def _journal_lookup(self, n: int):
+        if self.journal is None:
+            return None
+        hit = self.journal.lookup(n)
+        if hit is not None:
+            self.stats["journal_hits"] = int(
+                self.stats.get("journal_hits") or 0) + 1
+        return hit
+
+    def _journal_record(self, n: int, ok: bool, nf: int) -> None:
+        if self.journal is not None:
+            self.journal.record(n, ok, nf)
 
     # ------------------------------------------------------------ arithmetic ----
 
@@ -241,8 +304,24 @@ class CapacityPlanner:
         history = [(n, n_failed)] for the give-up diagnostics. found=False
         means no-progress/max-exhausted."""
         self.stats = {"path": "fresh", "probes": 0, "dispatches": 0,
-                      "encode_s": 0.0, "encodes": 0}
-        out = self._search_incremental()
+                      "encode_s": 0.0, "encodes": 0, "journal_hits": 0}
+        try:
+            out = self._search_incremental()
+        except BaseException as e:
+            # simonguard containment: a wedged backend / device OOM inside
+            # the encode-once session is not fatal to the SEARCH — the
+            # backend is quarantined (wedge) and the fresh-probe fallback
+            # re-runs on the surviving backend, journal verdicts intact
+            # (placements are backend-invariant). Anything non-containable
+            # (deadline expiry, real bugs) propagates.
+            cause = guard.containment_cause(e)
+            if cause is None:
+                raise
+            guard.count_failover(cause, "capacity_search")
+            logging.getLogger("open_simulator_tpu").warning(
+                "capacity search contained a device failure (%s); falling "
+                "back to fresh-Simulator probes", cause)
+            out = None
         if out is None:
             out = self._search_fresh()
         # registry mirror of the stats dict: search accounting survives the
@@ -281,16 +360,29 @@ class CapacityPlanner:
             # every probe round re-checks the --deadline budget: a search that
             # cannot finish dies between dispatches, never mid-kernel
             check_deadline("capacity_search")
-            session.ensure_capacity(max(cands))
-            res = session.probe_many(cands)
+            out = {}
+            # resumed-journal verdicts satisfy candidates without a dispatch
+            todo = []
+            for n in cands:
+                hit = self._journal_lookup(n)
+                if hit is not None:
+                    out[n] = hit
+                else:
+                    todo.append(n)
+            if not todo:
+                return out
+            session.ensure_capacity(max(todo))
+            res = session.probe_many(todo)
             self.stats["probes"] += len(res)
             self.stats["dispatches"] += 1
-            out = {}
             for n, (scheduled, total, u) in res.items():
                 nf = total - scheduled
                 ok = nf == 0 and self._envelope_ok(
                     u["cpu_used"], u["cpu_alloc"], u["mem_used"], u["mem_alloc"])
                 out[n] = (ok, nf)
+                # verdict journaled (fsync) BEFORE the next dispatch: a crash
+                # loses at most the round in flight
+                self._journal_record(n, ok, nf)
             return out
 
         # The arithmetic bound is frequently EXACT (homogeneous workloads), so
@@ -355,9 +447,14 @@ class CapacityPlanner:
 
         def probe(n):
             check_deadline("capacity_search")  # per-candidate budget check
+            hit = self._journal_lookup(n)
+            if hit is not None:
+                return hit
             self.stats["probes"] += 1
             self.stats["dispatches"] += 1
-            return self.probe(n)
+            ok, nf = self.probe(n)
+            self._journal_record(n, ok, nf)
+            return ok, nf
 
         lb = self.lower_bound()
         if lb == 0:
@@ -399,6 +496,10 @@ class Options:
     # wall-clock budget for the whole run (0 = unbounded): the capacity
     # search and every full simulation slice it via the Deadline contextvar
     deadline: float = 0.0
+    # crash-consistent capacity-search journal (simonguard): probe verdicts
+    # are fsync'd here and a re-run resumes, skipping completed probes; a
+    # digest mismatch (different search options) is rejected loudly
+    resume_journal: str = ""
 
 
 class Applier:
@@ -496,6 +597,10 @@ class Applier:
         self._println("Simulation success!")
         if n_added:
             self._println(f"(added {n_added} node(s) to make everything schedulable)")
+        if len(result.backend_path) > 1:
+            # no silent degradation: a mid-run failover is part of the report
+            self._println("(degraded run: backend path "
+                          + " -> ".join(result.backend_path) + ")")
         self.report(result.node_status, [a.name for a in apps])
         return result
 
@@ -522,6 +627,16 @@ class Applier:
 
         planner = CapacityPlanner.try_build(cluster, apps, new_node, patch_funcs,
                                             sched_config=self.sched_config)
+        if self.opts.resume_journal:
+            if planner is not None:
+                # JournalMismatch propagates: a stale journal must stop the
+                # run, not silently steer a different search
+                planner.attach_journal(self.opts.resume_journal)
+            else:
+                self._println(
+                    "note: --resume-journal ignored (workload does not "
+                    "qualify for the probe search; full simulations are "
+                    "not journaled)")
         if planner is not None:
             found, n, hist = planner.search()
             if found:

@@ -93,6 +93,13 @@ def build_parser() -> argparse.ArgumentParser:
              "every simulation slice the remaining budget and the run fails "
              "cleanly when it expires (0 = unbounded)")
     p_apply.add_argument(
+        "--resume-journal", default="", metavar="FILE.jsonl",
+        help="crash-consistent capacity-search journal: probe verdicts are "
+             "fsync'd to FILE as the search runs, and a re-run of the SAME "
+             "search (options digest must match) resumes from it, skipping "
+             "completed probes instead of recomputing an hour of search "
+             "after a crash/SIGKILL")
+    p_apply.add_argument(
         "--fault-plan", default="", metavar="SPEC",
         help="activate a deterministic fault-injection plan for the run: a "
              "JSON file, inline JSON, 'seed=N', or "
@@ -174,6 +181,7 @@ def cmd_apply(args) -> int:
             extended_resources=ext,
             output_file=args.output_file,
             deadline=getattr(args, "deadline", 0.0) or 0.0,
+            resume_journal=getattr(args, "resume_journal", "") or "",
         ))
         if trace_out:
             from ..utils.trace import start_collection

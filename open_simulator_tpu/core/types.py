@@ -68,10 +68,16 @@ class NodeStatus:
 
 @dataclass
 class SimulateResult:
-    """Outcome of one simulation (core.go:19-23)."""
+    """Outcome of one simulation (core.go:19-23).
+
+    `backend_path` (extension, simonguard): the JAX backends the run executed
+    on, in order — `["tpu"]` for a clean run, `["tpu", "cpu"]` after a
+    mid-run device-failure failover. A degraded run changes this field and
+    the guard metrics, never silently just the numbers."""
 
     unscheduled_pods: List[UnscheduledPod] = field(default_factory=list)
     node_status: List[NodeStatus] = field(default_factory=list)
+    backend_path: List[str] = field(default_factory=list)
 
     @property
     def all_scheduled(self) -> bool:

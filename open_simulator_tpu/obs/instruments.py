@@ -158,6 +158,41 @@ HTTP_ERRORS = counter(
     "(structured JSON error bodies, server/http.py).",
     ("endpoint", "code"))
 
+# ------------------------------------------------------------------- guard ----
+# simonguard (resilience/guard.py): mid-run device-failure containment. The
+# acceptance contract is "no silent degradation" — every watchdog expiry,
+# bisection, failover, and quarantine moves one of these.
+
+GUARD_WATCHDOG_EXPIRIES = counter(
+    "simon_guard_watchdog_expiries_total",
+    "Supervised device computations declared wedged (watchdog deadline "
+    "expired or injected watchdog_wedge fault), by dispatch site.",
+    ("site",))
+GUARD_OOM_BISECTIONS = counter(
+    "simon_guard_oom_bisections_total",
+    "Pod-batch halvings performed to contain a device OOM, by the stage "
+    "that OOM'd (to_device / dispatch).",
+    ("site",))
+GUARD_FAILOVERS = counter(
+    "simon_guard_failovers_total",
+    "Mid-run backend failovers to the CPU fallback, by cause "
+    "(watchdog_wedge / oom_exhausted / oom). Each also appends to the "
+    "result's backend_path.",
+    ("cause",))
+GUARD_QUARANTINED = gauge(
+    "simon_guard_quarantined",
+    "1 while the labeled backend is quarantined for this process "
+    "(wedged mid-run; all later device work routes to the CPU fallback).",
+    ("backend",))
+JOURNAL_RECORDS = counter(
+    "simon_journal_records_total",
+    "Probe verdicts appended (write+flush+fsync) to a capacity-search "
+    "journal (resilience/guard.py SearchJournal).")
+JOURNAL_REPLAYS = counter(
+    "simon_journal_replayed_probes_total",
+    "Capacity-search probes skipped because a resumed journal already "
+    "held their verdict.")
+
 # ---------------------------------------------------------- capacity search ---
 
 CAPACITY_SEARCHES = counter(

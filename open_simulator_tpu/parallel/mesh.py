@@ -141,6 +141,8 @@ def schedule_batch_on_mesh(bt: BatchTables, mesh: Mesh):
     tables, carry, bt = to_device_sharded(bt, mesh)
     enable_gpu, enable_storage = plugin_flags(bt)
     with mesh:
+        # simonlint: ignore[naked-dispatch] -- multichip dry-run harness, not
+        # an engine hot path: callers own the wedge exposure (bench/tests)
         final, choices = kernels.schedule_batch(
             tables, carry,
             jax.numpy.asarray(bt.pod_group),
@@ -201,6 +203,8 @@ def schedule_scenarios_on_mesh(bt: BatchTables, mesh: Mesh, seed_requested_s: np
     )
     enable_gpu, enable_storage = plugin_flags(bt)
     vmapped = jax.vmap(
+        # simonlint: ignore[naked-dispatch] -- multichip dry-run harness, not
+        # an engine hot path: callers own the wedge exposure (bench/tests)
         lambda c: kernels.schedule_batch(
             tables, c,
             jax.numpy.asarray(bt.pod_group),

@@ -1,10 +1,11 @@
-"""simonfault: first-party robustness layer — policies, fault injection,
-crash-consistent simulation state.
+"""simonfault + simonguard: first-party robustness layer — policies, fault
+injection, crash-consistent simulation state, and mid-run device-failure
+containment.
 
 The reference inherits its failure behavior from client-go and kube-scheduler
 for free (informer relists, rate-limited retries, the scheduler's error
 funnel); this rebuild owns every network call and device dispatch itself, so
-it owns the failure semantics too. Three parts:
+it owns the failure semantics too. Four parts:
 
 - `policy` — composable `RetryPolicy` (exponential backoff, deterministic
   seeded jitter, max-attempts/max-elapsed), `Deadline` (contextvar-propagated
@@ -19,6 +20,39 @@ it owns the failure semantics too. Three parts:
   `Simulator._transaction`): any failure — injected or real — after partial
   device work rolls host-visible state (placements, census, commit/rollback
   metric reconciliation) back to exactly the pre-call state.
+- `guard` (simonguard) — what happens NEXT after the rollback: watchdog-
+  supervised dispatch (wedged backends are quarantined and the run fails
+  over to CPU, resuming from the last committed segment), device-OOM
+  containment by pod-batch bisection (split-vs-unsplit placements are
+  bit-identical), and a crash-consistent fsync'd capacity-search journal
+  (`simon apply --resume-journal` skips completed probes; a digest guard
+  rejects a stale journal).
+
+Fault-site catalog (the injection error class and the invariant the tests
+assert for each; README "Failure handling" carries the same table):
+
+  site            injected as            invariant asserted
+  --------------  ---------------------  ------------------------------------
+  live_get        Transient/Auth/        retried per policy (Retry-After
+                  Protocol error         floors honored); 401 never retried
+  encode          FaultInjected          rollback: census/pod dicts/metric
+                                         reconciliation bit-identical
+  to_device       FaultInjected          same rollback invariant
+  dispatch        FaultInjected          same rollback invariant
+  fetch           FaultInjected          same rollback invariant
+  commit          FaultInjected          partial batch (k-1 commits) fully
+                                         rolled back, counters reconciled
+  preempt_evict   FaultInjected          evictions undone, victims restored
+  watchdog_wedge  BackendWedged (via     quarantine + CPU failover resumes
+                  guard.supervised)      from the committed prefix; final
+                                         placements == fault-free run
+  oom_to_device   FaultInjected,         batch bisected in halves; split
+                  classified as OOM      placements bit-identical to unsplit
+  oom_dispatch    FaultInjected,         same bisection invariant; floor
+                  classified as OOM      exhaustion fails over to CPU
+  journal_write   FaultInjected          journal's valid prefix survives; a
+                                         resumed search reaches the same
+                                         nodes_added without re-probing
 """
 
 from .faults import (
@@ -31,6 +65,16 @@ from .faults import (
     install_plan,
     installed,
     maybe_fail,
+)
+from .guard import (
+    BackendWedged,
+    GuardError,
+    JournalMismatch,
+    OOMBisectionExhausted,
+    SearchJournal,
+    containment_cause,
+    oom_site,
+    supervised,
 )
 from .policy import (
     BreakerOpen,
@@ -52,6 +96,14 @@ __all__ = [
     "install_plan",
     "installed",
     "maybe_fail",
+    "BackendWedged",
+    "GuardError",
+    "JournalMismatch",
+    "OOMBisectionExhausted",
+    "SearchJournal",
+    "containment_cause",
+    "oom_site",
+    "supervised",
     "BreakerOpen",
     "CircuitBreaker",
     "Deadline",

@@ -16,6 +16,20 @@ Sites (SITES) cover each stage a scheduling run can die in:
   commit          one pod commit onto host cluster state (engine._commit_pod)
   preempt_evict   preemption eviction (preemption.evict)
 
+simonguard containment sites (resilience/guard.py) — these do not model a
+crash but a CONTAINED device failure, so the run is expected to degrade and
+converge, not die:
+
+  watchdog_wedge  a supervised dispatch's watchdog expiry (guard.supervised
+                  converts the injection into the quarantine + BackendWedged
+                  path without blocking a thread)
+  oom_to_device   device OOM during the host->device transfer (classified
+                  like jaxlib RESOURCE_EXHAUSTED; engine bisects the batch)
+  oom_dispatch    device OOM during a kernel dispatch (same containment)
+  journal_write   a capacity-search journal append (fires BEFORE the write,
+                  so the journal's valid prefix survives — the crash-resume
+                  smoke's injection point)
+
 Activation is process-global (`install_plan` / `clear_plan`): tests use the
 context manager form, the CLI wires `simon apply --fault-plan`, and the
 server exposes POST /debug/fault-plan. The no-plan fast path is a single
@@ -36,6 +50,8 @@ from ..obs import instruments as obs
 SITES: Tuple[str, ...] = (
     "live_get", "encode", "to_device", "dispatch", "fetch", "commit",
     "preempt_evict",
+    # simonguard containment sites (resilience/guard.py)
+    "watchdog_wedge", "oom_to_device", "oom_dispatch", "journal_write",
 )
 
 ERROR_CLASSES: Tuple[str, ...] = ("runtime", "transient", "auth", "protocol")

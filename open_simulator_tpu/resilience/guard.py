@@ -1,0 +1,417 @@
+"""simonguard: mid-run device-failure containment.
+
+PR 4 (simonfault) made host state crash-consistent: any failure rolls a
+scheduling call back to its pre-call state. This module is the layer ABOVE
+that transactional core — it decides what happens NEXT, so a wedged
+accelerator or a device OOM degrades the run instead of killing it:
+
+- **Watchdog-supervised dispatch** (`supervised`): every device computation
+  (kernel dispatch, result fetch, probe fan-out round) runs in a worker
+  thread under a deadline scaled by batch size and tightened by the
+  contextvar `Deadline` (resilience/policy.py). On expiry the backend is
+  classified *wedged*, quarantined for the rest of the process, and
+  `BackendWedged` is raised — which the engine's failover loop catches. The
+  blocked worker thread is a daemon and is abandoned (a dispatch stuck in a
+  driver ioctl cannot be interrupted from Python); the quarantine is exactly
+  what prevents a second thread from following it.
+- **OOM classification** (`oom_site` / `containment_cause`): jaxlib
+  RESOURCE_EXHAUSTED errors (and the injected `oom_to_device` /
+  `oom_dispatch` faults that stand in for them in tests) are recognized so
+  the engine can retry by bisecting the pod batch instead of dying.
+- **Quarantine registry**: process-global backend → cause map. Once a
+  backend is quarantined every later Simulator in the process starts
+  directly on the CPU fallback (`fallback_scope`), so one wedge costs one
+  watchdog expiry, not one per run.
+- **Crash-consistent capacity-search journal** (`SearchJournal`): fsync'd
+  JSONL of probe verdicts with an options-digest header, so a SIGKILLed
+  capacity search resumed via `simon apply --resume-journal` skips every
+  completed probe — and a journal written by a DIFFERENT search is rejected
+  (`JournalMismatch`) instead of silently corrupting the answer.
+
+Every decision is observable: `simon_guard_watchdog_expiries_total{site}`,
+`simon_guard_oom_bisections_total{site}`, `simon_guard_failovers_total{cause}`,
+`simon_guard_quarantined{backend}`, `simon_journal_*` (obs/instruments.py),
+the `events()` trace (replay-equal across identical seeded runs — the
+fault-smoke CI criterion), `state()` on the server's /debug/vars, and the
+result's `backend_path` (e.g. ``["tpu", "cpu"]``). Nothing fails over
+silently.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Tuple, TypeVar
+
+from ..obs import instruments as obs
+from . import faults
+from .policy import check_deadline, deadline_remaining
+
+T = TypeVar("T")
+
+# Failover cause labels (simon_guard_failovers_total{cause}).
+CAUSE_WEDGE = "watchdog_wedge"
+CAUSE_OOM_EXHAUSTED = "oom_exhausted"
+CAUSE_OOM = "oom"
+
+
+class GuardError(RuntimeError):
+    """Base of the containable device-failure classifications."""
+
+
+class BackendWedged(GuardError):
+    """A supervised device computation blew its watchdog deadline: the
+    backend is presumed hung (tunnel wedge, driver deadlock) and has been
+    quarantined for the process."""
+
+    def __init__(self, site: str, backend: str, injected: bool = False) -> None:
+        super().__init__(
+            f"backend {backend!r} wedged at {site} "
+            f"({'injected' if injected else 'watchdog deadline expired'}); "
+            f"quarantined for this process")
+        self.site = site
+        self.backend = backend
+        self.injected = injected
+
+
+class OOMBisectionExhausted(GuardError):
+    """Device OOM persisted all the way down to the bisection floor: the
+    batch cannot be made to fit by splitting. The engine fails the run over
+    to the CPU backend; if THAT also exhausts, the error propagates."""
+
+    def __init__(self, site: str, batch: int, floor: int) -> None:
+        super().__init__(
+            f"device OOM at {site} persisted at batch size {batch} "
+            f"(bisection floor {floor}); batch cannot be split further")
+        self.site = site
+        self.batch = batch
+        self.floor = floor
+
+
+class JournalMismatch(ValueError):
+    """A --resume-journal file was written by a different search (options
+    digest mismatch) or is not a capacity-search journal at all."""
+
+
+# ------------------------------------------------------------------ knobs -----
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:  # tuning knob: fall back, don't crash the run
+        return default
+
+
+def watchdog_enabled() -> bool:
+    return os.environ.get("OPEN_SIMULATOR_WATCHDOG", "").lower() not in (
+        "0", "off", "false", "no")
+
+
+def watchdog_budget(pods: int) -> float:
+    """Seconds a supervised computation may take before it is declared
+    wedged: a base generous enough for a cold XLA compile plus a per-pod
+    term so giant batches are never misclassified. Env-tunable."""
+    base = _env_float("OPEN_SIMULATOR_WATCHDOG_BASE_S", 120.0)
+    per_pod = _env_float("OPEN_SIMULATOR_WATCHDOG_PER_POD_S", 0.005)
+    return max(1.0, base + per_pod * max(0, int(pods)))
+
+
+def oom_bisect_floor() -> int:
+    """Smallest pod-batch size the OOM bisection will retry at (>= 1)."""
+    try:
+        return max(1, int(os.environ.get("OPEN_SIMULATOR_OOM_BISECT_FLOOR",
+                                         "1")))
+    except ValueError:
+        return 1
+
+
+# ----------------------------------------------------------- event trace ------
+
+# Guard decisions in firing order: ("wedge", site, backend),
+# ("oom_bisect", site, batch), ("failover", cause, where). Bounded; the
+# fault-smoke CI resets it per run and asserts two identical seeded runs
+# produce identical traces (the replay-equality criterion for the new sites).
+_EVENTS: List[Tuple] = []
+_EVENTS_MAX = 1024
+_STATE_LOCK = threading.Lock()
+
+
+def record_event(*event) -> None:
+    with _STATE_LOCK:
+        if len(_EVENTS) < _EVENTS_MAX:
+            _EVENTS.append(tuple(event))
+
+
+def events() -> List[Tuple]:
+    with _STATE_LOCK:
+        return list(_EVENTS)
+
+
+# ------------------------------------------------------------- quarantine -----
+
+_QUARANTINED: Dict[str, str] = {}  # backend platform -> cause
+
+
+def quarantine(backend: str, cause: str) -> None:
+    with _STATE_LOCK:
+        _QUARANTINED.setdefault(backend, cause)
+    obs.GUARD_QUARANTINED.labels(backend=backend).set(1)
+
+
+def quarantined() -> Dict[str, str]:
+    with _STATE_LOCK:
+        return dict(_QUARANTINED)
+
+
+def current_backend() -> str:
+    """The default JAX backend's platform name. Safe at the points the guard
+    calls it: either a dispatch already initialized the backend, or the
+    process-startup probe (utils/devices.py) verified it responsive."""
+    import jax
+
+    return jax.default_backend()
+
+
+def default_quarantined() -> bool:
+    """True when the process's default backend is quarantined (device work
+    must route to the CPU fallback). Never touches jax when nothing is
+    quarantined — the common case stays import-free."""
+    with _STATE_LOCK:
+        if not _QUARANTINED:
+            return False
+        q = dict(_QUARANTINED)
+    return current_backend() in q
+
+
+def fallback_scope():
+    """Context manager placing all JAX work inside it on the CPU fallback
+    device (the degraded-mode execution target after a wedge/OOM)."""
+    import jax
+
+    return jax.default_device(jax.local_devices(backend="cpu")[0])
+
+
+def reset_for_tests() -> None:
+    """Clear process-global guard state (quarantine + events). Tests and the
+    fault-smoke CI only — production never un-quarantines a backend."""
+    with _STATE_LOCK:
+        for b in _QUARANTINED:
+            obs.GUARD_QUARANTINED.labels(backend=b).set(0)
+        _QUARANTINED.clear()
+        del _EVENTS[:]
+
+
+def state() -> dict:
+    """The /debug/vars view of the guard: quarantine map, watchdog/bisection
+    configuration, and the recent containment events."""
+    return {
+        "quarantined": quarantined(),
+        "watchdog": {
+            "enabled": watchdog_enabled(),
+            "base_s": _env_float("OPEN_SIMULATOR_WATCHDOG_BASE_S", 120.0),
+            "per_pod_s": _env_float("OPEN_SIMULATOR_WATCHDOG_PER_POD_S", 0.005),
+        },
+        "oom_bisect_floor": oom_bisect_floor(),
+        "events": [list(e) for e in events()[-64:]],
+    }
+
+
+# ------------------------------------------------------ supervised dispatch ---
+
+
+def supervised(fn: Callable[[], T], *, site: str, pods: int = 0) -> T:
+    """Run one device computation under the dispatch watchdog.
+
+    `fn` executes in a daemon worker thread (contextvars copied, so the
+    Deadline and any test-installed state propagate); the caller waits at
+    most `watchdog_budget(pods)` seconds, further tightened by the contextvar
+    Deadline. Expiry quarantines the current backend and raises
+    `BackendWedged`; if the caller's own Deadline ran out during the wait,
+    `DeadlineExceeded` is raised instead (a spent budget is not a wedge).
+    Exceptions from `fn` re-raise transparently. The `watchdog_wedge` fault
+    site fires here, so a wedge is deterministically injectable without
+    actually blocking a thread."""
+    try:
+        faults.maybe_fail("watchdog_wedge")
+    except faults.FaultInjected as e:
+        raise _declare_wedged(site, injected=True) from e
+    if not watchdog_enabled():
+        return fn()
+    budget = watchdog_budget(pods)
+    if deadline_remaining() is not None:
+        check_deadline(site)
+        budget = min(budget, deadline_remaining())
+    box: dict = {}
+    done = threading.Event()
+    ctx = contextvars.copy_context()
+
+    def worker() -> None:
+        try:
+            box["result"] = ctx.run(fn)
+        # simonlint: ignore[swallowed-exception] -- not swallowed: the boxed
+        # error re-raises in the supervising caller the moment done is set
+        except BaseException as we:  # noqa: BLE001
+            box["error"] = we
+        finally:
+            done.set()
+
+    t = threading.Thread(target=worker, name=f"simon-guard-{site}",
+                         daemon=True)
+    t.start()
+    if not done.wait(budget):
+        check_deadline(site)  # the caller's budget expired, not the device
+        raise _declare_wedged(site, injected=False)
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
+
+
+def _declare_wedged(site: str, injected: bool) -> BackendWedged:
+    backend = current_backend()
+    quarantine(backend, f"{CAUSE_WEDGE}@{site}")
+    obs.GUARD_WATCHDOG_EXPIRIES.labels(site=site).inc()
+    record_event("wedge", site, backend)
+    return BackendWedged(site, backend, injected=injected)
+
+
+# -------------------------------------------------------- OOM classification --
+
+
+def oom_site(e: BaseException) -> Optional[str]:
+    """The dispatch stage an error OOM'd at ("to_device" / "dispatch"), or
+    None when the error is not an out-of-memory condition. Injected
+    `oom_to_device`/`oom_dispatch` faults classify exactly like the real
+    jaxlib RESOURCE_EXHAUSTED they stand in for."""
+    site = getattr(e, "site", None)
+    if (isinstance(e, faults.FaultInjected) and isinstance(site, str)
+            and site.startswith("oom_")):
+        return site[len("oom_"):]
+    if type(e).__name__ == "XlaRuntimeError":
+        msg = str(e)
+        if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+            # real OOMs do not carry the phase; attribute to dispatch (the
+            # stage whose retry semantics — bisection — apply either way)
+            return "dispatch"
+    return None
+
+
+def containment_cause(e: BaseException) -> Optional[str]:
+    """Failover cause label for a containable error, or None when the error
+    must propagate (deadline expiries, injected non-OOM faults, real bugs)."""
+    if isinstance(e, BackendWedged):
+        return CAUSE_WEDGE
+    if isinstance(e, OOMBisectionExhausted):
+        return CAUSE_OOM_EXHAUSTED
+    if oom_site(e) is not None:
+        return CAUSE_OOM
+    return None
+
+
+def count_failover(cause: str, where: str) -> None:
+    """One failover decision: counter + event trace (callers log the rest)."""
+    obs.GUARD_FAILOVERS.labels(cause=cause).inc()
+    record_event("failover", cause, where)
+
+
+# ------------------------------------------------- capacity-search journal ----
+
+
+class SearchJournal:
+    """Fsync'd JSONL journal of capacity-search probe verdicts.
+
+    Line 1 is a header carrying the search's options digest; every later line
+    is one verdict ``{"n": ..., "ok": ..., "n_failed": ...}``. `record` is
+    write → flush → fsync, so a SIGKILL between probes loses at most the
+    probe in flight; a torn trailing line (killed mid-write) is ignored on
+    load — the valid prefix IS the journal. `open` rejects a file whose
+    digest does not match the current search (`JournalMismatch`): a stale
+    journal can steer a DIFFERENT search to a wrong answer, which is strictly
+    worse than re-probing. The `journal_write` fault site fires before the
+    write, so crash-during-journaling is deterministically testable."""
+
+    KIND = "simon-capacity-journal"
+    VERSION = 1
+
+    def __init__(self, path: str, digest: str) -> None:
+        self.path = path
+        self.digest = digest
+        self.verdicts: Dict[int, Tuple[bool, int]] = {}
+        self.replayed = 0  # lookup hits served without a device probe
+        self._f = None
+
+    @classmethod
+    def open(cls, path: str, digest: str) -> "SearchJournal":
+        self = cls(path, digest)
+        if os.path.exists(path) and os.path.getsize(path) > 0:
+            with open(path, "rb") as f:
+                raw = f.read()
+            lines = raw.decode("utf-8", "replace").splitlines(keepends=True)
+            try:
+                head = json.loads(lines[0])
+            except ValueError:
+                raise JournalMismatch(
+                    f"{path} is not a capacity-search journal "
+                    f"(unparsable header)") from None
+            if not isinstance(head, dict) or head.get("kind") != cls.KIND:
+                raise JournalMismatch(
+                    f"{path} is not a capacity-search journal")
+            if head.get("digest") != digest:
+                raise JournalMismatch(
+                    f"journal {path} was written by a different search "
+                    f"(journal digest {head.get('digest')!r} != current "
+                    f"{digest!r}); refusing to resume — delete it or point "
+                    f"--resume-journal elsewhere")
+            valid_chars = len(lines[0])
+            for ln in lines[1:]:
+                # a record the crash left unterminated doesn't count as
+                # durable even if it happens to parse: neither served from
+                # memory nor kept on disk (the truncation below drops it)
+                if not ln.endswith("\n"):
+                    break
+                body = ln.strip()
+                try:
+                    if body:
+                        rec = json.loads(body)
+                        self.verdicts[int(rec["n"])] = (
+                            bool(rec["ok"]), int(rec["n_failed"]))
+                except (ValueError, KeyError, TypeError):
+                    break  # torn tail from a crash: the valid prefix ends here
+                valid_chars += len(ln)
+            self._f = open(path, "a")
+            if valid_chars < len(raw.decode("utf-8", "replace")):
+                # repair: drop the torn tail so the next append starts a
+                # fresh line instead of extending the garbage
+                self._f.truncate(len(
+                    "".join(lines)[:valid_chars].encode("utf-8")))
+                self._f.flush()
+                os.fsync(self._f.fileno())
+        else:
+            self._f = open(path, "w")
+            self._append({"kind": cls.KIND, "v": cls.VERSION, "digest": digest})
+        return self
+
+    def _append(self, doc: dict) -> None:
+        self._f.write(json.dumps(doc, sort_keys=True) + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def lookup(self, n: int) -> Optional[Tuple[bool, int]]:
+        hit = self.verdicts.get(int(n))
+        if hit is not None:
+            self.replayed += 1
+            obs.JOURNAL_REPLAYS.inc()
+        return hit
+
+    def record(self, n: int, ok: bool, n_failed: int) -> None:
+        faults.maybe_fail("journal_write")
+        self._append({"n": int(n), "ok": bool(ok), "n_failed": int(n_failed)})
+        self.verdicts[int(n)] = (bool(ok), int(n_failed))
+        obs.JOURNAL_RECORDS.inc()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None

@@ -413,6 +413,7 @@ class Server:
                     import resource
 
                     from ..obs import REGISTRY
+                    from ..resilience import guard
                     from ..utils.trace import recent_spans
 
                     started = getattr(server, "_t_start", None)
@@ -421,6 +422,9 @@ class Server:
                             round(time.time() - started, 3) if started else None),
                         "max_rss_kb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
                         "recent_traces": recent_spans(),
+                        # simonguard containment state: quarantined backends,
+                        # watchdog config, recent wedge/bisect/failover events
+                        "guard": guard.state(),
                         "metrics": REGISTRY.values(),
                     })
                 elif self.path == "/debug/fault-plan":

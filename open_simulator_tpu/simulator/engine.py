@@ -26,6 +26,7 @@ from __future__ import annotations
 import contextlib
 import copy
 import dataclasses
+import functools
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -34,6 +35,7 @@ import numpy as np
 from ..core import constants as C
 from ..obs import instruments as obs
 from ..resilience import faults
+from ..resilience import guard
 from ..core.types import AppResource, NodeStatus, ResourceTypes, SimulateResult, UnscheduledPod
 from ..algo.queues import sort_affinity, sort_toleration
 from ..models.workloads import generate_valid_pods_from_app
@@ -223,6 +225,13 @@ class Simulator:
         self.use_waves = True
         self.use_mesh = use_mesh
         self._mesh = _UNSET
+        # simonguard (resilience/guard.py): backends this run executed on, in
+        # order — ["tpu", "cpu"] after a mid-run failover. Seeded lazily at
+        # the first device call; surfaced on SimulateResult.backend_path so
+        # a degraded run is never silent. _fallback pins the rest of this
+        # simulator's life to the CPU fallback after a containment.
+        self.backend_path: List[str] = []
+        self._fallback = False
         self._wave_elig_cache: Dict[int, Tuple[bool, ...]] = {}
         self._domain_count_cache: Dict[str, int] = {}  # topo key → #domains
         import os as _os
@@ -336,17 +345,82 @@ class Simulator:
 
         The whole call is transactional (_transaction): any failure — an
         injected fault, a device error, a KeyboardInterrupt — rolls
-        placements, census, and pod dicts back to the pre-call state."""
+        placements, census, and pod dicts back to the pre-call state.
+
+        Containment (simonguard): a wedged backend (BackendWedged from the
+        dispatch watchdog) or a device OOM that bisection could not contain
+        fails the CALL over to the CPU fallback — the transaction has already
+        rolled this call back, so earlier committed calls (the committed
+        segments of the run) stay in place and only this batch replays, on
+        CPU, to the identical placements (serial-order determinism). The
+        failover is recorded on backend_path and
+        simon_guard_failovers_total{cause}; it is never silent."""
         t0 = time.perf_counter()
         try:
-            with self._transaction(memo_pods=pods):
-                if self._track_priorities(pods):
-                    from .preemption import schedule_with_preemption
+            def attempt():
+                with self._transaction(memo_pods=pods):
+                    if self._track_priorities(pods):
+                        from .preemption import schedule_with_preemption
 
-                    return schedule_with_preemption(self, pods)
-                return self._schedule_pods_inner(pods)
+                        return schedule_with_preemption(self, pods)
+                    return self._schedule_pods_inner(pods)
+
+            return self._run_contained(attempt)
         finally:
             obs.E2E_SECONDS.observe(time.perf_counter() - t0)
+
+    # ------------------------------------------------ guard / failover -------
+
+    # Bounded failover attempts per call: the initial run plus up to two
+    # contained retries (default backend → CPU, and one more in case an
+    # injected plan also faults the first CPU attempt). A third containment
+    # propagates — persistent OOM on the host backend is a real capacity
+    # problem, not a transient.
+    _MAX_BACKEND_ATTEMPTS = 3
+
+    def _run_contained(self, attempt: Callable):
+        """Run one transactional scheduling/probe attempt with mid-run
+        backend failover: containable failures (guard.containment_cause)
+        retry on the CPU fallback; everything else propagates."""
+        for k in range(self._MAX_BACKEND_ATTEMPTS):
+            try:
+                with self._device_scope():
+                    return attempt()
+            except BaseException as e:
+                cause = guard.containment_cause(e)
+                if cause is None or k + 1 >= self._MAX_BACKEND_ATTEMPTS:
+                    raise
+                self._failover(cause)
+
+    @contextlib.contextmanager
+    def _device_scope(self):
+        """Route this call's device work: the default backend normally, the
+        CPU fallback once this simulator failed over or the process
+        quarantined the default backend. Seeds backend_path on first use."""
+        use_cpu = self._fallback or guard.default_quarantined()
+        if not self.backend_path:
+            self.backend_path.append(
+                "cpu" if use_cpu else guard.current_backend())
+        if use_cpu and guard.current_backend() != "cpu":
+            with guard.fallback_scope():
+                yield
+        else:
+            yield
+
+    def _failover(self, cause: str) -> None:
+        """Commit this simulator to the CPU fallback for the rest of its
+        life (the transaction already rolled the failing call back)."""
+        import logging
+
+        guard.count_failover(cause, "schedule")
+        self._fallback = True
+        self._mesh = None  # the fallback runs single-device; drop shardings
+        self._last_tables = self._last_carry = None
+        self.backend_path.append("cpu")
+        logging.getLogger("open_simulator_tpu").warning(
+            "device failure contained (%s); failing over to the CPU backend "
+            "and replaying the rolled-back batch (backend_path=%s)",
+            cause, self.backend_path)
 
     def _count_commits(self, n: int = 1) -> None:
         """The one COMMITS increment path: tracks how many commit events are
@@ -627,8 +701,6 @@ class Simulator:
         return segs
 
     def _schedule_run(self, to_schedule: List[dict]) -> List[UnscheduledPod]:
-        from ..utils.trace import Span
-
         failed: List[UnscheduledPod] = []
         if not to_schedule:
             return failed
@@ -639,6 +711,38 @@ class Simulator:
                 UnscheduledPod(pod, self._format_reason(pod, {}, 0))
                 for pod in to_schedule
             ]
+        try:
+            return self._schedule_run_once(to_schedule)
+        except BaseException as e:
+            site = guard.oom_site(e)
+            if site is None:
+                raise
+            return self._bisect_oom(to_schedule, site, e)
+
+    def _bisect_oom(self, to_schedule: List[dict], site: str,
+                    err: BaseException) -> List[UnscheduledPod]:
+        """Contain a device OOM by scheduling the batch as two halves.
+
+        The engine's serial-order semantics make split and unsplit runs
+        bit-identical: the first half's commits seed the second half's
+        encode exactly as the serial loop would have (tests/test_guard.py
+        proves it, odd sizes included). Recursion halves down to the
+        bisection floor; an OOM that persists there is structural —
+        OOMBisectionExhausted hands the call to the backend failover."""
+        floor = guard.oom_bisect_floor()
+        if len(to_schedule) <= floor:
+            guard.record_event("oom_exhausted", site, len(to_schedule))
+            raise guard.OOMBisectionExhausted(
+                site, len(to_schedule), floor) from err
+        obs.GUARD_OOM_BISECTIONS.labels(site=site).inc()
+        guard.record_event("oom_bisect", site, len(to_schedule))
+        mid = len(to_schedule) // 2
+        failed = self._schedule_run(to_schedule[:mid])
+        failed.extend(self._schedule_run(to_schedule[mid:]))
+        return failed
+
+    def _schedule_run_once(self, to_schedule: List[dict]) -> List[UnscheduledPod]:
+        from ..utils.trace import Span
 
         with Span("schedule_run", log_if_longer=30.0) as span:
             t_enc = time.perf_counter()
@@ -673,6 +777,7 @@ class Simulator:
         outs: List[tuple] = []  # (seg, device array, carry AFTER the segment)
         for seg in segs:
             faults.maybe_fail("dispatch")
+            faults.maybe_fail("oom_dispatch")
             if seg[0] == "serial":
                 _, start, length = seg
                 pad = bucket_capped(length, 2048)
@@ -685,12 +790,15 @@ class Simulator:
                 obs.record_dispatch("schedule_batch", P=pad, zones=bt.n_zones,
                                     gpu=enable_gpu, storage=enable_storage,
                                     **dims)
-                carry, ch = kernels.schedule_batch(
-                    tables, carry, jnp.asarray(pg), jnp.asarray(fn), jnp.asarray(vd),
+                call = functools.partial(
+                    kernels.schedule_batch,
+                    tables, carry, jnp.asarray(pg), jnp.asarray(fn),
+                    jnp.asarray(vd),
                     n_zones=bt.n_zones, enable_gpu=enable_gpu,
                     enable_storage=enable_storage,
                     w=self.score_w, filters=self.filter_flags,
                 )
+                carry, ch = guard.supervised(call, site="dispatch", pods=pad)
                 outs.append((seg, ch, carry))
             elif seg[0] == "spread":
                 _, start, length, g, cap1, ss_live, sa_live, spread_wave = seg
@@ -700,12 +808,15 @@ class Simulator:
                     block = kernels.wave_block_for(length, self.na.N)
                     obs.record_dispatch("schedule_spread_wave", block=block,
                                         **dims)
-                    carry, counts, _ = kernels.schedule_spread_wave(
+                    call = functools.partial(
+                        kernels.schedule_spread_wave,
                         tables, carry, jnp.int32(g), jnp.int32(length),
                         jnp.asarray(cap1), w=self.score_w,
                         filters=self.filter_flags,
                         block=block,
                     )
+                    carry, counts, _ = guard.supervised(
+                        call, site="dispatch", pods=length)
                     outs.append((seg, counts, carry))
                     continue
                 pad = bucket_capped(length, 2048)
@@ -714,33 +825,43 @@ class Simulator:
                 obs.record_dispatch("schedule_group_serial", P=pad, ss=ss_live,
                                     sa=sa_live,
                                     zones=bt.n_zones if ss_live else 2, **dims)
-                carry, counts, _ = kernels.schedule_group_serial(
-                    tables, carry, jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1),
+                call = functools.partial(
+                    kernels.schedule_group_serial,
+                    tables, carry, jnp.int32(g), jnp.asarray(vd),
+                    jnp.asarray(cap1),
                     w=self.score_w, filters=self.filter_flags,
                     # n_zones only shapes the ss_live zone table; pin it for
                     # DNS-only segments so new zone labels don't recompile them
                     ss_live=ss_live, sa_live=sa_live,
                     n_zones=bt.n_zones if ss_live else 2,
                 )
+                carry, counts, _ = guard.supervised(
+                    call, site="dispatch", pods=pad)
                 outs.append((seg, counts, carry))
             else:
                 _, start, length, g, cap1, gpu_live = seg
                 block = kernels.wave_block_for(length, self.na.N)
                 obs.record_dispatch("schedule_wave", block=block,
                                     gpu_live=gpu_live, **dims)
-                carry, counts, _ = kernels.schedule_wave(
+                call = functools.partial(
+                    kernels.schedule_wave,
                     tables, carry, jnp.int32(g), jnp.int32(length),
                     jnp.asarray(cap1), gpu_live=gpu_live,
                     w=self.score_w, filters=self.filter_flags,
                     block=block,
                 )
+                carry, counts, _ = guard.supervised(
+                    call, site="dispatch", pods=length)
                 outs.append((seg, counts, carry))
         span.step("dispatch")
         final_carry = carry
         seg_of = np.zeros(P, np.int32)
         if outs:
             faults.maybe_fail("fetch")
-            flat = np.asarray(jnp.concatenate([a.astype(jnp.int32) for _, a, _ in outs]))
+            flat = guard.supervised(
+                lambda: np.asarray(jnp.concatenate(
+                    [a.astype(jnp.int32) for _, a, _ in outs])),
+                site="fetch", pods=P)
             off = 0
             for k, (seg, a, _) in enumerate(outs):
                 part = flat[off:off + a.shape[0]]
@@ -823,9 +944,17 @@ class Simulator:
         whole workload per candidate node count) is the intended caller; the
         authoritative placement run remains schedule_pods. Transactional like
         schedule_pods: a failure rolls back the pre-bound commits (and their
-        pod-dict status writes — probe pods belong to the CALLER)."""
-        with self._transaction():
-            return self._probe_pods_inner(pods)
+        pod-dict status writes — probe pods belong to the CALLER).
+
+        Containment: a wedge/OOM fails the whole probe over to the CPU
+        fallback and re-runs it there (probes are never BISECTED — splitting
+        a probe run would let the second half see placements the first never
+        committed, changing the counted semantics)."""
+        def attempt():
+            with self._transaction():
+                return self._probe_pods_inner(pods)
+
+        return self._run_contained(attempt)
 
     def _probe_pods_inner(self, pods: List[dict]) -> Tuple[int, int]:
         run: List[dict] = []
@@ -858,6 +987,7 @@ class Simulator:
         placed_parts = []
         for seg in segs:
             faults.maybe_fail("dispatch")
+            faults.maybe_fail("oom_dispatch")
             if seg[0] == "serial":
                 _, start, length = seg
                 pad = bucket_capped(length, 2048)
@@ -870,12 +1000,15 @@ class Simulator:
                 obs.record_dispatch("schedule_batch", P=pad, zones=bt.n_zones,
                                     gpu=enable_gpu, storage=enable_storage,
                                     **dims)
-                carry, ch = kernels.schedule_batch(
-                    tables, carry, jnp.asarray(pg), jnp.asarray(fn), jnp.asarray(vd),
+                call = functools.partial(
+                    kernels.schedule_batch,
+                    tables, carry, jnp.asarray(pg), jnp.asarray(fn),
+                    jnp.asarray(vd),
                     n_zones=bt.n_zones, enable_gpu=enable_gpu,
                     enable_storage=enable_storage,
                     w=self.score_w, filters=self.filter_flags,
                 )
+                carry, ch = guard.supervised(call, site="dispatch", pods=pad)
                 placed_parts.append(jnp.sum((ch >= 0).astype(jnp.int32)))
             elif seg[0] == "spread":
                 _, start, length, g, cap1, ss_live, sa_live, spread_wave = seg
@@ -883,12 +1016,15 @@ class Simulator:
                     block = kernels.wave_block_for(length, self.na.N)
                     obs.record_dispatch("schedule_spread_wave", block=block,
                                         **dims)
-                    carry, _, placed = kernels.schedule_spread_wave(
+                    call = functools.partial(
+                        kernels.schedule_spread_wave,
                         tables, carry, jnp.int32(g), jnp.int32(length),
                         jnp.asarray(cap1), w=self.score_w,
                         filters=self.filter_flags,
                         block=block,
                     )
+                    carry, _, placed = guard.supervised(
+                        call, site="dispatch", pods=length)
                     placed_parts.append(placed)
                     continue
                 pad = bucket_capped(length, 2048)
@@ -897,30 +1033,39 @@ class Simulator:
                 obs.record_dispatch("schedule_group_serial", P=pad, ss=ss_live,
                                     sa=sa_live,
                                     zones=bt.n_zones if ss_live else 2, **dims)
-                carry, _, placed = kernels.schedule_group_serial(
-                    tables, carry, jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1),
+                call = functools.partial(
+                    kernels.schedule_group_serial,
+                    tables, carry, jnp.int32(g), jnp.asarray(vd),
+                    jnp.asarray(cap1),
                     w=self.score_w, filters=self.filter_flags,
                     # n_zones only shapes the ss_live zone table; pin it for
                     # DNS-only segments so new zone labels don't recompile them
                     ss_live=ss_live, sa_live=sa_live,
                     n_zones=bt.n_zones if ss_live else 2,
                 )
+                carry, _, placed = guard.supervised(
+                    call, site="dispatch", pods=pad)
                 placed_parts.append(placed)
             else:
                 _, start, length, g, cap1, gpu_live = seg
                 block = kernels.wave_block_for(length, self.na.N)
                 obs.record_dispatch("schedule_wave", block=block,
                                     gpu_live=gpu_live, **dims)
-                carry, _, placed = kernels.schedule_wave(
+                call = functools.partial(
+                    kernels.schedule_wave,
                     tables, carry, jnp.int32(g), jnp.int32(length),
                     jnp.asarray(cap1), gpu_live=gpu_live,
                     w=self.score_w, filters=self.filter_flags,
                     block=block,
                 )
+                carry, _, placed = guard.supervised(
+                    call, site="dispatch", pods=length)
                 placed_parts.append(placed)
         self._last_tables, self._last_carry = bt, carry
         faults.maybe_fail("fetch")
-        total = int(np.asarray(jnp.sum(jnp.stack(placed_parts))))  # one fetch
+        total = int(guard.supervised(
+            lambda: np.asarray(jnp.sum(jnp.stack(placed_parts))),
+            site="fetch", pods=P))  # one fetch
         return scheduled + total, total_known
 
     def probe_utilization(self) -> Dict[str, float]:
@@ -956,6 +1101,13 @@ class Simulator:
         autodetects >1 visible device, overridable via OPEN_SIMULATOR_MESH."""
         if self._mesh is not _UNSET:
             return self._mesh
+        if self._fallback or guard.default_quarantined():
+            # degraded mode is single-device CPU: a mesh over the default
+            # backend's devices would carry explicit shardings that OVERRIDE
+            # jax.default_device, re-dispatching on the wedged backend and
+            # burning a watchdog timeout per fresh Simulator
+            self._mesh = None
+            return None
         import os
 
         want = self.use_mesh
@@ -991,6 +1143,7 @@ class Simulator:
 
     def _to_device(self, bt: BatchTables):
         faults.maybe_fail("to_device")
+        faults.maybe_fail("oom_to_device")
         jnp = _jax()
         from ..parallel.mesh import tables_from_batch
 
@@ -1037,11 +1190,12 @@ class Simulator:
         jnp = _jax()
 
         enable_gpu, enable_storage = getattr(self, "_last_flags", (True, True))
-        feasible, stages = kernels.feasibility_jit(
+        feasible, stages = guard.supervised(functools.partial(
+            kernels.feasibility_jit,
             tables, carry, jnp.int32(g), jnp.int32(forced), jnp.asarray(True),
             enable_gpu=enable_gpu, enable_storage=enable_storage,
             filters=self.filter_flags,
-        )
+        ), site="dispatch", pods=1)
         N = self.na.N  # stages arrays may carry phantom node padding; slice it off
         stages = {k: np.asarray(v)[:N] for k, v in stages.items()}
         return self._reasons_from_stages(pod, forced, stages)
@@ -1125,10 +1279,14 @@ class Simulator:
             patch(pods)
         self.register_app_objects(app.resource)
         failed = self.schedule_pods(pods)
-        return SimulateResult(unscheduled_pods=failed, node_status=self.get_cluster_node_status())
+        return SimulateResult(unscheduled_pods=failed,
+                              node_status=self.get_cluster_node_status(),
+                              backend_path=list(self.backend_path))
 
     def run_cluster(self, cluster: ResourceTypes) -> SimulateResult:
         """RunCluster + syncClusterResourceList (simulator.go:225-230,365-447)."""
         self.register_cluster_objects(cluster)
         failed = self.schedule_pods(cluster.pods)
-        return SimulateResult(unscheduled_pods=failed, node_status=self.get_cluster_node_status())
+        return SimulateResult(unscheduled_pods=failed,
+                              node_status=self.get_cluster_node_status(),
+                              backend_path=list(self.backend_path))

@@ -53,6 +53,8 @@ PostFilter at that state → evict → continue with the suffix.
 
 from __future__ import annotations
 
+import functools
+import os
 from dataclasses import replace
 from typing import Dict, List, Optional, Tuple
 
@@ -62,6 +64,7 @@ from ..core import constants as C
 from ..core.types import UnscheduledPod
 from ..obs import instruments as obs
 from ..ops import kernels
+from ..resilience import guard
 from ..utils.objutil import labels_of, match_label_selector, name_of, namespace_of
 from .encode import (
     SIG_MEMO_KEY,
@@ -224,11 +227,12 @@ def _fits(sim, g: int, node_i: int, placed2) -> bool:
     bt = pad_batch_tables(bt, bucket_capped(sim.na.N, 1024))
     tables, carry = sim._to_device(bt)
     enable_gpu, enable_storage = plugin_flags(bt)
-    feasible, _ = kernels.feasibility_jit(
+    feasible, _ = guard.supervised(functools.partial(
+        kernels.feasibility_jit,
         tables, carry, jnp.int32(g), jnp.int32(-1), jnp.asarray(True),
         enable_gpu=enable_gpu, enable_storage=enable_storage,
         filters=sim.filter_flags,
-    )
+    ), site="dispatch", pods=1)
     return bool(np.asarray(feasible)[node_i])
 
 
@@ -290,11 +294,12 @@ def try_preempt(sim, pod: dict) -> Tuple[int, List[dict], Dict[str, int]]:
     tables, carry = sim._to_device(bt)
     enable_gpu, enable_storage = plugin_flags(bt)
     g, forced = int(bt.pod_group[0]), int(bt.forced_node[0])
-    feasible, stages = kernels.feasibility_jit(
+    feasible, stages = guard.supervised(functools.partial(
+        kernels.feasibility_jit,
         tables, carry, jnp.int32(g), jnp.int32(forced), jnp.asarray(True),
         enable_gpu=enable_gpu, enable_storage=enable_storage,
         filters=sim.filter_flags,
-    )
+    ), site="dispatch", pods=1)
     N = sim.na.N
     stages = {k: np.asarray(v)[:N] for k, v in stages.items()}
     reasons = sim._reasons_from_stages(pod, forced, stages)
@@ -452,6 +457,17 @@ def evict(sim, victims: List[dict], node_i: int, preemptor: dict) -> None:
 # ------------------------------------------------------------- the outer loop -----
 
 
+def _max_replays() -> int:
+    """Bound on rewind/replay passes per schedule_pods call. Default is
+    generous — real workloads rarely exceed a handful of distinct failing
+    specs — but finite, so the O(failures × batch) corner cannot run away."""
+    try:
+        return max(0, int(os.environ.get(
+            "OPEN_SIMULATOR_MAX_PREEMPTION_REPLAYS", "512")))
+    except ValueError:  # tuning knob: fall back, don't crash the run
+        return 512
+
+
 def schedule_with_preemption(sim, pods: List[dict]) -> List[UnscheduledPod]:
     """schedule_pods with the PostFilter armed (mixed priorities present).
 
@@ -472,10 +488,22 @@ def schedule_with_preemption(sim, pods: List[dict]) -> List[UnscheduledPod]:
     # spec.priority: a later same-spec pod with HIGHER priority sees a larger
     # victim pool and must get its own attempt.
     attempted: Dict[object, int] = {}
+    # Replay-cost cap (ADVICE r5 / PARITY.md cost envelope): each loop
+    # iteration is one rewind + prefix replay + suffix re-run — worst case
+    # O(failures × batch) pod reschedules. The cap bounds that; beyond it the
+    # remaining failures are recorded WITHOUT preemption attempts (placement
+    # degrades conservatively: pods that could have preempted stay failed)
+    # and the skips are visible as preemption_attempts{outcome="capped"}.
+    replays = 0
+    cap = _max_replays()
     while True:
         target = _select_target(sim, remaining, failed, attempted)
         if target is None:
             return recorded + failed
+        if replays >= cap:
+            obs.PREEMPT_ATTEMPTS.labels(outcome="capped").inc(len(failed))
+            return recorded + failed
+        replays += 1
         restore(sim, snap)
         obs.PREEMPT_REPLAY_PODS.inc(target)
         prefix_failed = sim._schedule_pods_inner(remaining[:target])

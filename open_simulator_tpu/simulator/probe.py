@@ -59,6 +59,7 @@ probes.
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -67,6 +68,7 @@ import numpy as np
 from ..models.fakenode import new_fake_nodes
 from ..obs import instruments as obs
 from ..resilience import faults
+from ..resilience import guard
 from ..ops.resources import CPU_I, MEM_I
 from .encode import (
     HOSTNAME,
@@ -252,6 +254,8 @@ class ProbeSession:
         env = os.environ.get("OPEN_SIMULATOR_MESH", "")
         if env in ("0", "false", "no"):
             return None
+        if guard.default_quarantined():
+            return None  # degraded mode: no shardings over a wedged backend
         import jax
 
         n = len(jax.devices())
@@ -267,6 +271,7 @@ class ProbeSession:
     def _upload(self) -> None:
         """(Re-)pad and transfer the tables; rebuild per-segment batch arrays."""
         faults.maybe_fail("to_device")
+        faults.maybe_fail("oom_to_device")
         jnp = _jax()
         from .engine import batch_tables_nbytes
 
@@ -390,14 +395,26 @@ class ProbeSession:
         return out
 
     def _dispatch(self, active_s: np.ndarray):
+        S = active_s.shape[0]
+        # The whole fan-out round — lane padding, seed broadcast, every
+        # segment dispatch, the one fetch — runs as ONE supervised unit: the
+        # mesh context is thread-local, so it must be entered inside the
+        # watchdog's worker thread, and a wedge anywhere in the round
+        # classifies the same way (the search then falls back to fresh
+        # probes on the surviving backend).
+        placed_s, requested_s = guard.supervised(
+            functools.partial(self._dispatch_round, active_s),
+            site="dispatch", pods=self._run_len * max(1, S))
+        return placed_s[:S], requested_s[:S]
+
+    def _dispatch_round(self, active_s: np.ndarray):
         jnp = _jax()
         from ..ops import kernels
 
-        S = active_s.shape[0]
         if self._mesh is not None:
             # the scenario axis shards evenly: round the lane count up to a
             # multiple of the mesh's device count (padding repeats the last
-            # candidate; the surplus lanes are sliced off below)
+            # candidate; the surplus lanes are sliced off by the caller)
             from ..parallel.mesh import SCENARIO_AXIS
 
             shards = self._mesh.shape[SCENARIO_AXIS]
@@ -436,6 +453,7 @@ class ProbeSession:
         with ctx:
             for seg in self._segs:
                 faults.maybe_fail("dispatch")
+                faults.maybe_fail("oom_dispatch")
                 if seg[0] == "serial":
                     _, start, length = seg
                     pad = bucket_capped(length, 2048)
@@ -488,7 +506,7 @@ class ProbeSession:
             faults.maybe_fail("fetch")
             placed_s = np.asarray(jnp.sum(jnp.stack(placed_parts), axis=0))
             requested_s = np.asarray(carry_s.requested)
-        return placed_s[:S], requested_s[:S]
+        return placed_s, requested_s
 
     def _utilization(self, n: int, requested_row: Optional[np.ndarray]) -> Dict[str, float]:
         """probe_utilization's aggregate totals for candidate n: f64 host sums

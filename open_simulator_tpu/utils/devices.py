@@ -80,13 +80,75 @@ def enable_compilation_cache() -> None:
             "kernels will recompile per process", e)
 
 
-def probe_default_backend(timeout: float = 60.0) -> tuple:
+def _probe_state_path() -> str:
+    """Where the last probe outcome persists across processes. ONE shared
+    default (under the XDG cache, alongside the XLA cache) for every caller
+    — CLI, server, bench, the background probe logger — so any process's
+    wedge observation cools down all of them. OPEN_SIMULATOR_PROBE_STATE
+    overrides (point it at a per-host shared location when $HOME isn't)."""
+    p = os.environ.get("OPEN_SIMULATOR_PROBE_STATE", "")
+    if p:
+        return p
+    return os.path.join(
+        os.environ.get("XDG_CACHE_HOME")
+        or os.path.join(os.path.expanduser("~"), ".cache"),
+        "open-simulator-tpu", "probe_state.json")
+
+
+def _read_probe_state(path: str):
+    import json
+
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None  # missing/corrupt state: probe normally
+
+
+def _write_probe_state(path: str, rec: dict) -> None:
+    """Atomic best-effort persist (tmp + rename): a torn write must never
+    leave a half-record that later parses as a wedge."""
+    import json
+
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+    except OSError as e:
+        import logging
+
+        logging.getLogger("open_simulator_tpu").debug(
+            "probe state not persisted (%s)", e)
+
+
+def probe_cooldown_s() -> float:
+    """Seconds a persisted wedge outcome short-circuits re-probing
+    (OPEN_SIMULATOR_PROBE_COOLDOWN_S; 0 disables). Re-probing a known-wedged
+    host burns the full probe timeout (60-120s) on EVERY run — the r5
+    pattern: 20/20 probe attempts timing out across a round — so within the
+    window the run skips straight to the CPU fallback."""
+    try:
+        return float(os.environ.get("OPEN_SIMULATOR_PROBE_COOLDOWN_S", "600"))
+    except ValueError:
+        return 600.0
+
+
+def probe_default_backend(timeout: float = 60.0,
+                          state_path: str = "") -> tuple:
     """Probe `jax.devices()` on the default platform in a SUBPROCESS with a
     deadline. The single shared implementation of the wedge-safe probe (bench,
     the background probe logger, and the CLI all use it): a wedged accelerator
     tunnel blocks backend init forever holding a global lock, so the probe must
     never run in-process, and the killed child may be unkillable (D-state in a
     driver ioctl) — kill then bounded-wait to reap when possible.
+
+    The last outcome persists at `state_path` (default _probe_state_path());
+    a wedge outcome within the probe_cooldown_s window short-circuits to
+    (False, {"outcome": "cooldown", ...}) without burning another probe
+    timeout — a known-wedged host goes straight to cpu-fallback.
 
     Returns (ok, record) where record carries ts/outcome/elapsed_s plus
     rc/platform/stderr_tail on non-timeout exits — the stderr tail is what
@@ -96,9 +158,19 @@ def probe_default_backend(timeout: float = 60.0) -> tuple:
     import tempfile
     import time
 
+    state_path = state_path or _probe_state_path()
     t0 = time.time()
     rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t0)),
            "timeout_s": timeout}
+    cooldown = probe_cooldown_s()
+    st = _read_probe_state(state_path) if cooldown > 0 else None
+    if st and st.get("outcome") in ("timeout", "error"):
+        age = t0 - float(st.get("ts_epoch") or 0)
+        if 0 <= age < cooldown:
+            rec.update(outcome="cooldown", last_outcome=st.get("outcome"),
+                       cooldown_remaining_s=round(cooldown - age, 1),
+                       elapsed_s=0.0)
+            return False, rec
     # stderr to a FILE, not a pipe: a chatty plugin writing >64KB to an
     # undrained pipe would wedge an otherwise-healthy probe into a timeout
     with tempfile.TemporaryFile() as errf:
@@ -129,6 +201,10 @@ def probe_default_backend(timeout: float = 60.0) -> tuple:
             except subprocess.TimeoutExpired:
                 pass
             rec.update(outcome="timeout", elapsed_s=round(time.time() - t0, 1))
+    # persist the outcome next to the probe log so the NEXT process can
+    # honor the cooldown (a wedge rarely clears within minutes)
+    _write_probe_state(state_path, {"ts_epoch": t0, "outcome": rec["outcome"],
+                                    "ts": rec["ts"]})
     return ok, rec
 
 

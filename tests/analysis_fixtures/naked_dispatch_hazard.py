@@ -1,0 +1,61 @@
+"""Fixture for the naked-dispatch rule: direct kernel dispatches that bypass
+guard.supervised must fire; supervised forms (lambda, functools.partial,
+named function / method argument) and suppressed sites must not."""
+
+import functools
+
+from open_simulator_tpu.ops import kernels
+from open_simulator_tpu.resilience import guard
+
+tables = carry = active = pg = fn = vd = None
+
+
+def naked_serial():
+    # finding: direct dispatch, no watchdog
+    return kernels.schedule_batch(tables, carry, pg, fn, vd)
+
+
+def naked_wave():
+    # finding: direct dispatch in an assignment
+    c, counts, placed = kernels.schedule_wave(tables, carry, 0, 8, False)
+    return counts
+
+
+def naked_feasibility():
+    # finding: feasibility dispatch blocks at fetch just the same
+    feasible, stages = kernels.feasibility_jit(tables, carry, 0, -1, True)
+    return feasible
+
+
+def naked_suppressed():
+    # simonlint: ignore[naked-dispatch] -- offline harness, no wedge exposure
+    return kernels.probe_serial_fanout(tables, carry, active, pg, fn, vd)
+
+
+def guarded_lambda():
+    return guard.supervised(
+        lambda: kernels.schedule_batch(tables, carry, pg, fn, vd),
+        site="dispatch", pods=8)
+
+
+def guarded_partial():
+    call = functools.partial(kernels.schedule_group_serial, tables, carry)
+    return guard.supervised(call, site="dispatch", pods=8)
+
+
+def _round():
+    return kernels.probe_wave_fanout(tables, carry, active, 0, 8, False)
+
+
+def guarded_named_function():
+    return guard.supervised(_round, site="dispatch", pods=8)
+
+
+class Session:
+    def _dispatch_round(self, active_s):
+        return kernels.probe_group_serial_fanout(tables, carry, active_s)
+
+    def dispatch(self, active_s):
+        return guard.supervised(
+            functools.partial(self._dispatch_round, active_s),
+            site="dispatch", pods=8)

@@ -140,12 +140,30 @@ def test_clean_module_is_clean():
     assert fr.findings == []
 
 
+def test_naked_dispatch_rule_fires():
+    # three direct kernel dispatches fire; the offline-harness waiver is
+    # reported suppressed, not active
+    assert _counts("naked_dispatch_hazard.py", "naked-dispatch") == 3
+    assert _counts("naked_dispatch_hazard.py", "naked-dispatch",
+                   suppressed=True) == 1
+
+
+def test_naked_dispatch_spares_supervised_forms():
+    # lambda / functools.partial / named function / bound-method forms all
+    # run under guard.supervised — the guarded_* half of the fixture is clean
+    fr = analyze_file(str(FIXTURES / "naked_dispatch_hazard.py"))
+    src = (FIXTURES / "naked_dispatch_hazard.py").read_text().splitlines()
+    ok_start = next(i for i, l in enumerate(src, 1) if "def guarded_lambda" in l)
+    assert not any(f.line >= ok_start for f in fr.findings
+                   if f.rule == "naked-dispatch")
+
+
 def test_fixture_tree_reports_all_families_and_fails():
     report = analyze_paths([str(FIXTURES)])
     fired = {f.rule for f in report.findings if not f.suppressed}
     assert {"host-sync-in-jit", "recompile-trigger",
             "dtype-drift", "carry-contract", "metric-in-jit",
-            "swallowed-exception"} <= fired
+            "swallowed-exception", "naked-dispatch"} <= fired
     assert report.active(Severity.WARNING)
     rc = run_lint([str(FIXTURES)])
     assert rc == 1

@@ -125,6 +125,49 @@ def test_fault_soak_no_state_divergence(seed):
         assert _census(sim) == baseline  # plan never fired: plain parity
 
 
+@pytest.mark.parametrize("seed", range(700, 730))
+def test_guard_containment_soak(seed):
+    """simonguard soak: a random CONTAINED fault (watchdog wedge, device OOM
+    at either stage) injected mid-run must reconverge bit-for-bit with the
+    fault-free baseline — no exception, no divergence, and the containment
+    visible on the guard's event trace whenever the plan fired."""
+    from open_simulator_tpu.resilience import FaultPlan, installed
+    from open_simulator_tpu.resilience import guard
+
+    rng = random.Random(seed)
+    nodes = [make_node(f"n{i}", cpu=f"{rng.randint(1000, 6000)}m",
+                       memory=str(rng.randint(2, 10) << 30),
+                       pods=str(rng.randint(3, 20)))
+             for i in range(rng.randint(3, 12))]
+    pods = []
+    for b in range(rng.randint(1, 3)):
+        app = f"gd{b}"
+        for _ in range(rng.randint(5, 40)):
+            pods.append(make_pod(f"{app}-{len(pods)}",
+                                 cpu=f"{rng.randint(100, 900)}m",
+                                 memory=str(rng.randint(64, 900) << 20),
+                                 labels={"app": app}))
+
+    baseline = _run(nodes, pods, True)
+
+    guard.reset_for_tests()
+    try:
+        # one fault: a single contained failure per run (a second injected
+        # wedge DURING the failover replay is a double-fault scenario the
+        # bounded-retry path handles separately)
+        plan = FaultPlan.seeded(
+            seed, n_faults=1, max_attempt=rng.randint(1, 4),
+            sites=("watchdog_wedge", "oom_dispatch", "oom_to_device"))
+        sim = Simulator(copy.deepcopy(nodes))
+        with installed(plan):
+            failed = sim.schedule_pods(copy.deepcopy(pods))
+        assert (_census(sim), len(failed)) == baseline
+        if plan.trace:
+            assert guard.events(), "containment fired but left no event trace"
+    finally:
+        guard.reset_for_tests()
+
+
 @pytest.mark.parametrize("seed", range(400, 430))
 def test_soak_epoch_wave_forced(seed, monkeypatch):
     # force the epoch wave even at low domain cardinality: the routing is a

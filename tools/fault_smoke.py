@@ -19,6 +19,14 @@ Plus a server drain smoke: start a server, park a slow request in flight,
 deliver a real SIGTERM, and assert the in-flight request completes 200 while
 requests arriving mid-drain get structured 503s.
 
+simonguard containment sites (watchdog_wedge / oom_to_device / oom_dispatch /
+journal_write) assert the CONTAINMENT criteria instead of clean failure: an
+injected fault produces (a) final placements identical to the fault-free run
+after bisection/failover/resume and (b) a replay-equal injection + guard-event
+trace across two identical runs. The journal half additionally SIGKILLs a
+capacity search mid-probe in a child process and asserts the resumed search
+reaches the same nodes_added without re-running the journaled probes.
+
 Prints one JSON line with the measured numbers.
 """
 
@@ -36,6 +44,7 @@ from open_simulator_tpu.resilience import (  # noqa: E402
     FaultPlan,
     FaultSpec,
     RetryPolicy,
+    guard,
     installed,
 )
 from open_simulator_tpu.simulator.encode import scheduling_signature  # noqa: E402
@@ -264,10 +273,166 @@ def server_drain_smoke(row):
     row["drain_ok"] = True
 
 
+# --------------------------------------------------------------- simonguard --
+
+GUARD_SITES = ("watchdog_wedge", "oom_to_device", "oom_dispatch")
+
+
+def guard_site_sweep(row):
+    """Containment criteria for the guard sites: the faulted run SUCCEEDS,
+    converges bit-for-bit with the fault-free baseline, and two identical
+    runs produce identical injection + guard-event traces."""
+    nodes, pods = synth_cluster(16, 120)
+    sim0 = Simulator(copy.deepcopy(nodes))
+    failed0 = len(sim0.schedule_pods(copy.deepcopy(pods)))
+    baseline = census(sim0)
+    for site in GUARD_SITES:
+        traces = []
+        for rep in range(2):  # replay-equality criterion
+            guard.reset_for_tests()
+            sim = Simulator(copy.deepcopy(nodes))
+            plan = FaultPlan([FaultSpec(site, 1)])
+            with installed(plan):
+                failed = sim.schedule_pods(copy.deepcopy(pods))
+            assert plan.trace, f"{site}: no injection recorded"
+            assert census(sim) == baseline, f"{site}: placements diverged"
+            assert len(failed) == failed0, f"{site}: failure count diverged"
+            assert guard.events(), f"{site}: containment left no event trace"
+            if site == "watchdog_wedge":
+                assert sim.backend_path[-1] == "cpu" and len(sim.backend_path) == 2,                     f"{site}: failover missing from backend_path"
+            traces.append((list(plan.trace), guard.events()))
+        assert traces[0] == traces[1], f"{site}: replay produced a different trace"
+    guard.reset_for_tests()
+    row["guard_sites"] = len(GUARD_SITES)
+
+
+def _journal_workload():
+    """lb-inexact fragmentation search (several probe rounds → several
+    journal records): 10 pods of 3000m on 4000m nodes, answer 8 added."""
+    def node(name):
+        return {"apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": name, "labels": {}},
+                "status": {"allocatable": {"cpu": "4000m",
+                                           "memory": str(8 << 30),
+                                           "pods": "20"}}}
+
+    def pod(name):
+        return {"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"containers": [{
+                    "name": "c", "image": "x",
+                    "resources": {"requests": {"cpu": "3000m",
+                                               "memory": str(128 << 20)}}}]}}
+
+    base = [node(f"b{i}") for i in range(2)]
+    return base, node("tmpl"), [pod(f"w{j}") for j in range(10)]
+
+
+def journal_fault_smoke(row):
+    """journal_write containment: the injected fault kills the search, the
+    journal's valid prefix resumes to the fault-free answer, and the
+    injection trace replays identically."""
+    from open_simulator_tpu.apply.applier import CapacityPlanner
+
+    base, template, pods = _journal_workload()
+    p0 = CapacityPlanner(base, template, copy.deepcopy(pods))
+    found0, n0, _ = p0.search()
+    assert found0
+
+    traces = []
+    for rep in range(2):
+        guard.reset_for_tests()
+        path = f"/tmp/fault_smoke_journal_{rep}.jsonl"
+        if os.path.exists(path):
+            os.unlink(path)
+        p1 = CapacityPlanner(base, template, copy.deepcopy(pods))
+        p1.attach_journal(path)
+        plan = FaultPlan([FaultSpec("journal_write", 2)])
+        raised = False
+        try:
+            with installed(plan):
+                p1.search()
+        except Exception:
+            raised = True
+        assert raised, "journal_write fault did not surface"
+        assert plan.trace, "no injection recorded"
+        traces.append(list(plan.trace))
+        p2 = CapacityPlanner(base, template, copy.deepcopy(pods))
+        p2.attach_journal(path)
+        found2, n2, _ = p2.search()
+        assert (found2, n2) == (found0, n0),             f"resumed search diverged: {(found2, n2)} != {(found0, n0)}"
+        assert p2.stats["journal_hits"] >= 1, "resume replayed no verdicts"
+        os.unlink(path)
+    assert traces[0] == traces[1], "journal_write trace not replay-equal"
+    guard.reset_for_tests()
+    row["journal_fault_ok"] = True
+
+
+def journal_crash_resume_smoke(row):
+    """Real-SIGKILL crash-resume: a child process runs the search with a
+    journal and SIGKILLs itself after the 2nd fsync'd verdict; the resumed
+    search reaches the same nodes_added with the completed probes replayed
+    from the journal, not re-run."""
+    import signal
+    import subprocess
+
+    from open_simulator_tpu.apply.applier import CapacityPlanner
+
+    base, template, pods = _journal_workload()
+    p0 = CapacityPlanner(base, template, copy.deepcopy(pods))
+    found0, n0, _ = p0.search()
+    fresh_dispatches = p0.stats["dispatches"]
+
+    path = "/tmp/fault_smoke_journal_kill.jsonl"
+    if os.path.exists(path):
+        os.unlink(path)
+    child = r"""
+import os, signal, sys
+sys.path.insert(0, %r)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from open_simulator_tpu.resilience import guard
+from open_simulator_tpu.apply.applier import CapacityPlanner
+import tools.fault_smoke as fs
+
+base, template, pods = fs._journal_workload()
+real = guard.SearchJournal.record
+state = {"n": 0}
+def record(self, n, ok, nf):
+    real(self, n, ok, nf)          # fsync'd BEFORE the kill
+    state["n"] += 1
+    if state["n"] >= 2:
+        os.kill(os.getpid(), signal.SIGKILL)
+guard.SearchJournal.record = record
+p = CapacityPlanner(base, template, pods)
+p.attach_journal(%r)
+p.search()
+print("UNREACHABLE")
+""" % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))), path)
+    proc = subprocess.run([sys.executable, "-c", child],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == -signal.SIGKILL,         f"child did not die by SIGKILL: rc={proc.returncode} {proc.stderr[-400:]}"
+    assert "UNREACHABLE" not in proc.stdout
+
+    p2 = CapacityPlanner(base, template, copy.deepcopy(pods))
+    p2.attach_journal(path)
+    found2, n2, _ = p2.search()
+    assert (found2, n2) == (found0, n0),         f"crash-resumed search diverged: {(found2, n2)} != {(found0, n0)}"
+    assert p2.stats["journal_hits"] >= 2,         "the SIGKILL'd probes were not replayed from the journal"
+    assert p2.stats["dispatches"] < fresh_dispatches,         "resume re-ran every probe (journal saved nothing)"
+    os.unlink(path)
+    row["journal_crash_resume"] = {"nodes_added": n2,
+                                   "replayed": p2.stats["journal_hits"],
+                                   "dispatches": p2.stats["dispatches"],
+                                   "fresh_dispatches": fresh_dispatches}
+
+
 def main() -> int:
     row = {"metric": "fault_smoke"}
     engine_site_sweep(row)
     preempt_evict_smoke(row)
+    guard_site_sweep(row)
+    journal_fault_smoke(row)
+    journal_crash_resume_smoke(row)
     live_get_smoke(row)
     server_drain_smoke(row)
     row["faults_injected_total"] = _sum("simon_faults_injected_total")

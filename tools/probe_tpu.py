@@ -36,10 +36,18 @@ from open_simulator_tpu.utils.devices import (  # noqa: E402
 
 
 def probe_once(timeout: float) -> dict:
-    """One lock-guarded subprocess probe. Never touches the backend in-process."""
+    """One lock-guarded subprocess probe. Never touches the backend in-process.
+
+    The logger's OWN probes bypass the cooldown window (its whole job is to
+    keep probing), but the outcome is persisted at the SHARED state path
+    (OPEN_SIMULATOR_PROBE_STATE, default under the XDG cache — the same
+    path every probe_default_backend caller reads) so every other run —
+    CLI, server, bench — honors the cooldown and skips straight to
+    cpu-fallback while the tunnel stays wedged."""
     if not acquire_tpu_lock(LOCK):
         return {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                 "outcome": "skipped-lock", "elapsed_s": 0.0}
+    os.environ["OPEN_SIMULATOR_PROBE_COOLDOWN_S"] = "0"
     try:
         _, rec = probe_default_backend(timeout)
         return rec
