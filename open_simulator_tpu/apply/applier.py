@@ -306,24 +306,30 @@ class CapacityPlanner:
         self.stats = {"path": "fresh", "probes": 0, "dispatches": 0,
                       "encode_s": 0.0, "encodes": 0, "journal_hits": 0}
         try:
-            out = self._search_incremental()
-        except BaseException as e:
-            # simonguard containment: a wedged backend / device OOM inside
-            # the encode-once session is not fatal to the SEARCH — the
-            # backend is quarantined (wedge) and the fresh-probe fallback
-            # re-runs on the surviving backend, journal verdicts intact
-            # (placements are backend-invariant). Anything non-containable
-            # (deadline expiry, real bugs) propagates.
-            cause = guard.containment_cause(e)
-            if cause is None:
-                raise
-            guard.count_failover(cause, "capacity_search")
-            logging.getLogger("open_simulator_tpu").warning(
-                "capacity search contained a device failure (%s); falling "
-                "back to fresh-Simulator probes", cause)
-            out = None
-        if out is None:
-            out = self._search_fresh()
+            try:
+                out = self._search_incremental()
+            except BaseException as e:
+                # simonguard containment: a wedged backend / device OOM inside
+                # the encode-once session is not fatal to the SEARCH — the
+                # backend is quarantined (wedge) and the fresh-probe fallback
+                # re-runs on the surviving backend, journal verdicts intact
+                # (placements are backend-invariant). Anything non-containable
+                # (deadline expiry, real bugs) propagates.
+                cause = guard.containment_cause(e)
+                if cause is None:
+                    raise
+                guard.count_failover(cause, "capacity_search")
+                logging.getLogger("open_simulator_tpu").warning(
+                    "capacity search contained a device failure (%s); falling "
+                    "back to fresh-Simulator probes", cause)
+                out = None
+            if out is None:
+                out = self._search_fresh()
+        finally:
+            # the journal holds an fd for crash-consistent appends during the
+            # search only; its lookups keep serving from memory after close
+            if self.journal is not None:
+                self.journal.close()
         # registry mirror of the stats dict: search accounting survives the
         # planner object, so server /metrics and CLI snapshots report it
         obs.CAPACITY_SEARCHES.labels(path=str(self.stats.get("path"))).inc()

@@ -9,7 +9,10 @@ accelerator or a device OOM degrades the run instead of killing it:
   (kernel dispatch, result fetch, probe fan-out round) runs in a worker
   thread under a deadline scaled by batch size and tightened by the
   contextvar `Deadline` (resilience/policy.py). On expiry the backend is
-  classified *wedged*, quarantined for the rest of the process, and
+  classified *wedged*, quarantined for the process (a REAL expiry — never an
+  injected one — may later clear its name through one bounded subprocess
+  re-probe per `OPEN_SIMULATOR_QUARANTINE_REPROBE_S` window, so a slow
+  compile outlier doesn't degrade the process forever), and
   `BackendWedged` is raised — which the engine's failover loop catches. The
   blocked worker thread is a daemon and is abandoned (a dispatch stuck in a
   driver ioctl cannot be interrupted from Python); the quarantine is exactly
@@ -39,10 +42,12 @@ silently.
 
 from __future__ import annotations
 
+import contextlib
 import contextvars
 import json
 import os
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple, TypeVar
 
 from ..obs import instruments as obs
@@ -153,17 +158,100 @@ def events() -> List[Tuple]:
 # ------------------------------------------------------------- quarantine -----
 
 _QUARANTINED: Dict[str, str] = {}  # backend platform -> cause
+# real (watchdog-observed, non-injected) wedges only: when the entry was
+# created and when the next bounded re-probe may run. Injected wedges carry
+# no meta and never re-probe — fault-smoke determinism.
+_QUARANTINE_META: Dict[str, dict] = {}
+# backends whose quarantine was lifted by a re-probe once already: a SECOND
+# real wedge proves the subprocess probe cannot see this process's wedged
+# state (the abandoned worker thread holds in-process locks a fresh python
+# never touches), so the re-quarantine is permanent — the lift/burn cycle is
+# bounded at one, not one per window.
+_LIFTED: set = set()
 
 
-def quarantine(backend: str, cause: str) -> None:
+def quarantine_reprobe_s() -> float:
+    """Seconds after which a REAL (non-injected) wedge quarantine becomes
+    eligible for one bounded subprocess re-probe per window
+    (OPEN_SIMULATOR_QUARANTINE_REPROBE_S; 0 makes quarantines permanent).
+    A slow-but-healthy outlier — a cold XLA compile past the watchdog
+    budget — must not pin every later Simulator in the process to the CPU
+    fallback forever; a probe that finds the backend responsive lifts the
+    quarantine."""
+    return _env_float("OPEN_SIMULATOR_QUARANTINE_REPROBE_S", 600.0)
+
+
+def quarantine(backend: str, cause: str, *, reprobe: bool = False) -> None:
+    """Quarantine `backend`. `reprobe=True` (real watchdog expiries only —
+    never injected faults) marks the entry eligible for the bounded
+    re-probe/expiry path in `default_quarantined`, unless a previous lift
+    already failed to stick (see _LIFTED)."""
     with _STATE_LOCK:
-        _QUARANTINED.setdefault(backend, cause)
+        if backend not in _QUARANTINED:
+            _QUARANTINED[backend] = cause
+            if reprobe and backend not in _LIFTED:
+                # monotonic like policy.py's Deadline: the window is an
+                # interval, and a wall-clock step must not stretch or
+                # collapse it
+                _QUARANTINE_META[backend] = {"ts": time.monotonic(),
+                                             "next_probe": 0.0}
     obs.GUARD_QUARANTINED.labels(backend=backend).set(1)
 
 
 def quarantined() -> Dict[str, str]:
     with _STATE_LOCK:
         return dict(_QUARANTINED)
+
+
+def _unquarantine(backend: str, why: str) -> None:
+    with _STATE_LOCK:
+        _QUARANTINED.pop(backend, None)
+        _QUARANTINE_META.pop(backend, None)
+        _LIFTED.add(backend)  # a second real wedge is permanent
+    obs.GUARD_QUARANTINED.labels(backend=backend).set(0)
+    record_event("unquarantine", backend, why)
+    import logging
+
+    logging.getLogger("open_simulator_tpu").warning(
+        "backend %r responded to a re-probe; lifting its quarantine (%s)",
+        backend, why)
+
+
+def _maybe_lift_quarantine(backend: str) -> None:
+    """Bounded re-probe of a REAL wedge quarantine: once per
+    quarantine_reprobe_s window, run the existing subprocess probe
+    (utils/devices.probe_default_backend — deadline-bounded, never
+    in-process) in a BACKGROUND daemon thread — default_quarantined() sits
+    on hot dispatch paths and under callers' Deadline budgets, so the
+    state check itself must never block on a 60s probe. A responsive
+    backend is un-quarantined (for later calls) so one compile outlier
+    doesn't degrade the whole process permanently; a lift that fails to
+    stick makes the re-quarantine permanent (_LIFTED). Injected
+    quarantines (no meta) and the window==0 config never re-probe."""
+    window = quarantine_reprobe_s()
+    if window <= 0:
+        return
+    now = time.monotonic()
+    with _STATE_LOCK:
+        meta = _QUARANTINE_META.get(backend)
+        if meta is None or now - meta["ts"] < window or now < meta["next_probe"]:
+            return
+        # claim this window before dropping the lock: concurrent callers
+        # must not stack subprocess probes
+        meta["next_probe"] = now + window
+    threading.Thread(target=_reprobe_and_lift, args=(backend,),
+                     name="simon-guard-reprobe", daemon=True).start()
+
+
+def _reprobe_and_lift(backend: str) -> None:
+    from ..utils.devices import probe_default_backend
+
+    try:
+        ok, _rec = probe_default_backend()
+    except Exception:  # a failed probe just leaves the quarantine standing
+        return
+    if ok:
+        _unquarantine(backend, "reprobe_ok")
 
 
 def current_backend() -> str:
@@ -178,29 +266,78 @@ def current_backend() -> str:
 def default_quarantined() -> bool:
     """True when the process's default backend is quarantined (device work
     must route to the CPU fallback). Never touches jax when nothing is
-    quarantined — the common case stays import-free."""
+    quarantined — the common case stays import-free. A real-wedge entry past
+    its re-probe window kicks off one bounded BACKGROUND subprocess probe
+    here (this call never blocks on it); a responsive backend is
+    un-quarantined for subsequent calls."""
     with _STATE_LOCK:
         if not _QUARANTINED:
             return False
         q = dict(_QUARANTINED)
-    return current_backend() in q
+    b = current_backend()
+    if b not in q:
+        return False
+    _maybe_lift_quarantine(b)
+    with _STATE_LOCK:
+        return b in _QUARANTINED
 
 
-def fallback_scope():
-    """Context manager placing all JAX work inside it on the CPU fallback
-    device (the degraded-mode execution target after a wedge/OOM)."""
+# Carried INTO supervised worker threads via contextvars.copy_context():
+# jax.default_device is thread-scoped, so the scope entered on the caller
+# thread does not reach the worker — the flag does, and the worker re-enters
+# the scope itself (see _call_in_scope).
+_FALLBACK_SCOPE = contextvars.ContextVar("simon_guard_fallback", default=False)
+
+
+def _cpu_device():
     import jax
 
-    return jax.default_device(jax.local_devices(backend="cpu")[0])
+    return jax.local_devices(backend="cpu")[0]
+
+
+@contextlib.contextmanager
+def fallback_scope():
+    """Context manager placing all JAX work inside it on the CPU fallback
+    device (the degraded-mode execution target after a wedge/OOM).
+
+    Enters jax.default_device on the CALLING thread and raises a contextvar
+    flag: JAX device/config scopes are thread-local and copy_context() does
+    not carry them, so `supervised` re-establishes the scope inside its
+    worker thread whenever the flag is set — otherwise a post-failover
+    dispatch with uncommitted inputs would still target the quarantined
+    backend and burn another watchdog timeout per attempt."""
+    import jax
+
+    token = _FALLBACK_SCOPE.set(True)
+    try:
+        with jax.default_device(_cpu_device()):
+            yield
+    finally:
+        _FALLBACK_SCOPE.reset(token)
+
+
+def _call_in_scope(fn: Callable[[], T]) -> T:
+    """Run `fn`, re-entering the CPU fallback device scope in the CURRENT
+    thread when the caller held fallback_scope() (the contextvar flag is
+    copied into supervised workers; the thread-local jax scope is not)."""
+    if not _FALLBACK_SCOPE.get():
+        return fn()
+    import jax
+
+    with jax.default_device(_cpu_device()):
+        return fn()
 
 
 def reset_for_tests() -> None:
     """Clear process-global guard state (quarantine + events). Tests and the
-    fault-smoke CI only — production never un-quarantines a backend."""
+    fault-smoke CI only — production only un-quarantines through the bounded
+    re-probe path (_maybe_lift_quarantine)."""
     with _STATE_LOCK:
         for b in _QUARANTINED:
             obs.GUARD_QUARANTINED.labels(backend=b).set(0)
         _QUARANTINED.clear()
+        _QUARANTINE_META.clear()
+        _LIFTED.clear()
         del _EVENTS[:]
 
 
@@ -215,6 +352,7 @@ def state() -> dict:
             "per_pod_s": _env_float("OPEN_SIMULATOR_WATCHDOG_PER_POD_S", 0.005),
         },
         "oom_bisect_floor": oom_bisect_floor(),
+        "quarantine_reprobe_s": quarantine_reprobe_s(),
         "events": [list(e) for e in events()[-64:]],
     }
 
@@ -250,7 +388,10 @@ def supervised(fn: Callable[[], T], *, site: str, pods: int = 0) -> T:
 
     def worker() -> None:
         try:
-            box["result"] = ctx.run(fn)
+            # _call_in_scope: the copied context carries the fallback FLAG,
+            # not the thread-local jax device scope — re-enter it here so a
+            # failed-over dispatch actually lands on the CPU fallback
+            box["result"] = ctx.run(_call_in_scope, fn)
         # simonlint: ignore[swallowed-exception] -- not swallowed: the boxed
         # error re-raises in the supervising caller the moment done is set
         except BaseException as we:  # noqa: BLE001
@@ -271,7 +412,10 @@ def supervised(fn: Callable[[], T], *, site: str, pods: int = 0) -> T:
 
 def _declare_wedged(site: str, injected: bool) -> BackendWedged:
     backend = current_backend()
-    quarantine(backend, f"{CAUSE_WEDGE}@{site}")
+    # only a REAL watchdog expiry earns the re-probe/expiry path: a slow-but-
+    # healthy outlier can clear its name, while injected wedges stay pinned
+    # for deterministic tests and the fault-smoke CI
+    quarantine(backend, f"{CAUSE_WEDGE}@{site}", reprobe=not injected)
     obs.GUARD_WATCHDOG_EXPIRIES.labels(site=site).inc()
     record_event("wedge", site, backend)
     return BackendWedged(site, backend, injected=injected)
@@ -345,12 +489,34 @@ class SearchJournal:
     @classmethod
     def open(cls, path: str, digest: str) -> "SearchJournal":
         self = cls(path, digest)
+        raw = b""
         if os.path.exists(path) and os.path.getsize(path) > 0:
             with open(path, "rb") as f:
                 raw = f.read()
-            lines = raw.decode("utf-8", "replace").splitlines(keepends=True)
+        if raw:
+            # All offsets below are BYTE offsets into the raw file — a torn
+            # tail can hold invalid utf-8, and a replace-decoded round trip
+            # (U+FFFD is 3 bytes where the bad byte was 1) would make a
+            # char-counted truncate land in the wrong place.
+            nl = raw.find(b"\n")
+            if nl < 0:
+                # Unterminated first line. Rewrite ONLY when it is a byte-
+                # prefix of the exact header THIS search would write — i.e.
+                # our own crash torn mid-header-write, after which no verdict
+                # can exist. Any other newline-less file (a typo'd
+                # --resume-journal path at someone's digest/VERSION file, a
+                # different search's torn header) is refused untouched.
+                expected = (json.dumps(
+                    {"kind": cls.KIND, "v": cls.VERSION, "digest": digest},
+                    sort_keys=True) + "\n").encode()
+                if expected.startswith(raw):
+                    self._start_fresh(path, digest)
+                    return self
+                raise JournalMismatch(
+                    f"{path} is not a capacity-search journal "
+                    f"(unparsable header)")
             try:
-                head = json.loads(lines[0])
+                head = json.loads(raw[:nl])
             except ValueError:
                 raise JournalMismatch(
                     f"{path} is not a capacity-search journal "
@@ -364,14 +530,15 @@ class SearchJournal:
                     f"(journal digest {head.get('digest')!r} != current "
                     f"{digest!r}); refusing to resume — delete it or point "
                     f"--resume-journal elsewhere")
-            valid_chars = len(lines[0])
-            for ln in lines[1:]:
-                # a record the crash left unterminated doesn't count as
-                # durable even if it happens to parse: neither served from
-                # memory nor kept on disk (the truncation below drops it)
-                if not ln.endswith("\n"):
+            valid_bytes = pos = nl + 1
+            while True:
+                nl = raw.find(b"\n", pos)
+                if nl < 0:
+                    # a record the crash left unterminated doesn't count as
+                    # durable even if it happens to parse: neither served
+                    # from memory nor kept on disk (the truncation drops it)
                     break
-                body = ln.strip()
+                body = raw[pos:nl].strip()
                 try:
                     if body:
                         rec = json.loads(body)
@@ -379,19 +546,21 @@ class SearchJournal:
                             bool(rec["ok"]), int(rec["n_failed"]))
                 except (ValueError, KeyError, TypeError):
                     break  # torn tail from a crash: the valid prefix ends here
-                valid_chars += len(ln)
+                valid_bytes = pos = nl + 1
             self._f = open(path, "a")
-            if valid_chars < len(raw.decode("utf-8", "replace")):
+            if valid_bytes < len(raw):
                 # repair: drop the torn tail so the next append starts a
                 # fresh line instead of extending the garbage
-                self._f.truncate(len(
-                    "".join(lines)[:valid_chars].encode("utf-8")))
+                self._f.truncate(valid_bytes)
                 self._f.flush()
                 os.fsync(self._f.fileno())
         else:
-            self._f = open(path, "w")
-            self._append({"kind": cls.KIND, "v": cls.VERSION, "digest": digest})
+            self._start_fresh(path, digest)
         return self
+
+    def _start_fresh(self, path: str, digest: str) -> None:
+        self._f = open(path, "w")
+        self._append({"kind": self.KIND, "v": self.VERSION, "digest": digest})
 
     def _append(self, doc: dict) -> None:
         self._f.write(json.dumps(doc, sort_keys=True) + "\n")
@@ -407,6 +576,11 @@ class SearchJournal:
 
     def record(self, n: int, ok: bool, n_failed: int) -> None:
         faults.maybe_fail("journal_write")
+        if self._f is None:
+            # the planner closes the fd when a search finishes; a REUSED
+            # planner's next search appends to the (cleanly closed, fully
+            # valid) file rather than crashing on the closed handle
+            self._f = open(self.path, "a")
         self._append({"n": int(n), "ok": bool(ok), "n_failed": int(n_failed)})
         self.verdicts[int(n)] = (bool(ok), int(n_failed))
         obs.JOURNAL_RECORDS.inc()

@@ -1098,8 +1098,18 @@ class Simulator:
 
     def _resolve_mesh(self):
         """Decide (once) whether to shard: use_mesh True/False forces it; None
-        autodetects >1 visible device, overridable via OPEN_SIMULATOR_MESH."""
+        autodetects >1 visible device, overridable via OPEN_SIMULATOR_MESH.
+        Quarantine is re-checked on EVERY access, not just the first: a mesh
+        cached before ANOTHER simulator quarantined the backend carries
+        explicit shardings that override jax.default_device, and keeping it
+        would burn a watchdog timeout re-dispatching on the wedged backend."""
         if self._mesh is not _UNSET:
+            if self._mesh is not None and (
+                    self._fallback or guard.default_quarantined()):
+                self._mesh = None
+                # tables/carry placed through the old mesh live on the wedged
+                # backend; drop them so nothing re-dispatches against them
+                self._last_tables = self._last_carry = None
             return self._mesh
         if self._fallback or guard.default_quarantined():
             # degraded mode is single-device CPU: a mesh over the default

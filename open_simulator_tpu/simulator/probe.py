@@ -128,6 +128,12 @@ class ProbeSession:
 
         if new_node is None:
             return None
+        if guard.default_quarantined():
+            # the session uploads device-resident tables to the DEFAULT
+            # backend (no fallback routing on this path): with it
+            # quarantined, decline so the search runs fresh probes, which
+            # the engine routes to the CPU fallback
+            return None
         t0 = time.perf_counter()
         n_base = len(base_nodes)
         # Size the template axis to the engine's node-padding bucket: the
@@ -304,11 +310,23 @@ class ProbeSession:
 
     # ---------------------------------------------------------- extension -----
 
+    def _check_backend(self) -> None:
+        """A backend quarantined AFTER this session uploaded its tables must
+        not be touched again: device-resident arrays (and any mesh
+        shardings) are committed to it and override jax.default_device.
+        Raise the containable wedge classification so the capacity search
+        falls back to fresh probes — which the engine routes to the CPU
+        fallback — WITHOUT burning a watchdog timeout re-dispatching here."""
+        if self._segs and guard.default_quarantined():
+            raise guard.BackendWedged("dispatch", guard.current_backend(),
+                                      injected=False)
+
     def ensure_capacity(self, n: int) -> None:
         """Grow the template axis to cover candidate n via the node-axis
         extension path (append pre-encoded template columns; no re-encode)."""
         if n <= self.n_new:
             return
+        self._check_backend()  # _upload below transfers to the session backend
         target = bucket_capped(self.n_base + n, 1024)
         k = target - (self.n_base + self.n_new)
         if self._bt_raw is not None:
@@ -370,6 +388,7 @@ class ProbeSession:
         if not self._segs:  # no unbound pods: pure host arithmetic
             return {n: (self.bound_scheduled, self.total_known,
                         self._utilization(n, None)) for n in order}
+        self._check_backend()  # never re-dispatch on a now-quarantined backend
 
         # Lanes cost near-linearly, so a lone lower-bound probe (the common
         # exact-arithmetic case) must not pay for fanout-1 padded copies —

@@ -176,6 +176,65 @@ def test_supervised_real_timeout_declares_wedge(monkeypatch):
     assert "cpu" in guard.quarantined()
 
 
+def test_real_wedge_quarantine_lifts_after_successful_reprobe(monkeypatch):
+    """A REAL watchdog expiry (e.g. one slow compile outlier) must not pin
+    the process to CPU forever: past the re-probe window, one bounded
+    BACKGROUND subprocess probe that finds the backend responsive lifts the
+    quarantine — and a lift that fails to stick (a second real wedge) makes
+    the re-quarantine permanent, bounding the lift/burn cycle at one."""
+    import open_simulator_tpu.utils.devices as devices
+
+    monkeypatch.setenv("OPEN_SIMULATOR_WATCHDOG_BASE_S", "0.2")
+    monkeypatch.setenv("OPEN_SIMULATOR_WATCHDOG_PER_POD_S", "0")
+    monkeypatch.setenv("OPEN_SIMULATOR_QUARANTINE_REPROBE_S", "0.01")
+    probes = []
+    monkeypatch.setattr(devices, "probe_default_backend",
+                        lambda *a, **k: (probes.append(1) or True,
+                                         {"outcome": "ok"}))
+    with pytest.raises(guard.BackendWedged):
+        guard.supervised(lambda: time.sleep(3), site="dispatch", pods=0)
+    assert "cpu" in guard.quarantined()
+    time.sleep(0.05)  # past the re-probe window
+    guard.default_quarantined()  # kicks off the async re-probe; never blocks
+    deadline = time.monotonic() + 5.0
+    while guard.quarantined() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert guard.quarantined() == {}, "responsive backend must be lifted"
+    assert probes, "the lift must come from an actual re-probe"
+    assert any(e[0] == "unquarantine" for e in guard.events())
+
+    # the lift did not stick: a SECOND real wedge re-quarantines PERMANENTLY
+    # (the subprocess probe demonstrably cannot see this process's state)
+    with pytest.raises(guard.BackendWedged):
+        guard.supervised(lambda: time.sleep(3), site="dispatch", pods=0)
+    assert "cpu" in guard.quarantined()
+    n_probes = len(probes)
+    time.sleep(0.05)
+    assert guard.default_quarantined()
+    time.sleep(0.05)
+    assert guard.default_quarantined(), "re-quarantine must be permanent"
+    assert len(probes) == n_probes, "a permanent quarantine never re-probes"
+
+
+def test_injected_wedge_quarantine_never_reprobes(monkeypatch):
+    """Injected wedges stay deterministically quarantined — the fault-smoke
+    replay-equality criterion must never depend on a live probe."""
+    import open_simulator_tpu.utils.devices as devices
+
+    monkeypatch.setenv("OPEN_SIMULATOR_QUARANTINE_REPROBE_S", "0.01")
+
+    def _no_probe(*a, **k):
+        raise AssertionError("injected quarantine must not probe")
+
+    monkeypatch.setattr(devices, "probe_default_backend", _no_probe)
+    with installed(FaultPlan([FaultSpec("watchdog_wedge", 1)])):
+        with pytest.raises(guard.BackendWedged):
+            guard.supervised(lambda: None, site="dispatch", pods=0)
+    time.sleep(0.05)
+    assert guard.default_quarantined()
+    assert guard.quarantined()
+
+
 def test_supervised_prefers_deadline_over_wedge(monkeypatch):
     """When the CALLER's Deadline expires during the wait, that is a budget
     expiry, not a device wedge: no quarantine."""
@@ -224,6 +283,8 @@ def test_journal_resume_skips_completed_probes(tmp_path):
     found1, n1, _ = p1.search()
     assert found1 and p1.stats["dispatches"] > 0
 
+    assert p1.journal._f is None, "search must close the journal fd"
+
     p2 = CapacityPlanner(base, template, copy.deepcopy(pods))
     p2.attach_journal(path)
     found2, n2, _ = p2.search()
@@ -236,6 +297,22 @@ def test_journal_resume_skips_completed_probes(tmp_path):
     p3 = CapacityPlanner(base, template, copy.deepcopy(pods))
     found3, n3, _ = p3.search()
     assert (found3, n3) == (found1, n1)
+
+
+def test_reused_planner_keeps_journaling_after_close(tmp_path):
+    """search() closes the journal fd when it finishes; a REUSED planner's
+    next search must keep journaling (append to the valid file), not crash
+    on the closed handle."""
+    path = str(tmp_path / "search.jsonl")
+    base, template, pods = _planner_inputs()
+    p = CapacityPlanner(base, template, copy.deepcopy(pods))
+    p.attach_journal(path)
+    found1, n1, _ = p.search()
+    found2, n2, _ = p.search()  # second search on the SAME planner
+    assert (found2, n2) == (found1, n1)
+    # and a journal record on the reused planner hits disk, fsync'd
+    p.journal.record(999, True, 0)
+    assert SearchJournal.open(path, p.options_digest()).lookup(999) == (True, 0)
 
 
 def test_journal_digest_mismatch_rejected(tmp_path):
@@ -299,6 +376,105 @@ def test_journal_ignores_torn_tail(tmp_path):
     j2.record(9, True, 0)  # and stays appendable
     j2.close()
     assert SearchJournal.open(path, "sha256:x").lookup(9) == (True, 0)
+
+
+def test_journal_torn_tail_with_invalid_utf8_truncates_byte_exact(tmp_path):
+    """A SIGKILL can tear a write at any byte, leaving invalid utf-8 in the
+    tail; the repair must truncate at the BYTE offset of the valid prefix
+    (a replace-decoded round trip would widen each bad byte to a 3-byte
+    U+FFFD and overshoot)."""
+    path = str(tmp_path / "j.jsonl")
+    j = SearchJournal.open(path, "sha256:x")
+    j.record(3, False, 2)
+    j.close()
+    import os as _os
+
+    good = _os.path.getsize(path)
+    with open(path, "ab") as f:
+        f.write(b'{"n": 9, "ok\xff\xfe\xfd')  # torn mid-write, non-utf8 bytes
+    j2 = SearchJournal.open(path, "sha256:x")
+    assert j2.lookup(3) == (False, 2)
+    assert j2.lookup(9) is None
+    assert _os.path.getsize(path) == good, "repair must cut exactly the tail"
+    j2.record(9, True, 0)
+    j2.close()
+    with open(path, "rb") as f:
+        for line in f.read().splitlines():  # no garbage survived the repair
+            json.loads(line)
+    assert SearchJournal.open(path, "sha256:x").lookup(9) == (True, 0)
+
+
+def test_journal_torn_header_treated_as_empty(tmp_path):
+    """A crash mid-HEADER-write leaves an unterminated PREFIX of the header
+    this search would write: no verdict can follow it, so the journal is
+    empty — resume rewrites it instead of failing with JournalMismatch and
+    demanding manual deletion."""
+    path = str(tmp_path / "j.jsonl")
+    full_header = json.dumps(
+        {"kind": SearchJournal.KIND, "v": SearchJournal.VERSION,
+         "digest": "sha256:x"}, sort_keys=True)
+    with open(path, "w") as f:
+        f.write(full_header[:-7])  # SIGKILL mid-header (no newline)
+    j = SearchJournal.open(path, "sha256:x")
+    assert j.verdicts == {}
+    j.record(1, True, 0)
+    j.close()
+    assert SearchJournal.open(path, "sha256:x").lookup(1) == (True, 0)
+    # a TERMINATED non-journal first line is a different file, not a torn
+    # header: still rejected
+    with open(path, "w") as f:
+        f.write("not a journal at all\n")
+    with pytest.raises(JournalMismatch):
+        SearchJournal.open(path, "sha256:x")
+    # an UNTERMINATED line that is NOT a prefix of this search's header is
+    # someone else's file (typo'd --resume-journal path, another search's
+    # torn header): rejected UNTOUCHED, never clobbered
+    with open(path, "w") as f:
+        f.write("v1.2.3-some-users-version-file")  # no trailing newline
+    with pytest.raises(JournalMismatch):
+        SearchJournal.open(path, "sha256:x")
+    with open(path) as f:
+        assert f.read() == "v1.2.3-some-users-version-file", \
+            "a rejected file must not be modified"
+    # ...including a torn header from a DIFFERENT search's digest
+    other = json.dumps(
+        {"kind": SearchJournal.KIND, "v": SearchJournal.VERSION,
+         "digest": "sha256:OTHER"}, sort_keys=True)
+    with open(path, "w") as f:
+        f.write(other[:-7])
+    with pytest.raises(JournalMismatch):
+        SearchJournal.open(path, "sha256:x")
+
+
+def test_probe_session_build_declines_on_quarantined_backend():
+    """A session built after quarantine would upload device tables to the
+    wedged backend (this path has no fallback routing): try_build must
+    decline so the search runs fresh, CPU-routed probes."""
+    from open_simulator_tpu.simulator.probe import ProbeSession
+
+    base, template, pods = _planner_inputs()
+    guard.quarantine("cpu", "watchdog_wedge@dispatch")
+    assert ProbeSession.try_build(base, template, copy.deepcopy(pods)) is None
+    p = CapacityPlanner(base, template, copy.deepcopy(pods))
+    found, n, _ = p.search()
+    assert found and p.stats["path"] == "fresh"
+
+
+def test_probe_session_refuses_dispatch_after_midlife_quarantine():
+    """A session whose tables were uploaded BEFORE another simulator
+    quarantined the backend must not re-dispatch on it (committed arrays
+    override jax.default_device): the containable wedge classification
+    surfaces immediately instead of burning a watchdog timeout."""
+    from open_simulator_tpu.simulator.probe import ProbeSession
+
+    base, template, pods = _planner_inputs()
+    session = ProbeSession.try_build(base, template, copy.deepcopy(pods))
+    assert session is not None
+    guard.quarantine("cpu", "watchdog_wedge@dispatch")
+    with pytest.raises(guard.BackendWedged):
+        session.probe_many([1])
+    with pytest.raises(guard.BackendWedged):
+        session.ensure_capacity(session.n_new + 1)
 
 
 def test_search_contains_wedge_by_falling_back_to_fresh_probes():
