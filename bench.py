@@ -270,14 +270,64 @@ def _row_gpushare():
     }
 
 
+def _hard_segment_breakdown(n_nodes=5_000, n_pods=50_000):
+    """Per-segment-kind pod counts and wall share for the hard-predicate
+    workload, from ONE extra instrumented run (OPEN_SIMULATOR_SEGMENT_TIMING
+    blocks on every segment, so it never taints the timed rows). Registry
+    deltas isolate this run from the timed repeats in the same process."""
+    import re
+
+    from open_simulator_tpu.obs import REGISTRY
+    from open_simulator_tpu.utils.synth import synth_cluster
+
+    def seg_values():
+        out = {}
+        pat = re.compile(
+            r"^simon_segment_(pods_total|wall_seconds_total)\{kind=\"(\w+)\"\}$")
+        for key, val in REGISTRY.values().items():
+            mt = pat.match(key)
+            if mt:
+                out[(mt.group(2), mt.group(1))] = float(val)
+        return out
+
+    before = seg_values()
+    os.environ["OPEN_SIMULATOR_SEGMENT_TIMING"] = "1"
+    try:
+        nodes, pods = synth_cluster(n_nodes, n_pods, hard_predicates=True)
+        _schedule_run(nodes, pods)
+    finally:
+        os.environ.pop("OPEN_SIMULATOR_SEGMENT_TIMING", None)
+    after = seg_values()
+    kinds = sorted({k for k, _ in after})
+    wall = {k: after.get((k, "wall_seconds_total"), 0.0)
+            - before.get((k, "wall_seconds_total"), 0.0) for k in kinds}
+    total_wall = sum(wall.values()) or 1.0
+    return {
+        k: {
+            "pods": int(after.get((k, "pods_total"), 0.0)
+                        - before.get((k, "pods_total"), 0.0)),
+            "wall_s": round(wall[k], 3),
+            "wall_share": round(wall[k] / total_wall, 4),
+        }
+        for k in kinds
+    }
+
+
 def _row_hard():
     rate, placed, total, dt = bench_throughput(5_000, 50_000, hard=True)
-    return {
+    row = {
         "metric": "hard_predicate_pods_per_sec_50k_pods_5k_nodes",
         "value": round(rate, 1), "unit": "pods/s",
         "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4),
         "wall_s": round(dt, 3), "scheduled": placed, "total": total,
     }
+    # attribution ride-along: which segment kind owns this row's wall time,
+    # so a future regression is explainable without a profile run
+    try:
+        row["segments"] = _hard_segment_breakdown()
+    except Exception as e:  # the breakdown must never fail the metric
+        row["segments_error"] = f"{type(e).__name__}: {e}"
+    return row
 
 
 def _row_agreement():
@@ -336,7 +386,16 @@ METRICS = [
 
 
 def _run_worker(name: str) -> None:
-    """Subprocess entry: select platform, run one metric, print its row."""
+    """Subprocess entry: select platform, run one metric, print its row.
+
+    The row must be the ONLY thing on fd 1: XLA/absl can log C++-side chatter
+    (e.g. the cpu_aot_loader machine-feature warning) straight to the stdout
+    fd, which breaks the orchestrator's row parsing. Dup the real stdout
+    aside, point fd 1 at stderr for the whole run, and write the row through
+    the saved fd at the end."""
+    real_stdout = os.dup(1)
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         # config route, not env var: the injected accelerator plugin can hang
         # at import when JAX_PLATFORMS is set (see utils/devices.py)
@@ -356,7 +415,7 @@ def _run_worker(name: str) -> None:
         row["obs_metrics"] = REGISTRY.values()
     except Exception:
         pass  # observability must never fail the bench
-    print(json.dumps(row), flush=True)
+    os.write(real_stdout, (json.dumps(row) + "\n").encode())
 
 
 # --------------------------------------------------------------------------
@@ -408,10 +467,17 @@ def _run_metric(name: str, timeout: float, force_cpu: bool) -> dict | None:
         return None
     if child.returncode != 0:
         return None
-    try:
-        return json.loads(out.strip().splitlines()[-1])
-    except (IndexError, ValueError):
-        return None
+    # the worker writes its row as the final fd-1 line, but scan backwards
+    # for the last parseable JSON object anyway — belt and braces against
+    # C++-side chatter that ignores the worker's fd redirection
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
 
 
 def main() -> None:
@@ -419,6 +485,8 @@ def main() -> None:
 
     probe_log: list = []
     results: list = []
+    headline: dict = {"metric": "pods_scheduled_per_sec_100k_pods_10k_nodes",
+                      "error": "north_star did not run"}
     # hold the chip lock so tools/probe_tpu.py skips its attempts while the
     # bench may be running device work (two concurrent clients can wedge it).
     # A prober may be mid-probe (up to ~120s): wait it out, then proceed
@@ -457,15 +525,19 @@ def main() -> None:
             else:
                 row["backend"] = "default" if use_device else "cpu-fallback"
             results.append(row)
-            out = sys.stdout if name == "north_star" else sys.stderr
-            headline = {k: row[k] for k in
-                        ("metric", "value", "unit", "vs_baseline", "backend")
-                        if k in row}
-            print(json.dumps(headline if name == "north_star" else row),
-                  file=out, flush=True)
+            if name == "north_star":
+                headline = {k: row[k] for k in
+                            ("metric", "value", "unit", "vs_baseline",
+                             "backend") if k in row}
+            print(json.dumps(row), file=sys.stderr, flush=True)
     finally:
         if lock_owned:
             release_tpu_lock(LOCK)
+        # THE one stdout line, printed last: `python bench.py` piped through
+        # tail/last-line parsing must always see the headline JSON, never
+        # XLA/absl chatter (which all routes to stderr). Printed BEFORE the
+        # detail-file write so an unwritable REPO cannot break the contract.
+        print(json.dumps(headline), flush=True)
         with open(os.path.join(REPO, "BENCH_DETAIL.json"), "w") as f:
             json.dump({"results": results, "probe_log": probe_log}, f, indent=1)
 

@@ -500,9 +500,10 @@ def rule_swallowed_exception(ctx: ModuleContext) -> List[Finding]:
 # results) can block forever on a wedged backend. Every call site in hot-path
 # code must run under guard.supervised so the watchdog can contain it.
 _DISPATCH_KERNELS = {
-    "schedule_batch", "schedule_wave", "schedule_spread_wave",
+    "schedule_batch", "schedule_wave", "schedule_affinity_wave",
     "schedule_group_serial", "probe_serial_fanout",
-    "probe_group_serial_fanout", "probe_wave_fanout", "feasibility_jit",
+    "probe_group_serial_fanout", "probe_wave_fanout",
+    "probe_affinity_wave_fanout", "feasibility_jit",
 }
 
 

@@ -98,6 +98,8 @@ class Tables(NamedTuple):
     grp_unknown: jax.Array
     grp_ports: jax.Array
     counter_dom: jax.Array
+    counter_topo: jax.Array  # [T] i32: unique-topology row id per counter
+    topo_dom: jax.Array      # [U, N] i32: node→domain per unique topology key
     counter_sel_match_g: jax.Array
     req_aff_t: jax.Array
     grp_aff_self: jax.Array
@@ -114,6 +116,7 @@ class Tables(NamedTuple):
     ss_t: jax.Array
     ss_skip: jax.Array
     carr_dom: jax.Array
+    carr_topo: jax.Array    # [Tc] i32: unique-topology row id per carrier
     carr_anti_t: jax.Array  # [G, Ca] i32: anti-use carrier ids matching g (-1 pad)
     carr_w_t: jax.Array     # [G, Cw] i32: carrier ids with interpod weight for g
     carr_w_w: jax.Array     # [G, Cw] f32: those weights (hard=1 / signed pref)
@@ -373,7 +376,8 @@ def storage_alloc(tb: Tables, cry: Carry, g):
 def feasibility(
     tb: Tables, cry: Carry, g, forced, valid,
     enable_gpu: bool = True, enable_storage: bool = True,
-    include_dns: bool = True, filters: FilterFlags = DEFAULT_FILTERS,
+    include_dns: bool = True, include_interpod: bool = True,
+    filters: FilterFlags = DEFAULT_FILTERS,
 ) -> Tuple[jax.Array, dict]:
     """[N] feasibility mask for one pod, plus named per-stage masks for diagnostics.
 
@@ -381,9 +385,12 @@ def feasibility(
     demands the whole plugin subgraph is excluded at trace time (the inert tensor
     math would otherwise cost ~35% of each scan step). `include_dns=False` (also
     static) drops the PodTopologySpread DoNotSchedule filter — used by the live-
-    spread wave path, which re-evaluates that filter against its own running
-    counters each wave iteration (schedule_group_serial). `filters` (static)
-    carries --default-scheduler-config per-plugin disables."""
+    spread wave paths, which re-evaluate that filter against their own running
+    counters each wave iteration (schedule_group_serial). `include_interpod=False`
+    (static) likewise drops the InterPodAffinity filters — schedule_affinity_wave
+    re-evaluates affinity/anti-affinity gates per epoch from its live counter
+    rows. `filters` (static) carries --default-scheduler-config per-plugin
+    disables."""
     N = tb.alloc.shape[0]
     D = cry.counter.shape[1] - 1
 
@@ -413,7 +420,7 @@ def feasibility(
     # serial step paying T×N gathers for a handful of rows was the dominant
     # cost on service-heavy workloads.
     # InterPodAffinity: required affinity (filtering.go satisfyPodAffinity)
-    if filters.interpod:
+    if include_interpod and filters.interpod:
         aff_ids = tb.req_aff_t[g]
         avalid = aff_ids >= 0
         aids = jnp.maximum(aff_ids, 0)
@@ -696,7 +703,8 @@ def _step(tb: Tables, cry: Carry, xs, n_zones: int, enable_gpu: bool, enable_sto
 # Module-level jit so repeated diagnostic calls hit the compile cache.
 feasibility_jit = jax.jit(
     feasibility,
-    static_argnames=("enable_gpu", "enable_storage", "include_dns", "filters"),
+    static_argnames=("enable_gpu", "enable_storage", "include_dns",
+                     "include_interpod", "filters"),
 )
 
 
@@ -745,9 +753,15 @@ WAVE_BLOCK = 64  # B: max score-table depth = max copies per node per wave itera
 def wave_block_for(m: int, n: int) -> int:
     """Static score-table depth for an m-pod wave over n nodes: a pow2 in
     [8, WAVE_BLOCK] covering ~8× the mean per-node take, so a 1000-pod segment
-    over 5000 nodes sorts an [N, 8] table instead of [N, 64] (the sort is the
-    wave's dominant cost) while a 100k-pod headline still gets full depth.
-    Pow2 bucketing keeps the number of distinct compiled wave kernels small."""
+    over 5000 nodes builds an [N, 8] table instead of [N, 64] while a
+    100k-pod headline still gets full depth. Correctness never depends on
+    the depth (hidden entries defer to later iterations), only iteration
+    count does — the 8× headroom over the mean take keeps one iteration the
+    common case, and the floor of 8 keeps the hidden-continuation bound
+    BELOW the flat floor-quantized score runs (~3 copies wide at millicore
+    granularity; a depth-2 bound lands inside the run, equal to every
+    visible score, and stalls takes to the head fallback). Pow2 bucketing
+    keeps the number of distinct compiled wave kernels small."""
     b = 8
     target = (8 * m + max(n, 1) - 1) // max(n, 1)
     while b < min(WAVE_BLOCK, target):
@@ -857,8 +871,6 @@ def _aggregate_commit(tb: Tables, cry: Carry, g, j, gpu_live: bool) -> Carry:
     copy per step for every node in parallel, so the carry's per-device ledger
     matches the serial path bit for bit (j is small: bounded by GPU units)."""
     jf = j.astype(_F32)
-    T = cry.counter.shape[0]
-    Tc = cry.carrier.shape[0]
     D = cry.counter.shape[1] - 1
     requested = cry.requested + tb.grp_requests[g][None, :] * jf[:, None]
     nonzero = cry.nonzero + tb.grp_nonzero[g][None, :] * jf[:, None]
@@ -869,10 +881,19 @@ def _aggregate_commit(tb: Tables, cry: Carry, g, j, gpu_live: bool) -> Carry:
     pids = tb.grp_ports[g]
     port_used = cry.port_used.at[:, pids].max(
         ((pids > 0)[None, :]) & (j > 0)[:, None])
-    cinc = tb.counter_sel_match_g[:, g, None].astype(_F32) * (tb.counter_dom < D) * jf[None, :]
-    counter = cry.counter.at[jnp.arange(T)[:, None], tb.counter_dom].add(cinc)
-    rinc = tb.grp_carries[g][:, None] * (tb.carr_dom < D) * jf[None, :]
-    carrier = cry.carrier.at[jnp.arange(Tc)[:, None], tb.carr_dom].add(rinc)
+    # Counter/carrier rows sharing a topology key share their whole domain
+    # row, so the per-node counts segment-reduce ONCE per unique topology
+    # ([U, N] scatter, U = a handful) and broadcast to the [T]/[Tc] rows as
+    # cheap elementwise adds. The old per-row form scattered T×N + Tc×N
+    # updates — ~12ms per wave segment at 5k nodes, the dominant fixed cost.
+    U = tb.topo_dom.shape[0]
+    seg = jnp.zeros((U, D + 1), _F32).at[
+        jnp.arange(U)[:, None], tb.topo_dom
+    ].add(jf[None, :] * (tb.topo_dom < D))
+    counter = (cry.counter
+               + tb.counter_sel_match_g[:, g, None].astype(_F32)
+               * seg[tb.counter_topo])
+    carrier = cry.carrier + tb.grp_carries[g][:, None] * seg[tb.carr_topo]
     dev_used = cry.dev_used
     if gpu_live:
         gmem, gnum, safe_mem = _wave_gpu_params(tb, g)
@@ -899,15 +920,32 @@ def _aggregate_commit(tb: Tables, cry: Carry, g, j, gpu_live: bool) -> Carry:
 
 
 
+def wave_kmax(m: int, n: int, block: int) -> int:
+    """Static top-k width for a wave dispatch: a pow2 ≥ the segment length
+    (one iteration can never take more than m entries), capped at the full
+    table size. lax.top_k at a bounded k replaces the full N·B stable sort —
+    the sort was ~14ms per iteration at 5k nodes where top_k(1024) is
+    ~0.6ms — and pow2 bucketing bounds the compiled variants."""
+    cap = max(1, n * block)
+    k = 256
+    while k < min(m, cap):
+        k *= 2
+    return min(k, cap)
+
+
 def _wave_candidates(tb: Tables, cry: Carry, st: dict, g, j, avail, F,
-                     w: ScoreWeights, B: int, iota_n):
+                     w: ScoreWeights, B: int, iota_n, kmax: int):
     """Shared wave-iteration front half: normalizers for the current feasible
     set, the [N, B+1] score table, the usable-entry mask (capacity, monotone
     prefix, hidden-continuation guard — see schedule_wave's body comments for
-    the exactness argument), and the flattened stable sort. Single source for
-    schedule_wave and schedule_spread_wave; the callers differ only in how
-    much of the sorted order they may take. Returns
-    (norms, table, idx_srt, ex_srt, flat_s)."""
+    the exactness argument), and the top-kmax candidates in serial's exact
+    pick order (score desc, node asc, copy asc — lax.top_k breaks ties by
+    ascending flat index, which IS that order on the n-major table). Entries
+    beyond kmax rank strictly worse than every visible candidate, so
+    truncation only caps one iteration's take — the next iteration (or the
+    head fallback) sees them with identical state. Single source for
+    schedule_wave and schedule_affinity_wave. Returns
+    (norms, table, idx_srt, ex_srt, vals) with the last three [kmax]-wide."""
     N = tb.alloc.shape[0]
     norms = _wave_norms(st, F)
     table_ext = _wave_score_table(tb, cry, st, norms, g, j, w, B)  # [N, B+1]
@@ -945,22 +983,19 @@ def _wave_candidates(tb: Tables, cry: Carry, st: dict, g, j, avail, F,
     usable &= beats
 
     flat_s = jnp.where(usable, table, -jnp.inf).reshape(-1)
-    flat_idx = jnp.broadcast_to(iota_n[:, None], (N, B)).reshape(-1)
     exhaust = (ks == (avail[:, None] - 1)) & usable        # entry that empties n
-    flat_ex = exhaust.reshape(-1)
-    neg_s_srt, idx_srt, ex_srt = jax.lax.sort(
-        (-flat_s, flat_idx, flat_ex.astype(jnp.int32)), num_keys=2,
-        is_stable=True,
-    )
-    return norms, table, idx_srt, ex_srt, flat_s
+    vals, flat_pos = jax.lax.top_k(flat_s, kmax)
+    idx_srt = (flat_pos // B).astype(jnp.int32)
+    ex_srt = exhaust.reshape(-1)[flat_pos].astype(jnp.int32)
+    return norms, table, idx_srt, ex_srt, vals
 
 
-@partial(jax.jit, static_argnames=("gpu_live", "w", "filters", "block"))
+@partial(jax.jit, static_argnames=("gpu_live", "w", "filters", "block", "kmax"))
 @shaped(g="[] i32", m="[] i32", cap1="[] bool")
 def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
                   w: ScoreWeights = DEFAULT_WEIGHTS,
                   filters: FilterFlags = DEFAULT_FILTERS,
-                  block: int = WAVE_BLOCK):
+                  block: int = WAVE_BLOCK, kmax: int = 0):
     """Place up to m pods of wave-eligible group g, exactly reproducing m serial
     _step placements. Returns (new carry, per-node counts [N] i32, placed i32).
 
@@ -976,9 +1011,12 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
     block (static): score-table depth (wave_block_for). Correctness never
     depends on it — entries past the depth are exactly what the
     hidden-continuation guard defers to later iterations — only the
-    table/sort size vs iteration-count trade-off does."""
+    table/sort size vs iteration-count trade-off does. kmax (static, 0 =
+    full table): top-k truncation width (wave_kmax); also purely a
+    performance knob (tail entries defer to later iterations)."""
     N = tb.alloc.shape[0]
     B = block
+    K = kmax if kmax else N * B
     iota_n = jnp.arange(N, dtype=jnp.int32)
     base_feas, _ = feasibility(
         tb, cry, g, jnp.int32(-1), jnp.asarray(True),
@@ -997,10 +1035,10 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
         j, placed, _ = state
         avail = capacity - j                                   # copies left per node
         F = base_feas & (avail > 0)
-        norms, table, idx_srt, ex_srt, flat_s = _wave_candidates(
-            tb, cry, st, g, j, avail, F, w, B, iota_n)
-        pos = jnp.arange(N * B, dtype=jnp.int32)
-        n_finite = jnp.sum(jnp.isfinite(flat_s).astype(jnp.int32))
+        norms, table, idx_srt, ex_srt, vals = _wave_candidates(
+            tb, cry, st, g, j, avail, F, w, B, iota_n, K)
+        pos = jnp.arange(K, dtype=jnp.int32)
+        n_finite = jnp.sum(jnp.isfinite(vals).astype(jnp.int32))
         m_rem = (m - placed).astype(jnp.int32)
         m_cand = jnp.minimum(m_rem, n_finite)
 
@@ -1039,211 +1077,579 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
     return _aggregate_commit(tb, cry, g, j, gpu_live), j, placed
 
 
-@partial(jax.jit, static_argnames=("w", "filters", "block"))
+class AffinityWaveState(NamedTuple):
+    """Epoch-loop carry contract for schedule_affinity_wave: the ONLY state an
+    epoch may mutate. Leaf shapes/dtypes are fixed for the whole while_loop —
+    simonlint's carry-contract rule holds every branch to this declaration."""
+
+    j: jax.Array         # [N] i32: per-node copies placed so far
+    cnt_dns: jax.Array   # [Sd, D+1] f32: DoNotSchedule counter rows
+    cnt_aff: jax.Array   # [A, D+1] f32: required-affinity counter rows
+    cnt_anti: jax.Array  # [B, D+1] f32: incoming anti-affinity counter rows
+    cnt_car: jax.Array   # [Ca, D+1] f32: existing-pods-anti carrier rows
+    cnt_cw: jax.Array    # [Cw, D+1] f32: weighted (hard) carrier rows
+    cnt_ss: jax.Array    # [1, D+1] f32: SelectorSpread counter row
+    placed: jax.Array    # [] i32
+    last: jax.Array      # [] i32: last epoch's take (progress flag)
+
+
+@partial(jax.jit, static_argnames=("ss_live", "w", "filters", "block", "n_zones"))
 @shaped(g="[] i32", m="[] i32", cap1="[] bool")
-def schedule_spread_wave(tb: Tables, cry: Carry, g, m, cap1,
-                         w: ScoreWeights = DEFAULT_WEIGHTS,
-                         filters: FilterFlags = DEFAULT_FILTERS,
-                         block: int = WAVE_BLOCK):
-    """Epoch-batched wave for groups whose ONLY live self-interaction is
-    DoNotSchedule topology spread (no SelectorSpread counter, no
-    ScheduleAnyway terms, no GPU/storage) — the serial process in far fewer
-    device iterations than one-pod-per-scan-step.
+def schedule_affinity_wave(tb: Tables, cry: Carry, g, m, cap1,
+                           ss_live: bool = False,
+                           w: ScoreWeights = DEFAULT_WEIGHTS,
+                           filters: FilterFlags = DEFAULT_FILTERS,
+                           block: int = WAVE_BLOCK, n_zones: int = 2):
+    """Epoch-batched wave for groups whose hard predicates read their OWN
+    running placements: self-matching DoNotSchedule spread at ANY topology
+    cardinality (zone-level included), required InterPodAffinity (incl. the
+    bootstrap special case), required anti-affinity in both directions
+    (incoming terms and existing-pods carriers) on non-hostname topologies,
+    and a live SelectorSpread score — the serial one-pod-per-cycle process
+    reproduced bit-for-bit in a few device iterations per segment instead of
+    one scan step per pod. Returns (new carry, per-node counts [N] i32,
+    placed i32).
 
-    Exactness argument, extending schedule_wave's: between F-changing events,
-    the feasible set and every normalizer are constant, so serial's picks are
-    exactly the sorted score-table prefix (per-node columns consumed in
-    order). The DNS filter adds three event kinds beyond node-capacity
-    exhaustion, each with a closed-form position in the sorted order under a
-    min frozen at epoch start (filtering.go:200-241 semantics):
+    Exactness architecture (generalizing schedule_wave's argument):
 
-      * A SELF-matching term's domain d admits q = maxSkew - 1 + min - cnt[d]
-        + 1 more placements before cnt[d] + 1 - min exceeds maxSkew; the
-        entry consuming the q-th is the last allowed — the epoch cuts AFTER
-        it (the domain then blocks, shrinking F). Non-self terms' counters
-        never move during the run, so they contribute only the static q >= 1
-        feasibility gate, never budget consumption.
-      * min rises the moment every min-count eligible domain has gained a
-        placement; the entry completing that is exact to take, and the epoch
-        cuts AFTER it (budgets and blocked domains must be recomputed).
-      * node capacity exhaustion cuts after the exhausting entry, as in
-        schedule_wave (without the norm-invariance extension).
+      * Live-predicate state is compact: per-term counter/carrier rows
+        ([slots, D+1]) kept in the epoch carry (AffinityWaveState) and
+        updated by segment-reduced counts — never the full [T, N] gather of
+        the general scan step.
+      * Per epoch, one [N, B] score table is built with the normalizers of
+        serial's CURRENT feasible set F_start and stable-sorted under
+        serial's exact tie-break key (score desc, node asc). Required
+        affinity and static anti terms gate F as in feasibility(); live
+        budget terms (self DNS spread, self anti-affinity) instead meter
+        consumption along the sorted order.
+      * The MULTI-ROUND inner loop then consumes that one sorted order
+        across many frozen-min rounds: each round takes, in position order,
+        the per-domain budget prefixes (DNS: q = maxSkew - self + min - cnt
+        + 1; anti: q = 1 while the domain count is 0) up to the min-rise cut
+        (the entry giving the last min-count eligible domain its first
+        placement), then recomputes budgets — so a zone-spread segment
+        places its whole run under one table+sort where the old epoch wave
+        paid a sort per ~Z pods. Per-domain consumption is always a prefix
+        of that domain's sorted entries, so a [D+1] taken-counter per round
+        replaces per-entry bookkeeping.
+      * Soundness of the big take is PROVED per epoch by a normalizer
+        sandwich: every intermediate feasible set F_t satisfies
+        S_lo ⊆ F_t ⊆ S_hi, where S_hi ignores live gates and S_lo further
+        removes every node that exhausted capacity or was ever budget-
+        blocked; min/max normalizers are monotone under set inclusion, so
+        norm equality at both ends pins them at every step. InterPodAffinity
+        score liveness (the group's own hard carrier) is contained the same
+        way: the take is accepted only when ip_raw is uniform over S_hi and
+        each live carrier's domain is single-valued there (then the min-max
+        normalized term is identically 0 throughout); SelectorSpread
+        liveness by freezing maxN (per-node depth caps keep counts at or
+        below it) and cutting when zone sums could move.
+      * Whenever any proof obligation fails — a bootstrap placement, a
+        normalizer that would move, zoned SelectorSpread — the epoch falls
+        back to serial's literal next pick (the best head over F_start),
+        which is unconditionally exact and guarantees progress.
 
-    Each epoch therefore takes min(candidates, first-event cut) pods — with
-    Z eligible domains typically ~Z placements per iteration instead of 1 —
-    and the head fallback guarantees progress when the guard masks
-    everything. Returns (new carry, per-node counts [N] i32, placed i32)."""
+    block (static): score-table depth, as in schedule_wave. ss_live /
+    n_zones (static): live SelectorSpread scoring, as in
+    schedule_group_serial."""
     N = tb.alloc.shape[0]
     B = block
+    NB = N * B
+    K_EP = min(NB, 2048)  # static per-round working-set width (see below)
+    LMAX = 32             # min-rise levels batched per multi-level round
     D = cry.counter.shape[1] - 1
     iota_n = jnp.arange(N, dtype=jnp.int32)
-    INF_P = jnp.int32(N * B + 1)
+    iota_d = jnp.arange(D + 1)
+    pos_k = jnp.arange(K_EP, dtype=jnp.int32)
+    INF_P = jnp.int32(NB + 1)
     base_feas, _ = feasibility(
         tb, cry, g, jnp.int32(-1), jnp.asarray(True),
-        enable_gpu=False, enable_storage=False, include_dns=False, filters=filters,
+        enable_gpu=False, enable_storage=False, include_dns=False,
+        include_interpod=False, filters=filters,
     )
-    st = _wave_statics(tb, cry, g, w)
+    st0 = _wave_statics(tb, cry, g, w)
     capacity = jnp.where(base_feas, _wave_capacity(tb, cry, g, cap1), 0)
     if not filters.fit:
+        # resources unbounded, but cap1 (ports / self-anti-affinity) survives
         capacity = jnp.where(base_feas, 2_147_483_000, 0)
         capacity = jnp.where(cap1, jnp.minimum(capacity, 1), capacity)
 
+    # ---- term slots: static ids/doms, live flags, seed rows ----------------
     dids_raw = tb.dns_t[g]                                 # [Sd]
     dvalid = dids_raw >= 0
     dids = jnp.maximum(dids_raw, 0)
-    dom_rows = tb.counter_dom[dids]                        # [Sd, N]
-    key_present = dom_rows < D
+    dom_dns = tb.counter_dom[dids]                         # [Sd, N]
+    dns_key = dom_dns < D
     edom = tb.dns_edom[g]                                  # [Sd, D+1]
-    dself = tb.dns_self[g]                                 # [Sd] f32 (1.0 = self)
-    dskew = tb.dns_maxskew[g]                              # [Sd]
-    live = dvalid & (tb.counter_sel_match_g[dids, g]) & (dself > 0)  # [Sd]
-    cnt0 = cry.counter[dids]                               # [Sd, D+1]
+    dself = tb.dns_self[g]
+    dskew = tb.dns_maxskew[g]
+    live_dns = dvalid & tb.counter_sel_match_g[dids, g] & (dself > 0)
+    if not filters.spread:
+        dvalid = jnp.zeros_like(dvalid)
+        live_dns = jnp.zeros_like(live_dns)
+    cnt_dns0 = cry.counter[dids]                           # [Sd, D+1]
     Sd = dids.shape[0]
 
-    if not filters.spread:
-        # DNS filter disabled by scheduler config: plain-wave semantics
-        live = jnp.zeros_like(live)
-        dvalid = jnp.zeros_like(dvalid)
+    aids_raw = tb.req_aff_t[g]                             # [A]
+    avalid = aids_raw >= 0
+    aids = jnp.maximum(aids_raw, 0)
+    dom_aff = tb.counter_dom[aids]                         # [A, N]
+    live_aff = avalid & tb.counter_sel_match_g[aids, g]
+    cnt_aff0 = cry.counter[aids]
+    A = aids.shape[0]
 
-    def body(state):
-        j, cnt, placed, _ = state
-        avail = capacity - j
-        # frozen-min budgets: q[s, d] = remaining placements domain d admits
-        min_c = jnp.min(jnp.where(edom, cnt, jnp.inf), axis=1)
-        min_c = jnp.where(jnp.isfinite(min_c), min_c, 0.0)     # [Sd]
-        q = dskew[:, None] - dself[:, None] + min_c[:, None] - cnt + 1.0
-        q = jnp.maximum(q, 0.0)                                # [Sd, D+1]
-        # per-node DNS feasibility: every valid term has key + budget >= 1
-        q_at = jnp.take_along_axis(q, dom_rows, axis=1)        # [Sd, N]
-        dns_ok = jnp.all((key_present & (q_at >= 1.0)) | ~dvalid[:, None], axis=0)
-        F = base_feas & (avail > 0) & dns_ok
-        norms, table, idx_srt, ex_srt, flat_s = _wave_candidates(
-            tb, cry, st, g, j, avail, F, w, B, iota_n)
-        pos = jnp.arange(N * B, dtype=jnp.int32)
-        n_finite = jnp.sum(jnp.isfinite(flat_s).astype(jnp.int32))
-        m_rem = (m - placed).astype(jnp.int32)
-        m_cand = jnp.minimum(m_rem, n_finite)
-        valid_pos = pos < m_cand
+    bids_raw = tb.req_anti_t[g]                            # [Ba]
+    bvalid = bids_raw >= 0
+    bids = jnp.maximum(bids_raw, 0)
+    dom_anti = tb.counter_dom[bids]                        # [Ba, N]
+    live_anti = bvalid & tb.counter_sel_match_g[bids, g]
+    cnt_anti0 = cry.counter[bids]
+    Ba = bids.shape[0]
 
-        # node-capacity cut: after the first exhausting entry
-        p_ex = jnp.min(jnp.where((ex_srt > 0) & valid_pos, pos, INF_P))
+    ca_raw = tb.carr_anti_t[g]                             # [Ca]
+    cavalid = ca_raw >= 0
+    ca_ids = jnp.maximum(ca_raw, 0)
+    dom_car = tb.carr_dom[ca_ids]                          # [Ca, N]
+    car_inc = tb.grp_carries[g][ca_ids]                    # 1.0 when g carries it
+    live_car = cavalid & (car_inc > 0)
+    cnt_car0 = cry.carrier[ca_ids]
+    Ca = ca_ids.shape[0]
 
-        # Per-SELF-term domain bookkeeping along the sorted order. Everything
-        # here is LINEAR in NB and D — no [NB, D] one-hot, because hostname
-        # topologies have D ~ N and this kernel is routed exactly to
-        # high-cardinality topologies.
-        dom_srt = dom_rows[:, idx_srt]                          # [Sd, NB]
-        NB = N * B
-        p_dom_ex = INF_P
-        p_viol = INF_P
-        p_rise = INF_P
-        at_min = edom & (cnt == min_c[:, None])                 # [Sd, D+1]
-        within_budget = jnp.ones(N * B, bool)
-        for s in range(Sd):
-            dom_row = dom_srt[s]
-            dkey = jnp.where(valid_pos, dom_row, D)             # invalid → sentinel
-            # occ_before: rank of each entry among same-domain entries in
-            # score order, via one (domain, position) sort + run ranking
-            d2, p2 = jax.lax.sort((dkey, pos), num_keys=2, is_stable=True)
-            run_start = jnp.concatenate(
-                [jnp.ones((1,), bool), d2[1:] != d2[:-1]])
-            seg_start = jax.lax.associative_scan(
-                jnp.maximum, jnp.where(run_start, pos, 0))
-            occ = jnp.zeros(NB, _F32).at[p2].set((pos - seg_start).astype(_F32))
-            q_row = q[s][dom_row]                               # [NB]
-            act = live[s] & valid_pos
-            within_budget &= jnp.where(act, occ + 1.0 <= q_row, True)
-            # the q-th take exhausts its domain → cut after; a q+1-th entry is
-            # a violation (possible when another term still had budget) → cut
-            # before
-            p_dom_ex = jnp.minimum(p_dom_ex, jnp.min(
-                jnp.where(act & (occ + 1.0 == q_row), pos, INF_P)))
-            p_viol = jnp.minimum(p_viol, jnp.min(
-                jnp.where(act & (occ + 1.0 > q_row), pos, INF_P)))
-            # min-rise cut: the position where the LAST min-count eligible
-            # domain receives its first placement (INF if any never does)
-            first_occ = jnp.full((D + 1,), INF_P).at[dkey].min(
-                jnp.where(valid_pos, pos, INF_P))
-            rise = jnp.max(jnp.where(at_min[s], first_occ, -1))
-            unreached = jnp.any(at_min[s] & (first_occ >= INF_P))
-            p_rise = jnp.minimum(p_rise, jnp.where(
-                live[s] & ~unreached & (rise >= 0), rise, INF_P))
+    cw_raw = tb.carr_w_t[g]                                # [Cw]
+    cwvalid = cw_raw >= 0
+    cw_ids = jnp.maximum(cw_raw, 0)
+    dom_cw = tb.carr_dom[cw_ids]                           # [Cw, N]
+    cw_w = tb.carr_w_w[g]
+    cw_inc = tb.grp_carries[g][cw_ids]
+    live_cw = cwvalid & (cw_inc > 0)
+    cnt_cw0 = cry.carrier[cw_ids]
+    Cw = cw_ids.shape[0]
 
-        # Conservative epoch: stop at the first F-changing event.
-        m_take_cons = jnp.minimum(m_cand, jnp.minimum(p_ex + 1, p_viol))
-        m_take_cons = jnp.minimum(m_take_cons,
-                                  jnp.minimum(p_dom_ex + 1, p_rise + 1))
-        counts_cons = jnp.zeros(N, jnp.int32).at[idx_srt].add(
-            (pos < m_take_cons).astype(jnp.int32))
+    if not filters.interpod:
+        avalid = jnp.zeros_like(avalid)
+        live_aff = jnp.zeros_like(live_aff)
+        bvalid = jnp.zeros_like(bvalid)
+        live_anti = jnp.zeros_like(live_anti)
+        cavalid = jnp.zeros_like(cavalid)
+        live_car = jnp.zeros_like(live_car)
 
-        # Skipping epoch: with min frozen and every normalizer INVARIANT,
-        # serial just skips over-budget / capacity-exhausted entries and keeps
-        # consuming the same order — so take the first m_rem in-cap,
-        # within-budget entries up to the min-rise cut. Valid only when
-        # removing every node that leaves F during the prefix (capacity
-        # exhausted or domain blocked) provably changes no normalizer —
-        # checked on the end state exactly like schedule_wave's check.
-        # Only positions whose budgets were evaluated (valid_pos = pos <
-        # m_cand) may be taken — tail entries past m_cand have UNCHECKED
-        # budgets and must wait for the next epoch's accounting.
-        takeable = valid_pos & within_budget & (pos <= p_rise)
-        take_rank = jax.lax.associative_scan(
-            jnp.add, takeable.astype(jnp.int32))                # 1-based
-        taken = takeable & (take_rank <= m_rem)
-        m_take_skip = jnp.minimum(m_rem, take_rank[-1])
-        counts_skip = jnp.zeros(N, jnp.int32).at[idx_srt].add(
-            taken.astype(jnp.int32))
+    # static ip part: preferred terms (a self-matching preferred counter
+    # routes to the serial scan, so these rows never move during the segment)
+    pref_ids = tb.pref_t[g]
+    pvalid = pref_ids >= 0
+    pw = tb.pref_w[g]
+    _, pref_at, _, _ = counter_rows_at(tb, cry, jnp.maximum(pref_ids, 0))
+    ip_pref = jnp.sum(jnp.where(pvalid[:, None], pw[:, None] * pref_at, 0.0),
+                      axis=0)                              # [N]
 
-        leaves_cap = counts_skip >= jnp.maximum(avail, 1)
-        # nodes whose any live term's domain budget is fully consumed
-        used_budget = jnp.zeros((Sd, D + 1), _F32).at[
-            jnp.arange(Sd)[:, None], dom_srt
-        ].add(taken.astype(_F32)[None, :] * live[:, None].astype(_F32))
-        dom_blocked = used_budget >= q                          # [Sd, D+1]
-        node_blocked = jnp.any(
-            jnp.take_along_axis(dom_blocked, dom_rows, axis=1)
-            & live[:, None], axis=0)                            # [N]
-        F_end = F & ~leaves_cap & ~node_blocked
-        norms_end = _wave_norms(st, F_end)
+    ss_idx = jnp.maximum(tb.ss_t[g], 0)
+    dom_ss = tb.counter_dom[ss_idx][None]                  # [1, N]
+    cnt_ss0 = cry.counter[ss_idx][None]                    # [1, D+1]
+    ss_match = (tb.counter_sel_match_g[ss_idx, g]
+                & (tb.ss_t[g] >= 0)).astype(_F32)[None]    # [1]
+    if ss_live:
+        zones = tb.node_zone
+        Z = max(2, n_zones)
+
+    # counter increments one group placement applies (commit() semantics)
+    inc_dns = (tb.counter_sel_match_g[dids, g] & dvalid).astype(_F32)
+    inc_aff = (tb.counter_sel_match_g[aids, g] & avalid).astype(_F32)
+    inc_anti = (tb.counter_sel_match_g[bids, g] & bvalid).astype(_F32)
+    inc_car = car_inc * cavalid.astype(_F32)
+    inc_cw = cw_inc * cwvalid.astype(_F32)
+
+    # live budget terms (consume per-domain budgets along the sorted order).
+    # The multi-round path composes: exactly ONE live DNS term, or ANY number
+    # of live anti terms sharing one topology (identical domain rows — the
+    # ubiquitous both-directions self-anti pair composes into one combined
+    # meter: a domain is consumable iff every term's count is 0, and one take
+    # blocks it under all of them).
+    n_dns = jnp.sum(live_dns.astype(jnp.int32))
+    n_anti = (jnp.sum(live_anti.astype(jnp.int32))
+              + jnp.sum(live_car.astype(jnp.int32)))
+    n_budget = n_dns + n_anti
+    has_budget = n_budget >= 1
+
+    def sel(live, rows):
+        """Sum per-slot rows over live slots (the callers divide by the live
+        count or prove the slots identical, so sums are exact where used)."""
+        return jnp.sum(jnp.where(live[:, None], rows, 0), axis=0)
+
+    dom_sum = (sel(live_dns, dom_dns) + sel(live_anti, dom_anti)
+               + sel(live_car, dom_car))
+    # identical dom rows under budget_composes ⇒ the mean IS the row
+    dom_live = (dom_sum // jnp.maximum(n_budget, 1)).astype(jnp.int32)   # [N]
+    doms_same = (jnp.all(~live_dns[:, None] | (dom_dns == dom_live[None, :]))
+                 & jnp.all(~live_anti[:, None] | (dom_anti == dom_live[None, :]))
+                 & jnp.all(~live_car[:, None] | (dom_car == dom_live[None, :])))
+    budget_composes = (n_budget <= 1) | ((n_dns == 0) & doms_same)
+    edom_live = sel(live_dns, edom.astype(_F32)) > 0             # [D+1]
+    skew_live = jnp.sum(jnp.where(live_dns, dskew, 0.0))
+    self_live = jnp.sum(jnp.where(live_dns, dself, 0.0))
+    is_dns_live = jnp.any(live_dns)
+    # combined count units one take adds to the composed meter
+    inc_live = (jnp.sum(jnp.where(live_dns, inc_dns, 0.0))
+                + jnp.sum(jnp.where(live_anti, inc_anti, 0.0))
+                + jnp.sum(jnp.where(live_car, inc_car, 0.0)))
+    # live DNS terms demand the topology key (static per node)
+    dns_key_live_ok = jnp.all(dns_key | ~live_dns[:, None], axis=0)
+
+    def norm_stacks(ip_raw, pernode0):
+        rows = [st0["simon_s"], st0["na_raw"], st0["t_raw"], ip_raw]
+        if ss_live:
+            rows.append(pernode0)
+        return jnp.stack(rows), jnp.stack([st0["simon_s"], ip_raw])
+
+    def norm_vals(max_stack, min_stack, F):
+        maxes = jnp.max(jnp.where(F[None, :], max_stack, -jnp.inf), axis=1)
+        mins = jnp.min(jnp.where(F[None, :], min_stack, jnp.inf), axis=1)
+        return maxes, mins
+
+    def norms_eq(a, b):
         same = jnp.array(True)
-        for a, b in zip(norms, norms_end):
-            same &= a == b
+        for x, y in zip(a, b):
+            same &= jnp.all(x == y)  # ±inf compare equal; no NaN can arise
+        return same
 
-        # The skip path's per-term occ counts every same-domain entry, taken
-        # or not; with TWO+ live terms an entry skipped for term A still
-        # consumes term B's occ, under-estimating B's real remaining budget —
-        # serial would not consume it. One live term has no such interaction
-        # (its own over-budget entries are exactly the ones serial skips,
-        # consuming nothing), so the skip path is sound only there.
-        use_skip = same & (jnp.sum(live.astype(jnp.int32)) <= 1)
-        m_take = jnp.where(use_skip, m_take_skip, m_take_cons)
-        counts = jnp.where(use_skip, counts_skip, counts_cons)
+    def body(state: AffinityWaveState):
+        j, cnt_dns, cnt_aff, cnt_anti, cnt_car, cnt_cw, cnt_ss, placed, _ = state
+        avail = capacity - j
+        m_rem = (m - placed).astype(jnp.int32)
+
+        # ---- live gates from epoch-start rows (feasibility() term for term)
+        cnt_at_d = jnp.take_along_axis(cnt_dns, dom_dns, axis=1)     # [Sd, N]
+        min_d = jnp.min(jnp.where(edom, cnt_dns, jnp.inf), axis=1)
+        min_d = jnp.where(jnp.isfinite(min_d), min_d, 0.0)
+        skew_ok = dns_key & (cnt_at_d + dself[:, None] - min_d[:, None]
+                             <= dskew[:, None])
+        dns_ok = jnp.all(skew_ok | ~dvalid[:, None], axis=0)
+        dns_ok_static = jnp.all(skew_ok | ~dvalid[:, None] | live_dns[:, None],
+                                axis=0)
+
+        at_a = jnp.take_along_axis(cnt_aff, dom_aff, axis=1)         # [A, N]
+        sat = ((dom_aff < D) & (at_a > 0)) | ~avalid[:, None]
+        aff_all = jnp.all(sat, axis=0)
+        has_aff = jnp.any(avalid)
+        totals_a = jnp.sum(cnt_aff[:, :D], axis=1)
+        total_aff = jnp.sum(jnp.where(avalid, totals_a, 0.0))
+        bootstrap = has_aff & (total_aff == 0.0) & tb.grp_aff_self[g]
+        aff_ok = jnp.where(bootstrap, jnp.ones_like(aff_all), aff_all)
+
+        at_b = jnp.take_along_axis(cnt_anti, dom_anti, axis=1)       # [Ba, N]
+        blocked_in = jnp.any((at_b > 0) & bvalid[:, None], axis=0)
+        blocked_in_st = jnp.any((at_b > 0) & bvalid[:, None]
+                                & ~live_anti[:, None], axis=0)
+        at_c = jnp.take_along_axis(cnt_car, dom_car, axis=1)         # [Ca, N]
+        blocked_ex = jnp.any((at_c > 0) & cavalid[:, None], axis=0)
+        blocked_ex_st = jnp.any((at_c > 0) & cavalid[:, None]
+                                & ~live_car[:, None], axis=0)
+
+        # F_start: serial's CURRENT feasible set. F_hi: live budget gates
+        # lifted — the sandwich's upper set (every F_t during the epoch is
+        # between F_lo and F_hi; live-gated nodes re-enter as min rises).
+        room = base_feas & (avail > 0) & aff_ok
+        F_start = room & dns_ok & ~blocked_in & ~blocked_ex
+        F_hi = (room & dns_ok_static & ~blocked_in_st & ~blocked_ex_st
+                & dns_key_live_ok)
+
+        # ---- live scores: ip_raw from live carrier rows; ss pernode
+        cw_at = jnp.take_along_axis(cnt_cw, dom_cw, axis=1)          # [Cw, N]
+        ip_raw = ip_pref + jnp.sum(
+            jnp.where(cwvalid[:, None], cw_w[:, None] * cw_at, 0.0), axis=0)
+        pernode0 = jnp.take_along_axis(cnt_ss, dom_ss, axis=1)[0]    # [N]
+        max_stack, min_stack = norm_stacks(ip_raw, pernode0)
+        maxes_s, mins_s = norm_vals(max_stack, min_stack, F_start)
+        maxes_h, mins_h = norm_vals(max_stack, min_stack, F_hi)
+        norms6 = (maxes_s[0], mins_s[0], jnp.maximum(maxes_s[1], 0.0),
+                  jnp.maximum(maxes_s[2], 0.0), jnp.maximum(maxes_s[3], 0.0),
+                  jnp.minimum(mins_s[1], 0.0))
+        # Uniform normalizer inputs (simon/nodeaff/taint/ip identical across
+        # F_hi — identical-node clusters, the common fleet shape): every
+        # normalized term is then the same CONSTANT on every non-empty
+        # feasible subset, and F_t always contains the node being placed, so
+        # norms are pinned without any sandwich — blocking/unblocking cannot
+        # move them. This is what keeps the multi-round path on for workloads
+        # where every domain cycles through a budget block (the sandwich's
+        # lower set would be empty there).
+        base_hi_min = jnp.min(jnp.where(F_hi[None, :], max_stack[:4], jnp.inf),
+                              axis=1)
+        uniform_base = jnp.all(maxes_h[:4] == base_hi_min) & jnp.any(F_hi)
+
+        # ip-liveness containment: the group's own hard carrier moves ip_raw
+        # with every placement. The frozen table stays exact only when the
+        # normalized term is pinned at 0 throughout: ip_raw uniform over F_hi
+        # AND each live carrier's domain single-valued there (so it STAYS
+        # uniform as counts grow).
+        has_live_cw = jnp.any(live_cw)
+        anyF = jnp.any(F_hi)
+        dmax = jnp.max(jnp.where(F_hi[None, :], dom_cw, -1), axis=1)
+        dmin = jnp.min(jnp.where(F_hi[None, :], dom_cw, D + 2), axis=1)
+        dom_same = jnp.all(~live_cw | (dmax == dmin))
+        ip_hi = jnp.max(jnp.where(F_hi, ip_raw, -jnp.inf))
+        ip_lo = jnp.min(jnp.where(F_hi, ip_raw, jnp.inf))
+        ip_safe = ~has_live_cw | ~anyF | (dom_same & (ip_hi == ip_lo))
+
+        # ---- score table under serial's current normalizers --------------
+        st_ep = dict(st0)
+        st_ep["ip_raw"] = ip_raw
+        table_ext = _wave_score_table(tb, cry, st_ep, norms6, g, j, w, B)
+        if ss_live:
+            # live SelectorSpread, selector_spread_score term for term with
+            # maxN/zone sums frozen at epoch start; column c = c prior takes
+            # on the node this epoch, so pernode = row count + c
+            maxN = jnp.maximum(maxes_s[4], 0.0)
+            pernode_k = pernode0[:, None] + jnp.arange(B + 1, dtype=_F32)[None, :]
+            node_score = jnp.where(maxN > 0, 100.0 * (maxN - pernode_k) / maxN,
+                                   100.0)
+            nz_count = jnp.where(F_start, pernode0, 0.0)
+            zone_sums = jnp.zeros((Z,), _F32).at[zones].add(nz_count)
+            maxZ = jnp.max(zone_sums.at[0].set(0.0))
+            have_zones = jnp.any(F_start & (zones > 0))
+            zscore = jnp.where(maxZ > 0, 100.0 * (maxZ - zone_sums[zones]) / maxZ,
+                               100.0)
+            blended = jnp.where(
+                (have_zones & (zones > 0))[:, None],
+                node_score * (1.0 / 3.0) + zscore[:, None] * (2.0 / 3.0),
+                node_score)
+            table_ext = table_ext + w.ss * _flr(blended)
+            # depth cap: a take pushing a count past frozen maxN would move
+            # it — such entries are hidden (next epoch re-freezes maxN)
+            k_cap = jnp.clip(maxN - pernode0, 0.0, float(B)).astype(jnp.int32)
+            ss_multi_ok = ~have_zones  # zone sums move with every zoned take
+        else:
+            k_cap = jnp.full(N, B, jnp.int32)
+            ss_multi_ok = jnp.array(True)
+        table = table_ext[:, :B]
+
+        # ---- candidates: capacity, monotone prefix, hidden-continuation ---
+        ks = jnp.arange(B, dtype=jnp.int32)[None, :]
+        in_cap = ks < jnp.minimum(avail, k_cap.astype(avail.dtype))[:, None]
+        mono = jnp.cumprod(
+            jnp.concatenate(
+                [jnp.ones((N, 1), jnp.int32),
+                 (table[:, 1:] <= table[:, :-1]).astype(jnp.int32)], axis=1),
+            axis=1) > 0
+        usable = in_cap & mono & F_hi[:, None]
+        first_bad = jnp.min(jnp.where(mono, B, ks), axis=1)
+        k_hid = jnp.minimum(jnp.minimum(first_bad, B), k_cap)
+        has_hidden = (k_hid < avail) & F_hi
+        bound = jnp.where(
+            has_hidden,
+            jnp.take_along_axis(table_ext, k_hid[:, None], axis=1)[:, 0],
+            -jnp.inf)
+        b1 = jnp.max(bound)
+        i1 = jnp.argmax(bound)
+        bound2 = bound.at[i1].set(-jnp.inf)
+        b2 = jnp.max(bound2)
+        i2 = jnp.argmax(bound2)
+        cut_s = jnp.where(iota_n == i1, b2, b1)
+        cut_i = jnp.where(iota_n == i1, i2, i1).astype(jnp.int32)
+        beats = (table > cut_s[:, None]) | (
+            (table == cut_s[:, None]) & (iota_n[:, None] < cut_i[:, None]))
+        usable &= beats
+
+        flat_s = jnp.where(usable, table, -jnp.inf).reshape(-1)
+        # Rounds only ever consume from the TOP of the candidate order:
+        # lax.top_k at a static K replaces the full N·B stable sort (ties
+        # break by ascending flat index = score desc, node asc, copy asc —
+        # serial's exact pick order on the n-major table). Sound for any K —
+        # tail entries rank strictly worse than every visible entry, so
+        # serial reaches them only once no visible entry is consumable (the
+        # next epoch, or the head fallback, with identical state) — the same
+        # argument as the per-node depth guard. K also bounds round cost at
+        # O(K + D) instead of O(N·B).
+        vals_k, flat_pos = jax.lax.top_k(flat_s, K_EP)
+        idx_srt = (flat_pos // B).astype(jnp.int32)
+        cand = jnp.isfinite(vals_k)
+        dom_srt = dom_live[idx_srt]                                  # [K]
+        # occ_all: rank among same-domain visible candidates in sorted order
+        # (one sort + run ranking; per-domain consumption is always a prefix)
+        dkey_srt = jnp.where(cand, dom_srt, D + 1)
+        d2, p2 = jax.lax.sort((dkey_srt, pos_k), num_keys=2, is_stable=True)
+        run_start = jnp.concatenate([jnp.ones((1,), bool), d2[1:] != d2[:-1]])
+        seg_start = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(run_start, pos_k, 0))
+        occ_all = jnp.zeros(K_EP, _F32).at[p2].set(
+            (pos_k - seg_start).astype(_F32))
+
+        cnt_live = (sel(live_dns, cnt_dns) + sel(live_anti, cnt_anti)
+                    + sel(live_car, cnt_car))                        # [D+1]
+        pre_norms_ok = (uniform_base
+                        | norms_eq((maxes_s[:4], mins_s), (maxes_h[:4], mins_h)))
+        if ss_live:
+            # the frozen maxN must also hold for gate-lifted nodes (a blocked
+            # node with a higher count would move it when re-admitted)
+            pre_norms_ok &= maxes_s[4] == maxes_h[4]
+        use_multi_pre = (budget_composes & ~bootstrap & ip_safe & ss_multi_ok
+                         & pre_norms_ok)
+
+        # ---- multi-round consumption of the one sorted order --------------
+        # taken_d counts ENTRIES consumed per domain; cnt units scale by the
+        # composed increment (inc_live) where counts are compared
+        def round_cond(rs):
+            _, _, got, last_r, _ = rs
+            return use_multi_pre & (last_r > 0) & (got < m_rem)
+
+        def round_body(rs):
+            taken_d, counts_ep, got, _, everb = rs
+            cnt_now = cnt_live + taken_d * inc_live
+            min_c = jnp.min(jnp.where(edom_live, cnt_now, jnp.inf))
+            min_c = jnp.where(jnp.isfinite(min_c), min_c, 0.0)
+            # entry budgets at the CURRENT min: DNS adds 1 count per entry;
+            # composed anti terms admit one entry while every count is 0
+            q_dns = jnp.maximum(skew_live - self_live + min_c - cnt_now + 1.0,
+                                0.0)
+            q_anti = jnp.where(cnt_now > 0, 0.0, 1.0)
+            q = jnp.where(is_dns_live, q_dns, q_anti)                # [D+1]
+            q = jnp.where(has_budget, q, jnp.inf)
+            q = q.at[D].set(jnp.inf)       # absent-key nodes are never metered
+            t_e = taken_d[dom_srt]
+            q_e = q[dom_srt]
+            r_e = occ_all - t_e            # within-round rank (entry units)
+            remaining = cand & (r_e >= 0)
+            consumable = remaining & (r_e < q_e)
+            m_left = m_rem - got
+
+            # ---- multi-LEVEL take: process up to LMAX min-rises at once.
+            # Closed forms per entry: it becomes legal at level
+            # l_e = max(1, rank - budget + 2) (budgets grow by 1 per rise),
+            # and consuming it raises its domain's count to min + lc_e —
+            # i.e. it is exactly the entry whose take completes rise lc_e
+            # for that domain. Rise l completes when every eligible domain
+            # reaches min + l, so p_rise_l = max over needed entries with
+            # lc == l of their position (each is legal at its own level and
+            # precedes its p_rise by construction); a needed level some
+            # domain cannot provide caps the ladder. An entry is then taken
+            # by level L iff l_e <= L and pos <= p_rise_L (p_rise is
+            # monotone in l), and everything it skips stays over-budget
+            # through level L — the single-rise argument applied per level.
+            dom_cnt_e = cnt_now[dom_srt]
+            l_e = jnp.maximum(1.0, r_e - q_e + 2.0)
+            lc_e = dom_cnt_e + r_e + 1.0 - min_c
+            elig_e = edom_live[dom_srt]
+            lc_ok = (remaining & elig_e & (lc_e >= 1.0)
+                     & (lc_e <= float(LMAX)))
+            lc_i = jnp.clip(lc_e, 0.0, float(LMAX + 1)).astype(jnp.int32)
+            prise = jnp.full((LMAX + 2,), -1, jnp.int32).at[lc_i].max(
+                jnp.where(lc_ok, pos_k, -1))
+            provided = jnp.zeros((LMAX + 2,), _F32).at[lc_i].add(
+                lc_ok.astype(_F32))
+            # needed_l = #eligible domains still below min + l
+            delta = jnp.where(edom_live, cnt_now - min_c, jnp.inf)
+            hist = jnp.zeros((LMAX + 2,), _F32).at[
+                jnp.clip(delta, 0.0, float(LMAX + 1)).astype(jnp.int32)
+            ].add(edom_live.astype(_F32))
+            needed = jnp.cumsum(hist)  # needed for level l = hist[< l] summed
+            lvl = jnp.arange(LMAX + 2)
+            # L_used: longest prefix of levels whose every needed domain
+            # provided its rise-completing entry
+            ok_l = jnp.where((lvl >= 1) & (lvl <= LMAX),
+                             (provided == needed[jnp.maximum(lvl - 1, 0)])
+                             .astype(_F32), 1.0)
+            L_used = jnp.sum(((jnp.cumprod(ok_l) > 0)
+                              & (lvl >= 1) & (lvl <= LMAX)).astype(jnp.int32))
+            prise_cum = jax.lax.associative_scan(jnp.maximum, prise)
+            P_L = prise_cum[L_used]
+            take_full = (remaining & (l_e <= L_used.astype(_F32))
+                         & (pos_k <= P_L))
+            n_full = jnp.sum(take_full.astype(jnp.int32))
+            use_full = (is_dns_live & (L_used >= 1) & (n_full <= m_left)
+                        & (n_full > 0))
+
+            # ---- single-rise take (the exact chronological tail/partial
+            # round, and the anti/composed path)
+            at_min = edom_live & (cnt_now == min_c) & is_dns_live
+            first_pos = jnp.full((D + 1,), INF_P, jnp.int32).at[dom_srt].min(
+                jnp.where(consumable, pos_k, INF_P))
+            rise = jnp.max(jnp.where(at_min, first_pos, -1))
+            unreached = jnp.any(at_min & (first_pos >= INF_P))
+            p_rise = jnp.where(jnp.any(at_min) & ~unreached, rise, INF_P)
+            take_pre = consumable & (pos_k <= p_rise)
+            rank = jax.lax.associative_scan(jnp.add, take_pre.astype(jnp.int32))
+            take_one = take_pre & (rank <= m_left)
+            n_one = jnp.minimum(m_left, rank[-1])
+
+            take = jnp.where(use_full, take_full, take_one)
+            n_take = jnp.where(use_full, n_full, n_one)
+            counts_r = jnp.zeros(N, jnp.int32).at[idx_srt].add(
+                take.astype(jnp.int32))
+            consumed_d = jnp.zeros(D + 1, _F32).at[dom_srt].add(
+                take.astype(_F32))
+            # sandwich bookkeeping: any node whose live domain was blocked at
+            # the round start or fully consumed this round left F mid-epoch;
+            # a multi-level round cycles most domains through a block, so it
+            # marks every eligible/touched domain (conservative — the uniform
+            # shortcut is what keeps the fast path on)
+            blocked_d = (q < 1.0) | ((consumed_d >= q) & jnp.isfinite(q))
+            blocked_d |= use_full & (edom_live | (consumed_d > 0))
+            everb = everb | (blocked_d[dom_live] & has_budget)
+            taken_d = taken_d + consumed_d * (iota_d < D)
+            return (taken_d, counts_ep + counts_r, got + n_take, n_take, everb)
+
+        def round_chain(rs):
+            # 4 rounds per device iteration: a drained round is a no-op (zero
+            # take leaves the state fixed), so over-running is harmless and
+            # the while-loop bookkeeping amortizes 4×
+            for _ in range(4):
+                rs = round_body(rs)
+            return rs
+
+        rs0 = (jnp.zeros(D + 1, _F32), jnp.zeros(N, jnp.int32), jnp.int32(0),
+               jnp.int32(1), jnp.zeros(N, bool))
+        _, counts_multi, placed_multi, _, everb = jax.lax.while_loop(
+            round_cond, round_chain, rs0)
+
+        # normalizer sandwich: S_lo ⊆ every F_t ⊆ F_hi ⇒ equality at both
+        # ends pins every intermediate normalizer (min/max are monotone).
+        # Uniform inputs skip it (norms constant on every non-empty subset).
+        exhausted = counts_multi >= avail
+        F_lo = F_hi & ~everb & ~exhausted
+        maxes_l, mins_l = norm_vals(max_stack, min_stack, F_lo)
+        lo_norms_ok = (uniform_base
+                       | norms_eq((maxes_h[:4], mins_h), (maxes_l[:4], mins_l)))
+        if ss_live:
+            lo_norms_ok &= maxes_h[4] == maxes_l[4]
+        use_multi = use_multi_pre & (placed_multi > 0) & lo_norms_ok
 
         # head fallback: serial's single next pick is always exact
-        heads = jnp.where(F, table[:, 0], -jnp.inf)
-        any_head = jnp.any(F)
+        heads = jnp.where(F_start, table[:, 0], -jnp.inf)
+        any_head = jnp.any(F_start)
         head_pick = jnp.zeros(N, jnp.int32).at[jnp.argmax(heads)].set(1)
-        use_head = (m_take == 0) & any_head & (m_rem > 0)
-        counts = jnp.where(use_head, head_pick, counts)
-        m_take = jnp.where(use_head, jnp.int32(1), m_take)
+        use_head = ~use_multi & any_head & (m_rem > 0)
+        counts = jnp.where(use_multi, counts_multi,
+                           jnp.where(use_head, head_pick, 0))
+        m_take = jnp.where(use_multi, placed_multi,
+                           jnp.where(use_head, jnp.int32(1), jnp.int32(0)))
 
-        # fold the taken placements into the live terms' counters
-        inc = jnp.zeros((Sd, D + 1), _F32)
-        inc = inc.at[jnp.arange(Sd)[:, None], dom_rows].add(
-            counts.astype(_F32)[None, :] * live[:, None])
-        # sentinel column never counts (commit() masks dom >= D)
-        inc = inc * (jnp.arange(D + 1)[None, :] < D)
-        cnt = cnt + inc
-        return (j + counts, cnt, placed + m_take, m_take)
+        # fold the takes into every live counter/carrier row (sentinel column
+        # never counts — commit() masks dom >= D)
+        cf = counts.astype(_F32)
+        col_real = (iota_d[None, :] < D)
 
-    def cond(state):
-        _, _, placed, last = state
-        return (last > 0) & (placed < m)
+        def upd(rows, doms, incs):
+            S = rows.shape[0]
+            add = jnp.zeros_like(rows).at[
+                jnp.arange(S)[:, None], doms].add(cf[None, :] * incs[:, None])
+            return rows + add * col_real
 
-    j0 = jnp.zeros(N, jnp.int32)
-    j, _, placed, _ = jax.lax.while_loop(
-        cond, body, (j0, cnt0, jnp.int32(0), jnp.int32(1)))
-    return _aggregate_commit(tb, cry, g, j, False), j, placed
+        return AffinityWaveState(
+            j + counts,
+            upd(cnt_dns, dom_dns, inc_dns),
+            upd(cnt_aff, dom_aff, inc_aff),
+            upd(cnt_anti, dom_anti, inc_anti),
+            upd(cnt_car, dom_car, inc_car),
+            upd(cnt_cw, dom_cw, inc_cw),
+            upd(cnt_ss, dom_ss, ss_match),
+            placed + m_take, m_take)
+
+    def cond(state: AffinityWaveState):
+        return (state.last > 0) & (state.placed < m)
+
+    final = jax.lax.while_loop(cond, body, AffinityWaveState(
+        jnp.zeros(N, jnp.int32), cnt_dns0, cnt_aff0, cnt_anti0, cnt_car0,
+        cnt_cw0, cnt_ss0, jnp.int32(0), jnp.int32(1)))
+    return (_aggregate_commit(tb, cry, g, final.j, False), final.j,
+            final.placed)
 
 
 @partial(jax.jit, static_argnames=("w", "filters", "ss_live", "sa_live", "n_zones"))
@@ -1444,13 +1850,13 @@ def _mask_active(tb: Tables, active) -> Tables:
     return tb._replace(static_mask=tb.static_mask & active[None, :])
 
 
-@partial(jax.jit, static_argnames=("gpu_live", "w", "filters", "block"))
+@partial(jax.jit, static_argnames=("gpu_live", "w", "filters", "block", "kmax"))
 @shaped(active_s="[S, N] bool", g="[] i32", m="[] i32", cap1="[] bool")
 def probe_wave_fanout(tb: Tables, cry_s: Carry, active_s, g, m, cap1,
                       gpu_live: bool = False,
                       w: ScoreWeights = DEFAULT_WEIGHTS,
                       filters: FilterFlags = DEFAULT_FILTERS,
-                      block: int = WAVE_BLOCK):
+                      block: int = WAVE_BLOCK, kmax: int = 0):
     """schedule_wave over S candidate node-active masks in one dispatch.
     cry_s is a Carry whose leaves carry a leading [S] axis. Returns
     (carry_s, placed_s [S] i32)."""
@@ -1458,7 +1864,7 @@ def probe_wave_fanout(tb: Tables, cry_s: Carry, active_s, g, m, cap1,
     def one(cry: Carry, active):
         c2, _, placed = schedule_wave(
             _mask_active(tb, active), cry, g, m, cap1,
-            gpu_live=gpu_live, w=w, filters=filters, block=block)
+            gpu_live=gpu_live, w=w, filters=filters, block=block, kmax=kmax)
         return c2, placed
 
     return jax.vmap(one)(cry_s, active_s)
@@ -1479,6 +1885,25 @@ def probe_group_serial_fanout(tb: Tables, cry_s: Carry, active_s, g, valid, cap1
             _mask_active(tb, active), cry, g, valid, cap1,
             w=w, filters=filters, ss_live=ss_live, sa_live=sa_live,
             n_zones=n_zones)
+        return c2, placed
+
+    return jax.vmap(one)(cry_s, active_s)
+
+
+@partial(jax.jit, static_argnames=("ss_live", "w", "filters", "block", "n_zones"))
+@shaped(active_s="[S, N] bool", g="[] i32", m="[] i32", cap1="[] bool")
+def probe_affinity_wave_fanout(tb: Tables, cry_s: Carry, active_s, g, m, cap1,
+                               ss_live: bool = False,
+                               w: ScoreWeights = DEFAULT_WEIGHTS,
+                               filters: FilterFlags = DEFAULT_FILTERS,
+                               block: int = WAVE_BLOCK, n_zones: int = 2):
+    """schedule_affinity_wave over S candidate node-active masks in one
+    dispatch. Returns (carry_s, placed_s [S] i32)."""
+
+    def one(cry: Carry, active):
+        c2, _, placed = schedule_affinity_wave(
+            _mask_active(tb, active), cry, g, m, cap1, ss_live=ss_live,
+            w=w, filters=filters, block=block, n_zones=n_zones)
         return c2, placed
 
     return jax.vmap(one)(cry_s, active_s)

@@ -75,13 +75,15 @@ def table_shardings(mesh: Mesh) -> kernels.Tables:
         simon_raw=s(n), nodeaff_raw=s(n), taint_raw=s(n), avoid_raw=s(n),
         image_raw=s(n), extra_raw=s(n),
         grp_requests=s(r), grp_nonzero=s(r), grp_unknown=s(r), grp_ports=s(r),
-        counter_dom=s(n), counter_sel_match_g=s(r),
+        counter_dom=s(n), counter_topo=s(r), topo_dom=s(n),
+        counter_sel_match_g=s(r),
         req_aff_t=s(r), grp_aff_self=s(r), req_anti_t=s(r),
         pref_t=s(r), pref_w=s(r),
         dns_t=s(r), dns_maxskew=s(r), dns_self=s(r), dns_edom=s(r),
         sa_t=s(r), sa_maxskew=s(r), sa_self=s(r),
         ss_t=s(r), ss_skip=s(r),
-        carr_dom=s(n), carr_anti_t=s(r), carr_w_t=s(r), carr_w_w=s(r),
+        carr_dom=s(n), carr_topo=s(r),
+        carr_anti_t=s(r), carr_w_t=s(r), carr_w_w=s(r),
         grp_carries=s(r),
         grp_gpu_mem=s(r), grp_gpu_num=s(r), grp_gpu_pre=s(r), grp_gpu_take=s(r),
         dev_total=s(P(NODE_AXIS, None)),

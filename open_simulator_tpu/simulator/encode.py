@@ -751,14 +751,14 @@ class Encoder:
                     raw[i] = 0.0
         return raw
 
-    def _image_raw(self, pod: dict) -> np.ndarray:
-        """ImageLocality (imagelocality plugin): scaled sum of present image sizes,
-        normalized over [23MB, 1000MB x numContainers] (calculatePriority scales
-        the max threshold per container, image_locality.go:82-91). Zero when
-        nodes advertise no images."""
-        mb = 1024 * 1024
-        n_containers = max(1, len((pod.get("spec") or {}).get("containers") or []))
-        min_t, max_t = 23 * mb, 1000 * mb * n_containers
+    def _node_image_sizes(self) -> Tuple[List[Dict[str, float]], bool]:
+        """Per-node image-name → size maps, built ONCE per encoder: they are
+        group-independent, and rebuilding them per group made ImageLocality
+        the dominant encode cost on many-group batches (41 groups × 5k nodes
+        of dict parsing ≈ 0.75s on the hard-predicate bench)."""
+        cached = getattr(self, "_image_sizes_cache", None)
+        if cached is not None:
+            return cached
         sizes: List[Dict[str, float]] = []
         have_any = False
         for node in self.na.nodes:
@@ -769,6 +769,18 @@ class Encoder:
             if m:
                 have_any = True
             sizes.append(m)
+        self._image_sizes_cache = (sizes, have_any)
+        return sizes, have_any
+
+    def _image_raw(self, pod: dict) -> np.ndarray:
+        """ImageLocality (imagelocality plugin): scaled sum of present image sizes,
+        normalized over [23MB, 1000MB x numContainers] (calculatePriority scales
+        the max threshold per container, image_locality.go:82-91). Zero when
+        nodes advertise no images."""
+        mb = 1024 * 1024
+        n_containers = max(1, len((pod.get("spec") or {}).get("containers") or []))
+        min_t, max_t = 23 * mb, 1000 * mb * n_containers
+        sizes, have_any = self._node_image_sizes()
         raw = np.zeros(self.na.N, np.float32)
         if not have_any:
             return raw
@@ -839,6 +851,8 @@ class BatchTables:
     grp_ports: np.ndarray        # [G, PP] i32 (0 = pad)
     # counters
     counter_dom: np.ndarray      # [T, N] i32 (domain id; D = key-absent sentinel)
+    counter_topo: np.ndarray     # [T] i32: unique-topology row per counter
+    topo_dom: np.ndarray         # [U, N] i32: node→domain per unique topo key
     counter_sel_match_g: np.ndarray  # [T, G] bool: does a group pod match counter t
     req_aff_t: np.ndarray        # [G, A] i32 (-1 pad)
     grp_aff_self: np.ndarray     # [G] bool
@@ -856,6 +870,7 @@ class BatchTables:
     ss_skip: np.ndarray          # [G] bool (explicit constraints → plugin skipped)
     # carriers
     carr_dom: np.ndarray         # [Tc, N] i32
+    carr_topo: np.ndarray        # [Tc] i32: unique-topology row per carrier
     carr_anti_t: np.ndarray      # [G, Ca] i32: anti carrier ids matching g (-1 pad)
     carr_w_t: np.ndarray         # [G, Cw] i32: weighted carrier ids for g (-1 pad)
     carr_w_w: np.ndarray         # [G, Cw] f32: those weights
@@ -960,6 +975,7 @@ def pad_batch_tables(bt: "BatchTables", multiple: int) -> "BatchTables":
         image_raw=_pad_axis(bt.image_raw, 1, target, 0.0),
         extra_raw=_pad_axis(bt.extra_raw, 1, target, 0.0),
         counter_dom=_pad_axis(bt.counter_dom, 1, target, D),
+        topo_dom=_pad_axis(bt.topo_dom, 1, target, D),
         carr_dom=_pad_axis(bt.carr_dom, 1, target, D),
         dev_total=_pad_axis(bt.dev_total, 0, target, 0.0),
         vg_cap=_pad_axis(bt.vg_cap, 0, target, 0.0),
@@ -1062,10 +1078,18 @@ def pad_encoder_axes(bt: "BatchTables") -> "BatchTables":
         sa_self=pad_axis(pad_axis(bt.sa_self, 0, Gp, 0.0), 1, _bucket(bt.sa_self.shape[1]), 0.0),
         # T axis
         counter_dom=pad_axis(pad_dom(bt.counter_dom), 0, Tp, Dp),
+        # pad counter/carrier rows point at the all-sentinel topology row
+        # (the last real row by construction), pad topology rows are all-
+        # sentinel themselves — neither can ever accumulate
+        counter_topo=pad_axis(bt.counter_topo, 0, Tp,
+                              bt.topo_dom.shape[0] - 1),
+        topo_dom=pad_axis(pad_dom(bt.topo_dom), 0,
+                          _bucket(bt.topo_dom.shape[0]), Dp),
         counter_sel_match_g=pad_axis(pad_axis(bt.counter_sel_match_g, 0, Tp, False), 1, Gp, False),
         seed_counter=pad_axis(pad_counter_width(bt.seed_counter), 0, Tp, 0.0),
         # Tc axis
         carr_dom=pad_axis(pad_dom(bt.carr_dom), 0, Tcp, Dp),
+        carr_topo=pad_axis(bt.carr_topo, 0, Tcp, bt.topo_dom.shape[0] - 1),
         carr_sel_match_g=pad_axis(pad_axis(bt.carr_sel_match_g, 0, Tcp, False), 1, Gp, False),
         seed_carrier=pad_axis(pad_counter_width(bt.seed_carrier), 0, Tcp, 0.0),
         # PORT axis
@@ -1240,6 +1264,36 @@ def build_node_axis_tables(
     for t, dom in enumerate(carr_dom_raw):
         carr_dom[t] = np.where(dom >= 0, dom, D)
 
+    # Topology group-id tensors: counters/carriers sharing a topology key
+    # share their entire domain row, so the wave kernels segment-reduce
+    # per-node counts once per UNIQUE topology ([U, N]) and broadcast to the
+    # [T]/[Tc] rows — _aggregate_commit's per-row T×N scatter was the
+    # dominant per-segment fixed cost at 5k nodes. Row U-1 is always the
+    # all-sentinel topology, which pad rows and empty tables point at.
+    topo_ids: Dict[str, int] = {}
+    topo_rows: List[np.ndarray] = []
+
+    def topo_of(key: str, dom_row: np.ndarray) -> int:
+        got = topo_ids.get(key)
+        if got is None:
+            got = topo_ids[key] = len(topo_rows)
+            topo_rows.append(np.where(dom_row >= 0, dom_row, D).astype(np.int32))
+        return got
+
+    counter_topo = np.zeros(T, np.int32)
+    for t, cs in enumerate(enc.counter_list):
+        counter_topo[t] = topo_of(cs.topo_key, counter_dom_raw[t])
+    carr_topo = np.zeros(Tc, np.int32)
+    for t, cs in enumerate(enc.carrier_list):
+        carr_topo[t] = topo_of(cs.topo_key, carr_dom_raw[t])
+    sentinel_row = len(topo_rows)
+    topo_rows.append(np.full(N, D, np.int32))
+    if not enc.counter_list:
+        counter_topo[:] = sentinel_row
+    if not enc.carrier_list:
+        carr_topo[:] = sentinel_row
+    topo_dom = np.stack(topo_rows)
+
     Sd = max((len(g.spread_dns) for g in groups), default=0)
     dns_edom = np.zeros((G, max(1, Sd), D + 1), bool)
     for gi, g in enumerate(groups):
@@ -1327,7 +1381,10 @@ def build_node_axis_tables(
         image_raw=stack("image_raw"),
         extra_raw=stack("extra_raw"),
         counter_dom=counter_dom,
+        counter_topo=counter_topo,
+        topo_dom=topo_dom,
         carr_dom=carr_dom,
+        carr_topo=carr_topo,
         dns_edom=dns_edom,
         grp_gpu_pre=grp_gpu_pre,
         grp_gpu_take=grp_gpu_take,
@@ -1440,6 +1497,11 @@ def extend_node_axis(
         image_raw=rep_col(bt.image_raw),
         extra_raw=rep_col(bt.extra_raw),
         counter_dom=dom_ext(bt.counter_dom, hostname_counters),
+        # hostname TOPOLOGY rows get the same fresh per-node domains as the
+        # hostname counter/carrier rows that reference them
+        topo_dom=dom_ext(bt.topo_dom, sorted({
+            int(bt.counter_topo[t]) for t in hostname_counters
+        } | {int(bt.carr_topo[t]) for t in hostname_carriers})),
         carr_dom=dom_ext(bt.carr_dom, hostname_carriers),
         dns_edom=widen(bt.dns_edom),
         dev_total=rep_row(bt.dev_total),

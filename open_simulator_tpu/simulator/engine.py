@@ -28,7 +28,7 @@ import copy
 import dataclasses
 import functools
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -73,6 +73,18 @@ _jnp = None  # lazy jax import so host-only paths (ingestion, reports) stay jax-
 WAVE_MIN = 8
 
 _UNSET = object()  # Simulator._mesh sentinel: mesh decision not yet made
+
+
+class GroupRoute(NamedTuple):
+    """One group's kernel routing decision (see Simulator._wave_eligibility):
+    kind "wave" → schedule_wave, "affinity" → schedule_affinity_wave,
+    "spread" → schedule_group_serial, None → the general serial scan."""
+
+    kind: Optional[str]
+    cap1: bool
+    gpu_live: bool
+    ss_live: bool
+    sa_live: bool
 
 
 def _jax():
@@ -232,15 +244,29 @@ class Simulator:
         # simulator's life to the CPU fallback after a containment.
         self.backend_path: List[str] = []
         self._fallback = False
-        self._wave_elig_cache: Dict[int, Tuple[bool, ...]] = {}
+        # routing cache, keyed by a flags/weights digest so mutating
+        # filter_flags/score_w on a reused Simulator can never return stale
+        # routes (_route_digest; the stale-cache regression test covers it)
+        self._wave_elig_cache: Dict[int, GroupRoute] = {}
+        self._wave_elig_key: tuple = ()
         self._domain_count_cache: Dict[str, int] = {}  # topo key → #domains
         import os as _os
 
+        # Break-even fallback: live-DNS groups whose every self topology has
+        # fewer than this many domains ride the fused group-serial scan
+        # instead of the affinity wave. Default 0: the wave's multi-round
+        # epochs amortize one sort over the whole segment, so it wins at all
+        # cardinalities measured; the knob remains for backends where that
+        # trade flips (placements are exact on either path).
         try:
             self._spread_wave_min_domains = int(
-                _os.environ.get("OPEN_SIMULATOR_SPREAD_WAVE_MIN_DOMAINS", "64"))
+                _os.environ.get("OPEN_SIMULATOR_SPREAD_WAVE_MIN_DOMAINS", "0"))
         except ValueError:  # pure-performance knob: fall back, don't crash
-            self._spread_wave_min_domains = 64
+            self._spread_wave_min_domains = 0
+        # Per-segment wall-clock attribution (bench BENCH_DETAIL breakdown):
+        # blocks on every segment's result, so it is OFF unless asked for.
+        self._segment_timing = _os.environ.get(
+            "OPEN_SIMULATOR_SEGMENT_TIMING") == "1"
 
     # ------------------------------------------------------------- state ----------
 
@@ -567,23 +593,50 @@ class Simulator:
         pad = bucket_capped(len(batch), 2048)
         return build_batch_tables(self.encoder, batch, self.placed, self.match_cache, pad_to=pad)
 
-    def _wave_eligibility(self, gi: int) -> Tuple[bool, ...]:
-        """(eligible, cap1, spread_live, gpu_live, ss_live, sa_live,
-        spread_wave) for group gi — see
-        ops/kernels.py schedule_wave / schedule_group_serial. A group is
-        batch-eligible when its placements cannot change any predicate or score
-        input that it reads itself: no storage state and no affinity term
-        whose selector matches the group's own pods. Two self-interactions are
-        exactly per-node capacity-1 clamps (cap1): hostname-topology required
-        self-anti-affinity, and host ports while NodePorts is enabled (the
-        first copy claims the port; the aggregate commit writes the bits).
-        More self-interactions have dedicated kernels: shared-GPU requests
-        (gpu_live → unit-countable wave); self-matching DoNotSchedule spread
-        terms (spread_live), a live SelectorSpread counter (ss_live), and
-        ScheduleAnyway soft spread terms (sa_live) — those three via the
-        fused group-serial scan. A gpu_live group that is also counter-live
-        stays on the general serial path. Non-self-matching DoNotSchedule
-        terms are static during the run and ride the plain wave."""
+    def _route_digest(self) -> tuple:
+        """Everything _wave_eligibility reads besides the (immutable) group:
+        score weights, filter flags, and the break-even knob. Routing cached
+        per group must be invalidated when any of these change on a reused
+        Simulator (mutating filter_flags used to return stale routing)."""
+        return (self.score_w, self.filter_flags, self._spread_wave_min_domains)
+
+    def _wave_eligibility(self, gi: int) -> "GroupRoute":
+        """Route group gi to its scheduling kernel — see ops/kernels.py.
+
+        kind="wave": the group's placements cannot change any predicate or
+        score input it reads itself (no storage state, no live counter/term),
+        so schedule_wave commits whole score-table prefixes. Two
+        self-interactions are exactly per-node capacity-1 clamps (cap1):
+        hostname-topology required self-anti-affinity, and host ports while
+        NodePorts is enabled. Shared-GPU requests stay unit-countable waves
+        (gpu_live) unless they carry a pre-assigned gpu-index (host-driven →
+        serial).
+
+        kind="affinity": counter-live hard predicates — self-matching
+        DoNotSchedule spread terms at any topology cardinality, required
+        self-affinity (aff_live), non-hostname required self-anti-affinity in
+        either direction (anti_live), and/or a live SelectorSpread score on
+        an unzoned cluster — ride schedule_affinity_wave's epoch-batched
+        multi-round machinery. At most ONE budget-consuming live term (self
+        DNS or self anti) may be present: the multi-round proof does not
+        compose across interacting budgets.
+
+        kind="spread": the fused group-serial scan — ScheduleAnyway terms
+        (sa_live), zoned live SelectorSpread (the zone blend moves with
+        every placement, so wave epochs degenerate to single picks), and
+        multi-term live DNS groups. The knob
+        OPEN_SIMULATOR_SPREAD_WAVE_MIN_DOMAINS=k also reroutes live-DNS
+        groups below k domains here (break-even fallback; default 0 = the
+        wave always runs, placements are exact on either path).
+
+        kind=None: the general serial scan — the parity oracle and the home
+        of storage state, self-matching PREFERRED affinity (its score term
+        moves non-uniformly), gpu+counter-live combinations, and sa_live
+        mixed with affinity liveness."""
+        digest = self._route_digest()
+        if digest != self._wave_elig_key:
+            self._wave_elig_cache.clear()
+            self._wave_elig_key = digest
         got = self._wave_elig_cache.get(gi)
         if got is not None:
             return got
@@ -595,63 +648,76 @@ class Simulator:
         cap1 = False
         spread_live = (any(selfm for _, _, selfm in g.spread_dns)
                        and self.filter_flags.spread)
-        # DNS-only groups can take the epoch-batched spread wave, but it only
-        # pays when each epoch moves many pods — one per eligible min-count
-        # domain — so require every live term's topology to be high-cardinality
-        # (hostname-level spread: ~N domains); few-zone spread stays on the
-        # fused serial scan whose per-step cost is far below an epoch's
-        # OPEN_SIMULATOR_SPREAD_WAVE_MIN_DOMAINS tunes the break-even point
-        # per backend (placements are exact on either path, so routing is
-        # purely a performance choice): epochs move ~#domains pods each, so
-        # they win once the per-iteration cost amortizes — measured at ≥64
-        # domains on the CPU backend; accelerators with launch-bound scan
-        # steps may profit from a lower threshold.
-        spread_wave = spread_live and all(
-            not selfm or self._domain_count(cid) >= self._spread_wave_min_domains
-            for cid, _, selfm in g.spread_dns)
         # shared-GPU groups are unit-countable (kernels.schedule_wave gpu_live)
         # unless they carry a pre-assigned gpu-index (host-driven path → serial)
         gpu_live = g.gpu_mem > 0 and g.gpu_pre_ids is None
         # live SelectorSpread: the default spread selector always matches the
-        # group's own pods, so the score moves with every placement — the
-        # fused group-serial kernel computes it live. A zero SelectorSpread
-        # weight makes the term inert and the group plain-wave eligible.
+        # group's own pods, so the score moves with every placement. A zero
+        # SelectorSpread weight makes the term inert (plain-wave eligible).
         ss_live = g.ss_counter >= 0 and self.score_w.ss != 0
         # soft (ScheduleAnyway) spread terms: counters and relevant-set
         # normalizers move with every placement — live in the fused kernel.
-        # Weight 0 makes the term inert and the group plain-wave eligible.
         sa_live = bool(g.spread_sa) and self.score_w.pts != 0
-        ok = not ((g.gpu_mem > 0 and not gpu_live)
-                  or (gpu_live and (spread_live or ss_live or sa_live))
-                  or g.lvm_sizes or g.sdev_sizes)
-        # host-port groups: the first copy claims the port, so the group is
-        # exactly a capacity-1-per-node wave (conflicts vs other pods are in
-        # the carry's port table; _aggregate_commit writes the claimed bits)
-        if ok and g.ports and self.filter_flags.ports:
-            cap1 = True
-        if ok:
-            for cid in list(g.req_aff) + [c for c, _ in g.pref]:
+        serial = GroupRoute(None, False, False, False, False)
+        if (g.gpu_mem > 0 and not gpu_live) or g.lvm_sizes or g.sdev_sizes:
+            got = serial  # host-mirrored gpu/storage state → serial scan
+        else:
+            # host-port groups: the first copy claims the port, so the group
+            # is exactly a capacity-1-per-node wave (conflicts vs other pods
+            # are in the carry's port table; the aggregate commit writes bits)
+            if g.ports and self.filter_flags.ports:
+                cap1 = True
+            aff_live = anti_live = pref_live = False
+            budget_terms = sum(1 for _, _, selfm in g.spread_dns if selfm
+                               ) if spread_live else 0
+            if self.filter_flags.interpod:
+                for cid in g.req_aff:
+                    if enc.counter_list[cid].matches_pod(tmpl):
+                        aff_live = True
+                for cid in g.req_anti:
+                    cs = enc.counter_list[cid]
+                    if cs.matches_pod(tmpl):
+                        if cs.topo_key == HOSTNAME:
+                            cap1 = True
+                        else:
+                            anti_live = True
+                            budget_terms += 1
+                for cs in g.carried:
+                    if cs.use == "anti" and cs.matches_pod(tmpl):
+                        if cs.topo_key == HOSTNAME:
+                            cap1 = True
+                        else:
+                            anti_live = True
+                            budget_terms += 1
+            for cid, _ in g.pref:
                 if enc.counter_list[cid].matches_pod(tmpl):
-                    ok = False
-                    break
-        if ok:
-            for cid in g.req_anti:
-                cs = enc.counter_list[cid]
-                if cs.matches_pod(tmpl):
-                    if cs.topo_key != HOSTNAME:
-                        ok = False
-                        break
-                    cap1 = True
-        if ok:
-            for cs in g.carried:
-                if cs.matches_pod(tmpl):
-                    if cs.use == "anti" and cs.topo_key == HOSTNAME:
-                        cap1 = True
-                    else:
-                        ok = False
-                        break
-        got = (ok, cap1, ok and spread_live, ok and gpu_live, ok and ss_live,
-               ok and sa_live, ok and spread_wave)
+                    pref_live = True  # live ip SCORE term, weight-signed
+            counter_live = spread_live or ss_live or aff_live or anti_live
+            # zoned SelectorSpread moves the zone blend with every placement:
+            # affinity-wave epochs degenerate to single picks there, while
+            # the fused scan stays one cheap step per pod
+            ss_zoned = ss_live and len(self.na.zones) > 0
+            low_domains = spread_live and not all(
+                not selfm or self._domain_count(cid) >= self._spread_wave_min_domains
+                for cid, _, selfm in g.spread_dns)
+            if pref_live or (gpu_live and (counter_live or sa_live)):
+                got = serial
+            elif aff_live or anti_live:
+                # required-affinity/anti liveness: only the affinity wave
+                # evaluates these gates live; sa scoring does not compose.
+                # Non-composing budget combinations (kernel budget_composes)
+                # degrade to the wave's exact head-pick epochs, still no
+                # worse than the serial scan's [T, N]-gather steps.
+                got = (serial if sa_live
+                       else GroupRoute("affinity", cap1, False, ss_live, False))
+            elif sa_live or ss_zoned or budget_terms > 1 or (
+                    spread_live and low_domains):
+                # every disjunct implies dns/ss/sa liveness: fused group-serial
+                got = GroupRoute("spread", cap1, False, ss_live, sa_live)
+            elif spread_live or ss_live:
+                got = GroupRoute("affinity", cap1, False, ss_live, False)
+            else:
+                got = GroupRoute("wave", cap1, gpu_live, False, False)
         self._wave_elig_cache[gi] = got
         return got
 
@@ -666,11 +732,11 @@ class Simulator:
         return got
 
     def _segments(self, bt: BatchTables, P: int) -> List[tuple]:
-        """Split the batch into maximal runs of one (group, forced) pair; eligible
-        runs of >= WAVE_MIN become ('wave', start, len, g, cap1, gpu_live) or
-        ('spread', start, len, g, cap1, ss_live, sa_live, spread_wave)
-        segments, the rest coalesce
-        into ('serial', start, len) chunks."""
+        """Split the batch into maximal runs of one (group, forced) pair;
+        routed runs of >= WAVE_MIN become ('wave', start, len, g, cap1,
+        gpu_live), ('affinity', start, len, g, cap1, ss_live), or
+        ('spread', start, len, g, cap1, ss_live, sa_live) segments, the rest
+        coalesce into ('serial', start, len) chunks."""
         pg = np.asarray(bt.pod_group[:P])
         fn = np.asarray(bt.forced_node[:P])
         # vectorized run boundaries: one np.diff pass instead of a per-pod loop
@@ -682,18 +748,21 @@ class Simulator:
         for i, j in zip(starts.tolist(), ends.tolist()):
             g, f = int(pg[i]), int(fn[i])
             run = j - i
-            elig, cap1, spread_live, gpu_live, ss_live, sa_live, spread_wave = (
-                self._wave_eligibility(g) if f < 0
-                else (False,) * 7)
-            if elig and run >= WAVE_MIN:
+            route = (self._wave_eligibility(g) if f < 0
+                     else GroupRoute(None, False, False, False, False))
+            if route.kind is not None and run >= WAVE_MIN:
                 if ser_start is not None:
                     segs.append(("serial", ser_start, i - ser_start))
                     ser_start = None
-                if spread_live or ss_live or sa_live:
-                    segs.append(("spread", i, run, g, cap1, ss_live, sa_live,
-                                 spread_wave))
+                if route.kind == "spread":
+                    segs.append(("spread", i, run, g, route.cap1,
+                                 route.ss_live, route.sa_live))
+                elif route.kind == "affinity":
+                    segs.append(("affinity", i, run, g, route.cap1,
+                                 route.ss_live))
                 else:
-                    segs.append(("wave", i, run, g, cap1, gpu_live))
+                    segs.append(("wave", i, run, g, route.cap1,
+                                 route.gpu_live))
             elif ser_start is None:
                 ser_start = i
         if ser_start is not None:
@@ -778,6 +847,7 @@ class Simulator:
         for seg in segs:
             faults.maybe_fail("dispatch")
             faults.maybe_fail("oom_dispatch")
+            t_seg = time.perf_counter() if self._segment_timing else 0.0
             if seg[0] == "serial":
                 _, start, length = seg
                 pad = bucket_capped(length, 2048)
@@ -792,8 +862,7 @@ class Simulator:
                                     **dims)
                 call = functools.partial(
                     kernels.schedule_batch,
-                    tables, carry, jnp.asarray(pg), jnp.asarray(fn),
-                    jnp.asarray(vd),
+                    tables, carry, pg, fn, vd,
                     n_zones=bt.n_zones, enable_gpu=enable_gpu,
                     enable_storage=enable_storage,
                     w=self.score_w, filters=self.filter_flags,
@@ -801,24 +870,7 @@ class Simulator:
                 carry, ch = guard.supervised(call, site="dispatch", pods=pad)
                 outs.append((seg, ch, carry))
             elif seg[0] == "spread":
-                _, start, length, g, cap1, ss_live, sa_live, spread_wave = seg
-                if spread_wave and not ss_live and not sa_live:
-                    # DNS-only live spread: epoch-batched wave (many pods per
-                    # device iteration) instead of one-pod-per-scan-step
-                    block = kernels.wave_block_for(length, self.na.N)
-                    obs.record_dispatch("schedule_spread_wave", block=block,
-                                        **dims)
-                    call = functools.partial(
-                        kernels.schedule_spread_wave,
-                        tables, carry, jnp.int32(g), jnp.int32(length),
-                        jnp.asarray(cap1), w=self.score_w,
-                        filters=self.filter_flags,
-                        block=block,
-                    )
-                    carry, counts, _ = guard.supervised(
-                        call, site="dispatch", pods=length)
-                    outs.append((seg, counts, carry))
-                    continue
+                _, start, length, g, cap1, ss_live, sa_live = seg
                 pad = bucket_capped(length, 2048)
                 vd = np.zeros(pad, bool)
                 vd[:length] = True
@@ -827,8 +879,7 @@ class Simulator:
                                     zones=bt.n_zones if ss_live else 2, **dims)
                 call = functools.partial(
                     kernels.schedule_group_serial,
-                    tables, carry, jnp.int32(g), jnp.asarray(vd),
-                    jnp.asarray(cap1),
+                    tables, carry, np.int32(g), vd, np.bool_(cap1),
                     w=self.score_w, filters=self.filter_flags,
                     # n_zones only shapes the ss_live zone table; pin it for
                     # DNS-only segments so new zone labels don't recompile them
@@ -838,29 +889,61 @@ class Simulator:
                 carry, counts, _ = guard.supervised(
                     call, site="dispatch", pods=pad)
                 outs.append((seg, counts, carry))
-            else:
-                _, start, length, g, cap1, gpu_live = seg
+            elif seg[0] == "affinity":
+                # counter-live hard predicates (self spread/affinity/anti,
+                # live SelectorSpread): epoch-batched affinity wave instead
+                # of one pod per scan step
+                _, start, length, g, cap1, ss_live = seg
                 block = kernels.wave_block_for(length, self.na.N)
-                obs.record_dispatch("schedule_wave", block=block,
-                                    gpu_live=gpu_live, **dims)
+                obs.record_dispatch("schedule_affinity_wave", block=block,
+                                    ss=ss_live,
+                                    zones=bt.n_zones if ss_live else 2, **dims)
                 call = functools.partial(
-                    kernels.schedule_wave,
-                    tables, carry, jnp.int32(g), jnp.int32(length),
-                    jnp.asarray(cap1), gpu_live=gpu_live,
+                    kernels.schedule_affinity_wave,
+                    tables, carry, np.int32(g), np.int32(length),
+                    np.bool_(cap1), ss_live=ss_live,
                     w=self.score_w, filters=self.filter_flags,
                     block=block,
+                    n_zones=bt.n_zones if ss_live else 2,
                 )
                 carry, counts, _ = guard.supervised(
                     call, site="dispatch", pods=length)
                 outs.append((seg, counts, carry))
+            else:
+                _, start, length, g, cap1, gpu_live = seg
+                block = kernels.wave_block_for(length, self.na.N)
+                kmax = kernels.wave_kmax(length, self.na.N, block)
+                obs.record_dispatch("schedule_wave", block=block, k=kmax,
+                                    gpu_live=gpu_live, **dims)
+                call = functools.partial(
+                    kernels.schedule_wave,
+                    tables, carry, np.int32(g), np.int32(length),
+                    np.bool_(cap1), gpu_live=gpu_live,
+                    w=self.score_w, filters=self.filter_flags,
+                    block=block, kmax=kmax,
+                )
+                carry, counts, _ = guard.supervised(
+                    call, site="dispatch", pods=length)
+                outs.append((seg, counts, carry))
+            if self._segment_timing:
+                # per-kind wall attribution (bench breakdown): forces the
+                # async dispatch to finish, so only ever enabled explicitly
+                import jax as _jax_mod
+
+                _jax_mod.block_until_ready(outs[-1][1])
+                obs.SEGMENT_WALL.labels(kind=seg[0]).inc(
+                    time.perf_counter() - t_seg)
         span.step("dispatch")
         final_carry = carry
         seg_of = np.zeros(P, np.int32)
         if outs:
             faults.maybe_fail("fetch")
+            # every kernel returns i32 counts/choices; fetch each (one
+            # pipeline drain — dispatches are async) and stitch on the host,
+            # avoiding 2 eager device ops per segment
             flat = guard.supervised(
-                lambda: np.asarray(jnp.concatenate(
-                    [a.astype(jnp.int32) for _, a, _ in outs])),
+                lambda: np.concatenate(
+                    [np.asarray(a, np.int32) for _, a, _ in outs]),
                 site="fetch", pods=P)
             off = 0
             for k, (seg, a, _) in enumerate(outs):
@@ -1002,8 +1085,7 @@ class Simulator:
                                     **dims)
                 call = functools.partial(
                     kernels.schedule_batch,
-                    tables, carry, jnp.asarray(pg), jnp.asarray(fn),
-                    jnp.asarray(vd),
+                    tables, carry, pg, fn, vd,
                     n_zones=bt.n_zones, enable_gpu=enable_gpu,
                     enable_storage=enable_storage,
                     w=self.score_w, filters=self.filter_flags,
@@ -1011,22 +1093,7 @@ class Simulator:
                 carry, ch = guard.supervised(call, site="dispatch", pods=pad)
                 placed_parts.append(jnp.sum((ch >= 0).astype(jnp.int32)))
             elif seg[0] == "spread":
-                _, start, length, g, cap1, ss_live, sa_live, spread_wave = seg
-                if spread_wave and not ss_live and not sa_live:
-                    block = kernels.wave_block_for(length, self.na.N)
-                    obs.record_dispatch("schedule_spread_wave", block=block,
-                                        **dims)
-                    call = functools.partial(
-                        kernels.schedule_spread_wave,
-                        tables, carry, jnp.int32(g), jnp.int32(length),
-                        jnp.asarray(cap1), w=self.score_w,
-                        filters=self.filter_flags,
-                        block=block,
-                    )
-                    carry, _, placed = guard.supervised(
-                        call, site="dispatch", pods=length)
-                    placed_parts.append(placed)
-                    continue
+                _, start, length, g, cap1, ss_live, sa_live = seg
                 pad = bucket_capped(length, 2048)
                 vd = np.zeros(pad, bool)
                 vd[:length] = True
@@ -1035,8 +1102,7 @@ class Simulator:
                                     zones=bt.n_zones if ss_live else 2, **dims)
                 call = functools.partial(
                     kernels.schedule_group_serial,
-                    tables, carry, jnp.int32(g), jnp.asarray(vd),
-                    jnp.asarray(cap1),
+                    tables, carry, np.int32(g), vd, np.bool_(cap1),
                     w=self.score_w, filters=self.filter_flags,
                     # n_zones only shapes the ss_live zone table; pin it for
                     # DNS-only segments so new zone labels don't recompile them
@@ -1046,17 +1112,35 @@ class Simulator:
                 carry, _, placed = guard.supervised(
                     call, site="dispatch", pods=pad)
                 placed_parts.append(placed)
+            elif seg[0] == "affinity":
+                _, start, length, g, cap1, ss_live = seg
+                block = kernels.wave_block_for(length, self.na.N)
+                obs.record_dispatch("schedule_affinity_wave", block=block,
+                                    ss=ss_live,
+                                    zones=bt.n_zones if ss_live else 2, **dims)
+                call = functools.partial(
+                    kernels.schedule_affinity_wave,
+                    tables, carry, np.int32(g), np.int32(length),
+                    np.bool_(cap1), ss_live=ss_live,
+                    w=self.score_w, filters=self.filter_flags,
+                    block=block,
+                    n_zones=bt.n_zones if ss_live else 2,
+                )
+                carry, _, placed = guard.supervised(
+                    call, site="dispatch", pods=length)
+                placed_parts.append(placed)
             else:
                 _, start, length, g, cap1, gpu_live = seg
                 block = kernels.wave_block_for(length, self.na.N)
-                obs.record_dispatch("schedule_wave", block=block,
+                kmax = kernels.wave_kmax(length, self.na.N, block)
+                obs.record_dispatch("schedule_wave", block=block, k=kmax,
                                     gpu_live=gpu_live, **dims)
                 call = functools.partial(
                     kernels.schedule_wave,
-                    tables, carry, jnp.int32(g), jnp.int32(length),
-                    jnp.asarray(cap1), gpu_live=gpu_live,
+                    tables, carry, np.int32(g), np.int32(length),
+                    np.bool_(cap1), gpu_live=gpu_live,
                     w=self.score_w, filters=self.filter_flags,
-                    block=block,
+                    block=block, kmax=kmax,
                 )
                 carry, _, placed = guard.supervised(
                     call, site="dispatch", pods=length)

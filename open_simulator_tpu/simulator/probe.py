@@ -495,7 +495,7 @@ class ProbeSession:
                 elif seg[0] == "spread":
                     # dns/sa groups are gated out at build: only a live
                     # SelectorSpread counter routes here (ss_live)
-                    _, start, length, g, cap1, ss_live, sa_live, _ = seg
+                    _, start, length, g, cap1, ss_live, sa_live = seg
                     pad = bucket_capped(length, 2048)
                     vd = np.zeros(pad, bool)
                     vd[:length] = True
@@ -509,15 +509,31 @@ class ProbeSession:
                         ss_live=ss_live, sa_live=sa_live,
                         n_zones=bt.n_zones if ss_live else 2,
                     )
+                elif seg[0] == "affinity":
+                    # counter-live predicates (dns spread is gated out at
+                    # build, so: live SelectorSpread and affinity/anti gates)
+                    _, start, length, g, cap1, ss_live = seg
+                    block = kernels.wave_block_for(length, n_real)
+                    obs.record_dispatch(
+                        "probe_affinity_wave_fanout", block=block, ss=ss_live,
+                        zones=bt.n_zones if ss_live else 2, **dims)
+                    carry_s, placed = kernels.probe_affinity_wave_fanout(
+                        self._tables, carry_s, active,
+                        jnp.int32(g), jnp.int32(length), jnp.asarray(cap1),
+                        ss_live=ss_live, w=sim.score_w,
+                        filters=sim.filter_flags, block=block,
+                        n_zones=bt.n_zones if ss_live else 2,
+                    )
                 else:
                     _, start, length, g, cap1, gpu_live = seg
                     block = kernels.wave_block_for(length, n_real)
+                    kmax = kernels.wave_kmax(length, n_real, block)
                     obs.record_dispatch("probe_wave_fanout", block=block,
-                                        gpu_live=gpu_live, **dims)
+                                        k=kmax, gpu_live=gpu_live, **dims)
                     carry_s, placed = kernels.probe_wave_fanout(
                         self._tables, carry_s, active,
                         jnp.int32(g), jnp.int32(length), jnp.asarray(cap1),
-                        gpu_live=gpu_live, w=sim.score_w,
+                        kmax=kmax, gpu_live=gpu_live, w=sim.score_w,
                         filters=sim.filter_flags,
                         block=block,
                     )

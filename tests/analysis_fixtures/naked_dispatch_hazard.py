@@ -21,6 +21,17 @@ def naked_wave():
     return counts
 
 
+def naked_affinity_wave():
+    # finding: the epoch-batched affinity wave blocks at fetch just the same
+    c, counts, placed = kernels.schedule_affinity_wave(tables, carry, 0, 8, False)
+    return counts
+
+
+def naked_affinity_fanout():
+    # finding: fan-out variant of the affinity wave, also unsupervised
+    return kernels.probe_affinity_wave_fanout(tables, carry, active, 0, 8, False)
+
+
 def naked_feasibility():
     # finding: feasibility dispatch blocks at fetch just the same
     feasible, stages = kernels.feasibility_jit(tables, carry, 0, -1, True)

@@ -1,0 +1,340 @@
+"""Affinity wave == serial scan, placement for placement.
+
+schedule_affinity_wave (ops/kernels.py) extends the epoch-batched wave
+machinery to counter-live hard predicates: required InterPodAffinity (incl.
+the bootstrap special case), required anti-affinity in both directions,
+low-cardinality (zone-level) DoNotSchedule spread, and live SelectorSpread.
+Every test here runs the same pod sequence through a waves-on and a waves-off
+Simulator and compares the per-(node, signature) placement census — the same
+bit-identity contract tests/test_waves.py holds the plain and spread waves to.
+"""
+
+import copy
+
+from open_simulator_tpu.simulator.engine import Simulator
+
+from fixtures import make_node, make_pod
+from test_waves import census_of, replicas, run_both
+
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def zoned(n, n_zones, **kw):
+    return [make_node(f"n{i}", labels={ZONE: f"z{i % n_zones}"}, **kw)
+            for i in range(n)]
+
+
+def with_affinity(pods, app, topo, kind="podAffinity"):
+    for p in pods:
+        aff = p["spec"].setdefault("affinity", {})
+        aff[kind] = {"requiredDuringSchedulingIgnoredDuringExecution": [
+            {"labelSelector": {"matchLabels": {"app": app}},
+             "topologyKey": topo}]}
+    return pods
+
+
+def with_spread(pods, app, max_skew=1, topo=ZONE):
+    for p in pods:
+        p["spec"]["topologySpreadConstraints"] = [{
+            "maxSkew": max_skew, "topologyKey": topo,
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": app}}}]
+    return pods
+
+
+# ------------------------------------------------------------ routing ---------
+
+
+def test_affinity_segments_route_to_the_wave():
+    sim = Simulator(zoned(8, 4, cpu="8"))
+    cases = {
+        "aff": with_affinity(replicas("aff", 10, cpu="100m", memory="128Mi"),
+                             "aff", ZONE),
+        "anti": with_affinity(replicas("anti", 10, cpu="100m", memory="128Mi"),
+                              "anti", ZONE, "podAntiAffinity"),
+        "dns": with_spread(replicas("dns", 10, cpu="100m", memory="128Mi"),
+                           "dns"),
+    }
+    for name, pods in cases.items():
+        bt = sim.encode_batch(copy.deepcopy(pods))
+        segs = sim._segments(bt, len(pods))
+        assert [s[0] for s in segs] == ["affinity"], name
+
+
+def test_wave_elig_cache_invalidated_on_flag_change():
+    """Regression: eligibility is cached per group but reads filter_flags and
+    score weights — mutating them on a reused Simulator must re-route, not
+    return the stale decision."""
+    sim = Simulator(zoned(6, 3, cpu="8"))
+    pods = with_spread(replicas("kc", 8, cpu="100m", memory="128Mi"), "kc")
+    sim.schedule_pods(copy.deepcopy(pods))
+    assert sim._wave_eligibility(0).kind == "affinity"
+    # disabling the spread filter makes the term inert → plain wave
+    sim.filter_flags = sim.filter_flags._replace(spread=False)
+    assert sim._wave_eligibility(0).kind == "wave"
+    sim.filter_flags = sim.filter_flags._replace(spread=True)
+    assert sim._wave_eligibility(0).kind == "affinity"
+    # zeroing the PodTopologySpread score weight flips sa-liveness routing
+    # on a soft-spread group the same way (weights are part of the digest)
+    sa = replicas("sa", 8, cpu="100m", memory="128Mi")
+    for p in sa:
+        p["spec"]["topologySpreadConstraints"] = [{
+            "maxSkew": 1, "topologyKey": ZONE,
+            "whenUnsatisfiable": "ScheduleAnyway",
+            "labelSelector": {"matchLabels": {"app": "sa"}}}]
+    sim.schedule_pods(copy.deepcopy(sa))
+    gi = next(i for i, g in enumerate(sim.encoder.group_list) if g.spread_sa)
+    assert sim._wave_eligibility(gi).kind == "spread"
+    sim.score_w = sim.score_w._replace(pts=0.0)
+    assert sim._wave_eligibility(gi).kind == "wave"
+
+
+# ------------------------------------------- required affinity (podAffinity) --
+
+
+def test_required_self_affinity_zone_bootstrap_and_clump():
+    # empty cluster: the first pod bootstraps anywhere, the rest must clump
+    # into its zone — gate goes live after placement one
+    nodes = zoned(12, 4, cpu="8")
+    pods = with_affinity(replicas("cl", 30, cpu="100m", memory="128Mi"),
+                         "cl", ZONE)
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+    zones_used = {i % 4 for (i, _sig) in wc}
+    assert len(zones_used) == 1  # the clump stayed in one zone
+
+
+def test_required_self_affinity_hostname():
+    nodes = [make_node(f"h{i}", cpu="4") for i in range(9)]
+    pods = with_affinity(replicas("hn", 20, cpu="100m", memory="128Mi"),
+                         "hn", "kubernetes.io/hostname")
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+
+
+def test_required_affinity_seeded_counts_skip_bootstrap():
+    # pre-bound matching pods in two zones: no bootstrap, the gate admits
+    # exactly those zones from the first wave pod on
+    nodes = zoned(12, 4, cpu="8")
+    seed = [make_pod("s0", labels={"app": "sd"}, node_name="n0",
+                     cpu="100m", memory="128Mi"),
+            make_pod("s1", labels={"app": "sd"}, node_name="n1",
+                     cpu="100m", memory="128Mi")]
+    pods = with_affinity(replicas("sd", 24, cpu="100m", memory="128Mi"),
+                         "sd", ZONE)
+    wc, sc, wf, sf = run_both(nodes, [seed, pods])
+    assert wc == sc and wf == sf
+    landed_zones = {i % 4 for (i, _sig) in wc}
+    assert landed_zones <= {0, 1}
+
+
+def test_required_affinity_capacity_pushes_across_nodes():
+    # tiny nodes: the clump must spill across its zone's nodes in serial's
+    # exact order (normalizer sandwich + per-node capacity)
+    nodes = zoned(8, 2, cpu="1", pods="3")
+    pods = with_affinity(replicas("sp", 16, cpu="200m", memory="64Mi"),
+                         "sp", ZONE)
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+
+
+# -------------------------------------------------- anti-affinity directions --
+
+
+def test_self_anti_affinity_zone_one_per_domain():
+    # both directions live (incoming term + carried term) composed into one
+    # budget meter: exactly one pod per zone
+    nodes = zoned(12, 4, cpu="8")
+    pods = with_affinity(replicas("az", 10, cpu="100m", memory="128Mi"),
+                         "az", ZONE, "podAntiAffinity")
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+    assert sum(wc.values()) == 4  # one per zone, six unschedulable
+
+
+def test_existing_pods_anti_affinity_seeded_blocks_zone():
+    # a seeded pod's carried anti term blocks its whole zone for the wave run
+    nodes = zoned(12, 4, cpu="8")
+    seed = with_affinity([make_pod("s0", labels={"app": "ez"},
+                                   node_name="n0", cpu="100m", memory="128Mi")],
+                         "ez", ZONE, "podAntiAffinity")
+    pods = with_affinity(replicas("ez", 8, cpu="100m", memory="128Mi"),
+                         "ez", ZONE, "podAntiAffinity")
+    wc, sc, wf, sf = run_both(nodes, [seed, pods])
+    assert wc == sc and wf == sf
+    assert not any(i % 4 == 0 for (i, _sig) in wc if _sig is not None and i != 0)
+
+
+def test_anti_affinity_against_other_app_static_gate():
+    # anti term tracking a DIFFERENT app stays a static gate (plain wave):
+    # routing must not regress it onto slower paths, placements identical
+    nodes = zoned(8, 4, cpu="8")
+    anchors = [make_pod("an-0", labels={"app": "anchor"}, node_name="n0",
+                        cpu="100m", memory="128Mi"),
+               make_pod("an-1", labels={"app": "anchor"}, node_name="n1",
+                        cpu="100m", memory="128Mi")]
+    pods = with_affinity(replicas("obs", 12, cpu="100m", memory="128Mi"),
+                         "anchor", ZONE, "podAntiAffinity")
+    wc, sc, wf, sf = run_both(nodes, [anchors, pods])
+    assert wc == sc and wf == sf
+    sim = Simulator(copy.deepcopy(nodes))
+    sim.schedule_pods(copy.deepcopy(anchors))
+    bt = sim.encode_batch(copy.deepcopy(pods))
+    segs = sim._segments(bt, len(pods))
+    assert [s[0] for s in segs] == ["wave"]
+
+
+# ------------------------------------------------------------- zone-level DNS --
+
+
+def test_zone_spread_low_cardinality_rides_the_wave():
+    # the hard-predicate bench shape: few zones, DoNotSchedule, self-matching
+    nodes = zoned(15, 5, cpu="4")
+    pods = with_spread(replicas("zs", 60, cpu="100m", memory="128Mi"),
+                       "zs", max_skew=2)
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+    sim = Simulator(copy.deepcopy(nodes))
+    bt = sim.encode_batch(copy.deepcopy(pods))
+    assert [s[0] for s in sim._segments(bt, len(pods))] == ["affinity"]
+
+
+def test_zone_spread_skewed_capacity_binds():
+    nodes = (zoned(6, 1, cpu="4")
+             + [make_node(f"b{i}", labels={ZONE: "z1"}, cpu="4")
+                for i in range(3)]
+             + [make_node("c0", labels={ZONE: "z2"}, cpu="4")])
+    pods = with_spread(replicas("sk", 80, cpu="200m", memory="256Mi"), "sk")
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+    assert sum(wc.values()) < 80  # the one-node zone caps the run
+
+
+def test_zone_spread_odd_epoch_sizes():
+    # prime-ish node/pod/zone counts + maxSkew 1: exercises mid-round m-cuts
+    # and min-rise boundaries on every epoch
+    nodes = zoned(13, 5, cpu="2")
+    pods = with_spread(replicas("odd", 37, cpu="150m", memory="128Mi"), "odd")
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+
+
+def test_zone_spread_seeded_blocked_then_min_rise():
+    # one zone seeded far above the rest starts blocked and is re-admitted
+    # round by round as the min rises — the multi-round budget direction
+    nodes = zoned(9, 3, cpu="16")
+    seed = with_spread([make_pod(f"seed-{i}", labels={"app": "r"},
+                                 node_name="n0", cpu="100m", memory="128Mi")
+                        for i in range(5)], "r", max_skew=2)
+    pods = with_spread(replicas("r", 40, cpu="100m", memory="128Mi"),
+                       "r", max_skew=2)
+    wc, sc, wf, sf = run_both(nodes, [seed, pods])
+    assert wc == sc and wf == sf
+
+
+# ------------------------------------------------------------- mixed groups ---
+
+
+def test_mixed_spread_plus_hostname_self_anti_cap1():
+    nodes = zoned(10, 3, cpu="4")
+    pods = with_spread(replicas("mx", 25, cpu="200m", memory="256Mi"),
+                       "mx", max_skew=2)
+    with_affinity(pods, "mx", "kubernetes.io/hostname", "podAntiAffinity")
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+    assert all(c <= 1 for c in wc.values())  # cap1 held on the wave
+
+
+def test_mixed_affinity_plus_zone_anti_head_fallback():
+    # zone affinity + hostname anti on the same group: the budget terms do
+    # not compose, so the wave degrades to exact head-pick epochs
+    nodes = zoned(12, 4, cpu="8")
+    pods = with_affinity(replicas("mix", 12, cpu="100m", memory="128Mi"),
+                         "mix", ZONE)
+    with_affinity(pods, "mix", "kubernetes.io/hostname", "podAntiAffinity")
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+
+
+def test_mixed_groups_interleaved_batches():
+    # affinity, anti, spread, and plain groups interleaved in one call: the
+    # carries seed each segment from the previous ones in serial order
+    nodes = zoned(12, 4, cpu="8")
+    plain = replicas("pl", 10, cpu="100m", memory="128Mi")
+    aff = with_affinity(replicas("af", 10, cpu="100m", memory="128Mi"),
+                        "af", ZONE)
+    anti = with_affinity(replicas("an", 10, cpu="100m", memory="128Mi"),
+                         "an", ZONE, "podAntiAffinity")
+    dns = with_spread(replicas("dz", 10, cpu="100m", memory="128Mi"), "dz")
+    wc, sc, wf, sf = run_both(nodes, [plain + aff + anti + dns])
+    assert wc == sc and wf == sf
+
+
+def test_probe_pods_counts_affinity_wave_groups():
+    # the probe path dispatches the same affinity-wave segments; its counted
+    # result must equal the number schedule_pods actually places
+    nodes = zoned(12, 4, cpu="8")
+    pods = with_affinity(replicas("pr", 10, cpu="100m", memory="128Mi"),
+                         "pr", ZONE, "podAntiAffinity")
+    probe = Simulator(copy.deepcopy(nodes))
+    scheduled, total = probe.probe_pods(copy.deepcopy(pods))
+    real = Simulator(copy.deepcopy(nodes))
+    failed = real.schedule_pods(copy.deepcopy(pods))
+    assert (scheduled, total) == (len(pods) - len(failed), len(pods))
+    assert scheduled == 4  # one per zone
+    # probing must not materialize placements
+    assert sum(len(p) for p in probe.pods_on_node) == 0
+
+
+def test_probe_affinity_wave_fanout_matches_single_lane():
+    # the capacity prober's vmapped fan-out must equal per-lane dispatches:
+    # lane 0 = all nodes active, lane 1 = half the nodes masked off
+    import numpy as np
+
+    from open_simulator_tpu.ops import kernels
+
+    nodes = zoned(8, 4, cpu="4")
+    pods = with_spread(replicas("fo", 12, cpu="200m", memory="256Mi"),
+                       "fo", max_skew=2)
+    sim = Simulator(copy.deepcopy(nodes))
+    bt = sim.encode_batch(copy.deepcopy(pods))
+    tables, carry = sim._to_device(bt)
+    N = bt.alloc.shape[0]
+    active = np.ones((2, N), bool)
+    active[1, :] = False
+    active[1, :2] = True  # zones 2/3 masked off entirely: skew vs their
+    # (encode-time) eligible domains pins the active zones at maxSkew
+    block = kernels.wave_block_for(len(pods), sim.na.N)
+
+    import jax.numpy as jnp
+
+    carry_s = type(carry)(*(jnp.stack([leaf, leaf]) for leaf in carry))
+    _, placed_s = kernels.probe_affinity_wave_fanout(
+        tables, carry_s, jnp.asarray(active), np.int32(0),
+        np.int32(len(pods)), np.bool_(False),
+        w=sim.score_w, filters=sim.filter_flags, block=block)
+    for lane in range(2):
+        masked = tables._replace(
+            static_mask=tables.static_mask & jnp.asarray(active[lane])[None, :])
+        _, _, placed = kernels.schedule_affinity_wave(
+            masked, carry, np.int32(0), np.int32(len(pods)), np.bool_(False),
+            w=sim.score_w, filters=sim.filter_flags, block=block)
+        assert int(placed_s[lane]) == int(placed), lane
+    assert int(placed_s[1]) < int(placed_s[0])  # masking half costs capacity
+
+
+def test_heterogeneous_nodes_norm_sandwich():
+    # uneven allocatables/odd byte sizes: normalizer values differ per node,
+    # so the sandwich check must actually gate the big takes
+    nodes = [make_node(f"hz{i}", labels={ZONE: f"z{i % 3}"},
+                       cpu=f"{2001 + 997 * i}m",
+                       memory=str((3 << 30) + 7919 * i)) for i in range(9)]
+    pods = with_spread(replicas("hz", 40, cpu="77m", memory=str((128 << 20) + 13)),
+                       "hz", max_skew=2)
+    wc, sc, wf, sf = run_both(nodes, [pods])
+    assert wc == sc and wf == sf
+    aff = with_affinity(replicas("ha", 30, cpu="99m", memory="96Mi"),
+                        "ha", ZONE)
+    wc, sc, wf, sf = run_both(nodes, [aff])
+    assert wc == sc and wf == sf

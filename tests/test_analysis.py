@@ -141,9 +141,10 @@ def test_clean_module_is_clean():
 
 
 def test_naked_dispatch_rule_fires():
-    # three direct kernel dispatches fire; the offline-harness waiver is
-    # reported suppressed, not active
-    assert _counts("naked_dispatch_hazard.py", "naked-dispatch") == 3
+    # five direct kernel dispatches fire (incl. schedule_affinity_wave and
+    # its fan-out variant); the offline-harness waiver is reported
+    # suppressed, not active
+    assert _counts("naked_dispatch_hazard.py", "naked-dispatch") == 5
     assert _counts("naked_dispatch_hazard.py", "naked-dispatch",
                    suppressed=True) == 1
 

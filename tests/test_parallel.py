@@ -178,7 +178,7 @@ def test_engine_mesh_epoch_spread_wave_matches_single_device(monkeypatch):
 
     sim_mesh = Simulator(copy.deepcopy(nodes), use_mesh=True)
     f1 = sim_mesh.schedule_pods(copy.deepcopy(pods))
-    assert sim_mesh._wave_eligibility(0)[-1] is True  # epoch wave routed
+    assert sim_mesh._wave_eligibility(0).kind == "affinity"  # epoch wave routed
     sim_single = Simulator(copy.deepcopy(nodes), use_mesh=False)
     f2 = sim_single.schedule_pods(copy.deepcopy(pods))
     assert census(sim_mesh) == census(sim_single)

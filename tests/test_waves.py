@@ -347,13 +347,14 @@ def test_spread_wave_two_constraints():
 
 
 def test_spread_wave_segments_are_waves():
-    # the segmentation classifies a self-matching dns group as a spread segment
+    # the segmentation routes a self-matching dns group onto the epoch-batched
+    # affinity wave (any topology cardinality since the multi-round epochs)
     nodes = zoned_nodes([2, 2])
     sim = Simulator(copy.deepcopy(nodes))
     pods = spread_replicas("seg", 12, cpu="100m", memory="128Mi")
     bt = sim.encode_batch(copy.deepcopy(pods))
     segs = sim._segments(bt, len(pods))
-    assert [s[0] for s in segs] == ["spread"]
+    assert [s[0] for s in segs] == ["affinity"]
 
 
 # ------------------------------------------------------------------- gpu waves ----
@@ -830,9 +831,11 @@ def test_spread_epoch_wave_preloaded_nodes_budget_checked():
 
 
 def test_spread_wave_threshold_env_knob(monkeypatch):
-    """OPEN_SIMULATOR_SPREAD_WAVE_MIN_DOMAINS reroutes few-domain spread
-    groups onto the epoch wave — placements must not change (routing is
-    purely a performance choice), and malformed values fall back silently."""
+    """OPEN_SIMULATOR_SPREAD_WAVE_MIN_DOMAINS is the break-even fallback:
+    live-DNS groups below the threshold reroute onto the fused group-serial
+    scan — placements must not change (routing is purely a performance
+    choice), and malformed values fall back silently to the default (0 =
+    the affinity wave always runs)."""
     nodes = [make_node(f"kn{i}", labels={"topology.kubernetes.io/zone": f"z{i % 3}"})
              for i in range(9)]
     pods = replicas("kn", 18, cpu="200m", memory="256Mi", labels={"app": "kn"})
@@ -851,14 +854,13 @@ def test_spread_wave_threshold_env_knob(monkeypatch):
                                raising=False)
         sim = Simulator(copy.deepcopy(nodes))
         failed = sim.schedule_pods(copy.deepcopy(pods))
-        elig = sim._wave_eligibility(0)
-        return census_of(sim), len(failed), elig[-1]  # spread_wave flag
+        return census_of(sim), len(failed), sim._wave_eligibility(0).kind
 
     default_c, default_f, default_route = run(None)
-    assert default_route is False  # 3 zones < 64: fused scan
-    low_c, low_f, low_route = run("2")
-    assert low_route is True       # forced onto the epoch wave
-    assert (low_c, low_f) == (default_c, default_f)  # placements identical
+    assert default_route == "affinity"  # default 0: the wave always runs
+    high_c, high_f, high_route = run("64")
+    assert high_route == "spread"       # 3 zones < 64: fused scan fallback
+    assert (high_c, high_f) == (default_c, default_f)  # placements identical
     bad_c, bad_f, bad_route = run("not-a-number")
-    assert bad_route is False      # malformed → default threshold
+    assert bad_route == "affinity"      # malformed → default threshold
     assert (bad_c, bad_f) == (default_c, default_f)
