@@ -330,6 +330,48 @@ def _row_hard():
     return row
 
 
+def _row_xray_overhead():
+    """xray acceptance row: the flight recorder's wall-time overhead on the
+    100k-pod unconstrained bench (budget: <= 15%). Runs the workload warm
+    with recording OFF then ON (same synth config; trace spills to a temp
+    prefix, JSONL + npz included in the measured time) and reports the
+    fraction plus the recorder's own record counts."""
+    import tempfile
+
+    from open_simulator_tpu.obs import xray
+
+    rate_off, placed_off, total_off, dt_off = bench_throughput(
+        10_000, 100_000, repeats=1)
+    prefix = os.path.join(tempfile.mkdtemp(prefix="bench-xray-"), "trace")
+    xray.enable(prefix)
+    try:
+        rate_on, placed_on, total_on, dt_on = bench_throughput(
+            10_000, 100_000, repeats=1)
+    finally:
+        rec = xray.active()
+        counts = rec.counts() if rec is not None else {}
+        xray.disable()
+    frac = (dt_on - dt_off) / dt_off if dt_off else 0.0
+    return {
+        "metric": "xray_overhead_frac_100k_pods_10k_nodes",
+        "value": round(frac, 4), "unit": "fraction",
+        # budget-relative: >= 1.0 means within the 15% acceptance budget
+        "vs_baseline": round(0.15 / frac, 4) if frac > 0 else 99.0,
+        "budget_frac": 0.15, "within_budget": frac <= 0.15,
+        "wall_off_s": round(dt_off, 3), "wall_on_s": round(dt_on, 3),
+        "pods_per_sec_off": round(rate_off, 1),
+        "pods_per_sec_on": round(rate_on, 1),
+        # scheduled/total COUNT parity only — per-pod placement bit-identity
+        # is asserted by tools/xray_smoke.py and tests/test_xray.py
+        "scheduled_counts_match": (placed_on == placed_off
+                                   and total_on == total_off),
+        "decision_records": counts.get("pods"),
+        "decision_sets": counts.get("sets"),
+        "trace_bytes": (os.path.getsize(prefix + ".jsonl")
+                        if os.path.exists(prefix + ".jsonl") else 0),
+    }
+
+
 def _row_agreement():
     rate, total = bench_placement_agreement()
     return {
@@ -379,6 +421,7 @@ METRICS = [
     ("throughput_10k_1k", _row_throughput_10k_1k, 900, True),
     ("gpushare", _row_gpushare, 900, True),
     ("hard", _row_hard, 1800, True),
+    ("xray_overhead", _row_xray_overhead, 1800, True),
     ("agreement", _row_agreement, 1800, True),
     ("mesh8", _row_mesh8, 1200, False),
     ("capacity", _row_capacity, 1800, True),

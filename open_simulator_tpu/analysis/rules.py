@@ -10,6 +10,7 @@ Rule ids (stable — they appear in suppression comments and CI output):
   metric-in-jit      metrics-registry mutation or wall-clock read under trace
   swallowed-exception  broad except that neither re-raises, returns, logs, nor counts
   naked-dispatch     device-computation call site bypassing the simonguard watchdog
+  fetch-in-wave-loop device->host fetch inside a per-segment/epoch/round loop body
 
 Every rule is a pure function ModuleContext -> List[Finding]; file IO,
 suppressions, and exit-code policy live in runner.py.
@@ -503,7 +504,7 @@ _DISPATCH_KERNELS = {
     "schedule_batch", "schedule_wave", "schedule_affinity_wave",
     "schedule_group_serial", "probe_serial_fanout",
     "probe_group_serial_fanout", "probe_wave_fanout",
-    "probe_affinity_wave_fanout", "feasibility_jit",
+    "probe_affinity_wave_fanout", "feasibility_jit", "explain_jit",
 }
 
 
@@ -596,6 +597,84 @@ def rule_naked_dispatch(ctx: ModuleContext) -> List[Finding]:
                 f"— a wedged backend would hang here with no watchdog, "
                 f"quarantine, or failover (wrap the dispatch, or whitelist "
                 f"non-hot-path harness code)",
+            ))
+    return out
+
+
+# ---------------------------------------------------------- fetch-in-wave-loop --
+
+# Loop-name fragments marking per-segment / per-epoch / per-round dispatch
+# loops (the engine's `for seg in segs:` dispatch loop, the wave kernels'
+# epoch machinery mirrored on the host, capacity-search rounds). A fetch
+# inside such a body pays one full device round trip PER ITERATION — the
+# exact tunnel-latency hazard the PR 3 "fetch ONE concatenated result at the
+# end" rewrite removed, and the one xray-style instrumentation most easily
+# reintroduces.
+_WAVE_LOOP_NAMES = ("seg", "epoch", "round", "wave")
+
+# Resolved call targets that force a device→host sync when applied to a
+# device value. jnp.* stays device-side and is deliberately absent.
+_FETCH_CALLS = {
+    "numpy.asarray", "numpy.array", "jax.device_get",
+    "jax.block_until_ready",
+}
+_FETCH_ATTRS = {"block_until_ready", "device_get"}
+
+
+def _loopish_names(node: ast.AST) -> Set[str]:
+    """Lower-cased identifier names in a loop's target/iter (For) or test
+    (While) — the signal for 'this iterates segments/epochs/rounds'."""
+    if isinstance(node, ast.For):
+        src: List[ast.AST] = [node.target, node.iter]
+    elif isinstance(node, ast.While):
+        src = [node.test]
+    else:
+        return set()
+    out: Set[str] = set()
+    for expr in src:
+        out |= {n.lower() for n in _names_in(expr)}
+    return out
+
+
+@register(
+    "fetch-in-wave-loop", Severity.WARNING,
+    "A device->host fetch (np.asarray / jax.device_get / block_until_ready) "
+    "sits inside a per-segment/per-epoch/per-round loop body. Each "
+    "iteration then pays a full device round trip — behind an accelerator "
+    "tunnel that turns milliseconds of device work into seconds of waiting "
+    "(the engine's dispatch loop collects results and fetches ONE "
+    "concatenated array after the loop for exactly this reason). Move the "
+    "fetch to a post-loop spill point, or whitelist a deliberate blocking "
+    "site with `# simonlint: ignore[fetch-in-wave-loop] -- <why>`.",
+)
+def rule_fetch_in_wave_loop(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    seen: Set[tuple] = set()  # nested wave-named loops report a site once
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        names = _loopish_names(loop)
+        if not any(frag in name for name in names
+                   for frag in _WAVE_LOOP_NAMES):
+            continue
+        for sub in ast.walk(loop):
+            if sub is loop or not isinstance(sub, ast.Call):
+                continue
+            if (sub.lineno, sub.col_offset) in seen:
+                continue
+            r = ctx.resolve(sub.func) or ""
+            leaf = r.split(".")[-1]
+            if r not in _FETCH_CALLS and leaf not in _FETCH_ATTRS:
+                continue
+            seen.add((sub.lineno, sub.col_offset))
+            out.append(Finding(
+                "fetch-in-wave-loop", Severity.WARNING, ctx.path,
+                sub.lineno, sub.col_offset,
+                f"{r or leaf}(...) inside a "
+                f"per-{'/'.join(sorted(names & set(_WAVE_LOOP_NAMES)) or ['segment'])} "
+                f"loop body forces one device round trip per iteration — "
+                f"collect device values and fetch once after the loop "
+                f"(designated spill point)",
             ))
     return out
 

@@ -12,7 +12,7 @@ import json
 import logging
 import os
 import sys
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from .. import __version__
 from ..core import constants as C
@@ -105,11 +105,51 @@ def build_parser() -> argparse.ArgumentParser:
              "JSON file, inline JSON, 'seed=N', or "
              "'site=S,attempt=K,error=E[;...]' (sites: see "
              "open_simulator_tpu.resilience.SITES). Testing/CI only.")
+    p_apply.add_argument(
+        "--xray", action="store_true",
+        help="record per-pod scheduling decision records (simonxray flight "
+             "recorder): segment attribution, per-plugin filter masks and "
+             "score breakdowns, preemption victim chains. Query afterwards "
+             "with `simon explain POD`.")
+    p_apply.add_argument(
+        "--xray-out", default="simon-xray", metavar="PREFIX",
+        help="trace file prefix for --xray (writes PREFIX.jsonl + "
+             "PREFIX.npz; default: simon-xray)")
 
     p_metrics = sub.add_parser(
         "metrics", help="Render a saved metrics snapshot (--metrics-out / "
-                        "--trace-out file) as Prometheus text")
-    p_metrics.add_argument("snapshot", help="snapshot or trace JSON file")
+                        "--trace-out file) as Prometheus text, or diff two "
+                        "snapshots with --diff")
+    p_metrics.add_argument(
+        "snapshot", nargs="+",
+        help="snapshot or trace JSON file (two files with --diff)")
+    p_metrics.add_argument(
+        "--diff", action="store_true",
+        help="render per-metric deltas between TWO dumps (A B: changes from "
+             "A to B), flagging counter regressions — compile-cache misses, "
+             "retries, rollbacks and friends that grew, and counters that "
+             "went backwards (different-process baselines)")
+    p_metrics.add_argument(
+        "--fail-on-regression", action="store_true",
+        help="with --diff: exit 1 when any regression-direction counter grew")
+
+    p_explain = sub.add_parser(
+        "explain", help="Explain one pod's scheduling decision from a "
+                        "simonxray trace (apply --xray): the kube-parity "
+                        "event string, per-plugin filter rejections, and the "
+                        "score breakdown vs the runner-up nodes")
+    p_explain.add_argument("pod", nargs="?", default="",
+                           help="pod to explain ('namespace/name', or a bare "
+                                "name when unambiguous)")
+    p_explain.add_argument(
+        "--trace", default="simon-xray", metavar="PREFIX",
+        help="xray trace prefix or .jsonl path (default: simon-xray)")
+    p_explain.add_argument(
+        "--unscheduled", action="store_true",
+        help="list every unscheduled pod in the trace with its reason "
+             "string instead of explaining one pod")
+    p_explain.add_argument("--json", action="store_true",
+                           help="emit the raw decision record as JSON")
 
     p_parity = sub.add_parser(
         "parity", help="Compute the placement match-rate between two dumps "
@@ -145,6 +185,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--debug-faults", action="store_true",
         help="enable the POST /debug/fault-plan injection endpoint "
              "(testing/CI only; never enable on a production server)")
+    p_server.add_argument(
+        "--xray", action="store_true",
+        help="keep in-memory scheduling decision records and serve them on "
+             "GET /explain/<pod> (+ the unscheduled summary on /debug/vars)")
 
     sub.add_parser("version", help="Print the version of simon")
 
@@ -168,6 +212,11 @@ def cmd_apply(args) -> int:
     trace_out = getattr(args, "trace_out", "")
     metrics_out = getattr(args, "metrics_out", "")
     fault_plan = None
+    xray_on = bool(getattr(args, "xray", False))
+    if xray_on:
+        from ..obs import xray
+
+        xray.enable(getattr(args, "xray_out", "") or "simon-xray")
     try:
         if getattr(args, "fault_plan", ""):
             from ..resilience import FaultPlan, install_plan
@@ -221,6 +270,23 @@ def cmd_apply(args) -> int:
         print(f"apply error: {e}", file=sys.stderr)
         return 1
     finally:
+        if xray_on:
+            # close on FAILED runs too — the partial trace is exactly the
+            # evidence a failed run leaves behind
+            from ..obs import xray
+
+            rec = xray.active()
+            counts = rec.counts() if rec is not None else {}
+            xray.disable()
+            # only point at the trace when something was actually recorded
+            # (the JSONL is opened lazily on the first committed batch)
+            if counts.get("batches"):
+                print(f"xray: {counts.get('pods', 0)} decision records "
+                      f"({counts.get('unscheduled', 0)} unscheduled, "
+                      f"{counts.get('sets', 0)} decision sets) -> "
+                      f"{counts.get('path')}.jsonl; query with "
+                      f"`simon explain POD --trace {counts.get('path')}`",
+                      file=sys.stderr)
         if fault_plan is not None:
             from ..resilience import clear_plan
 
@@ -250,7 +316,8 @@ def cmd_server(args) -> int:
 
     try:
         server = Server(kubeconfig=args.kubeconfig, master=args.master,
-                        debug_faults=True if args.debug_faults else None)
+                        debug_faults=True if args.debug_faults else None,
+                        xray=True if getattr(args, "xray", False) else None)
         if args.grpc_port:
             # same Server object behind both surfaces: the TryLock busy
             # semantics hold across REST and gRPC clients
@@ -270,27 +337,140 @@ def cmd_server(args) -> int:
     return 0
 
 
-def cmd_metrics(args) -> int:
-    """Render a saved registry snapshot (apply --metrics-out, or the metadata
-    of a --trace-out Chrome trace) as Prometheus text on stdout."""
-    from ..obs import render_text_from_snapshot
-
-    try:
-        with open(args.snapshot) as f:
-            doc = json.load(f)
-    except (OSError, ValueError) as e:
-        print(f"metrics error: {e}", file=sys.stderr)
-        return 1
+def _load_metrics_snapshot(path: str) -> dict:
+    """A registry snapshot from a --metrics-out dump or the metadata of a
+    --trace-out Chrome trace. Raises ValueError on anything else."""
+    with open(path) as f:
+        doc = json.load(f)
     if isinstance(doc, dict) and "traceEvents" in doc:
         doc = (doc.get("metadata") or {}).get("metrics")
         if not doc:
-            print("metrics error: trace file carries no metrics snapshot",
-                  file=sys.stderr)
-            return 1
+            raise ValueError(f"{path}: trace file carries no metrics snapshot")
     if not isinstance(doc, dict):
-        print("metrics error: not a metrics snapshot", file=sys.stderr)
+        raise ValueError(f"{path}: not a metrics snapshot")
+    return doc
+
+
+# Counter families whose GROWTH between two runs is a regression signal when
+# comparing bench/CI dumps (everything here counts failures, rework, or
+# compile churn — never useful work).
+_BAD_WHEN_UP = (
+    "simon_compile_cache_misses_total",
+    "simon_xla_backend_compiles_total",
+    "simon_commit_rollbacks_total",
+    "simon_http_errors_total",
+    "simon_retries_total",
+    "simon_deadline_exceeded_total",
+    "simon_faults_injected_total",
+    "simon_guard_watchdog_expiries_total",
+    "simon_guard_oom_bisections_total",
+    "simon_guard_failovers_total",
+    "simon_preemption_replay_pods_total",
+    "simon_xray_dropped_total",
+)
+
+
+def _diff_metrics(snap_a: dict, snap_b: dict, out) -> Tuple[int, int]:
+    """Render per-metric deltas A -> B; returns (changed, regressions)."""
+    from ..obs import values_from_snapshot
+
+    va, vb = values_from_snapshot(snap_a), values_from_snapshot(snap_b)
+    fam_type: dict = {}
+    for snap in (snap_a, snap_b):
+        for name, fam in snap.items():
+            fam_type[name] = fam.get("type", "untyped")
+    # longest-match family lookup: flat keys are name{labels} (+_sum/_count)
+    fams = sorted(fam_type, key=len, reverse=True)
+    changed = regressions = backwards = 0
+
+    def fmt(v: float) -> str:
+        return str(int(v)) if float(v).is_integer() else f"{v:.6g}"
+
+    for key in sorted(set(va) | set(vb)):
+        a, b = va.get(key, 0.0), vb.get(key, 0.0)
+        if a == b:
+            continue
+        changed += 1
+        fam = next((n for n in fams if key.startswith(n)), "")
+        delta = b - a
+        flags = []
+        if fam_type.get(fam) == "counter":
+            if delta < 0:
+                backwards += 1
+                flags.append("counter went backwards (different baseline?)")
+            elif any(fam.startswith(p) for p in _BAD_WHEN_UP):
+                regressions += 1
+                flags.append("REGRESSION")
+        sign = "+" if delta >= 0 else ""
+        print(f"{key}  {fmt(a)} -> {fmt(b)}  ({sign}{fmt(delta)})"
+              + (f"  [{'; '.join(flags)}]" if flags else ""), file=out)
+    print(f"# {changed} metric(s) changed, {regressions} regression(s), "
+          f"{backwards} counter(s) went backwards", file=out)
+    return changed, regressions
+
+
+def cmd_metrics(args) -> int:
+    """Render a saved registry snapshot (apply --metrics-out, or the metadata
+    of a --trace-out Chrome trace) as Prometheus text on stdout — or, with
+    --diff A B, the per-metric deltas between two dumps."""
+    from ..obs import render_text_from_snapshot
+
+    try:
+        if args.diff:
+            if len(args.snapshot) != 2:
+                print("metrics error: --diff needs exactly two snapshot "
+                      "files (A B)", file=sys.stderr)
+                return 1
+            _, regressions = _diff_metrics(
+                _load_metrics_snapshot(args.snapshot[0]),
+                _load_metrics_snapshot(args.snapshot[1]), sys.stdout)
+            return 1 if regressions and args.fail_on_regression else 0
+        if len(args.snapshot) != 1:
+            print("metrics error: one snapshot file expected (use --diff "
+                  "for two)", file=sys.stderr)
+            return 1
+        doc = _load_metrics_snapshot(args.snapshot[0])
+    except (OSError, ValueError) as e:
+        print(f"metrics error: {e}", file=sys.stderr)
         return 1
     sys.stdout.write(render_text_from_snapshot(doc))
+    return 0
+
+
+def cmd_explain(args) -> int:
+    """Explain one pod's scheduling decision from a simonxray trace: the
+    kube-scheduler-parity event line, per-plugin filter rejections, and the
+    chosen-node score breakdown vs the runner-ups."""
+    from ..obs import xray
+
+    if not args.pod and not args.unscheduled:
+        print("explain error: name a pod ('namespace/name') or pass "
+              "--unscheduled", file=sys.stderr)
+        return 1
+    try:
+        tr = xray.XrayTrace.load(args.trace)
+    except (OSError, ValueError) as e:
+        print(f"explain error: {e}", file=sys.stderr)
+        return 1
+    if args.unscheduled:
+        rows = tr.unscheduled_summary()
+        if args.json:
+            print(json.dumps(rows, indent=1))
+        else:
+            for r in rows:
+                print(f"{r['pod']}: {r['reason']}")
+            print(f"# {len(rows)} unscheduled pod(s)")
+        return 0
+    exp = tr.explain(args.pod)
+    if exp is None:
+        print(f"explain error: no decision record for pod {args.pod!r} in "
+              f"{args.trace} (run with --xray, and use 'namespace/name' "
+              "when the bare name is ambiguous)", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(exp, indent=1, default=str))
+    else:
+        print(xray.render_explanation(exp))
     return 0
 
 
@@ -335,6 +515,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     handlers = {
         "apply": cmd_apply,
+        "explain": cmd_explain,
         "lint": cmd_lint,
         "metrics": cmd_metrics,
         "server": cmd_server,

@@ -8,7 +8,13 @@
   kube-scheduler's in PARITY.md) plus the compile-cache dispatch tracker
   and the jax.monitoring backend-compile listener.
 - `obs.chrome` — Chrome trace-event (perfetto-loadable) export of
-  utils/trace.Span trees for `--trace-out FILE.json`.
+  utils/trace.Span trees for `--trace-out FILE.json`, including span
+  annotations (Span.annotate) as event args.
+- `obs.xray` — simonxray, the opt-in per-pod scheduling flight recorder
+  (`--xray` / OPEN_SIMULATOR_XRAY=1): decision records with kube-parity
+  explanations, queryable via `simon explain`, `GET /explain/<pod>`, and
+  the Chrome trace. Imported lazily by consumers (not re-exported here) so
+  the metrics registry stays import-light.
 
 Instrumentation lives on the HOST side of the device boundary by contract:
 the `metric-in-jit` simonlint rule rejects registry mutations or wall-clock
@@ -22,4 +28,5 @@ from .metrics import (  # noqa: F401
     gauge,
     histogram,
     render_text_from_snapshot,
+    values_from_snapshot,
 )

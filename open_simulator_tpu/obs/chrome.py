@@ -22,6 +22,12 @@ from ..utils.trace import Span
 
 
 def _span_events(span: Span, pid: int, out: List[dict]) -> None:
+    # annotations (Span.annotate — e.g. simonxray's per-batch decision
+    # summary) merge into the event args, so each schedule_run span carries
+    # its decision records straight into the perfetto UI
+    args = dict(getattr(span, "meta", None) or {})
+    if span.failed:
+        args["failed"] = True
     out.append({
         "name": span.name,
         "ph": "X",
@@ -30,7 +36,7 @@ def _span_events(span: Span, pid: int, out: List[dict]) -> None:
         "pid": pid,
         "tid": span.tid,
         "cat": "span",
-        "args": ({"failed": True} if span.failed else {}),
+        "args": args,
     })
     # steps are contiguous sub-intervals from the span start (utiltrace
     # semantics: step(i) measures since the previous mark)

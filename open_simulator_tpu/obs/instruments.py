@@ -200,6 +200,24 @@ JOURNAL_REPLAYS = counter(
     "Capacity-search probes skipped because a resumed journal already "
     "held their verdict.")
 
+# ------------------------------------------------------------------- xray -----
+# simonxray (obs/xray.py): both counters are LABELED on purpose — an
+# untouched labeled family renders no samples, so a recording-off run's
+# /metrics and --metrics-out output stays byte-identical to pre-xray builds.
+
+XRAY_RECORDS = counter(
+    "simon_xray_records_total",
+    "Flight-recorder records committed, by kind (batch / pod / set / "
+    "preempt / probe). Zero unless --xray / OPEN_SIMULATOR_XRAY=1.",
+    ("kind",))
+XRAY_DROPPED = counter(
+    "simon_xray_dropped_total",
+    "Flight-recorder records dropped by the bounded-memory caps, by kind "
+    "(set: OPEN_SIMULATOR_XRAY_MAX_SETS; pod_index: the in-memory explain "
+    "index, the JSONL trace keeps everything). Never silent: the first "
+    "drop logs a warning.",
+    ("kind",))
+
 # ---------------------------------------------------------- capacity search ---
 
 CAPACITY_SEARCHES = counter(

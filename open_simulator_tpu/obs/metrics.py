@@ -267,17 +267,26 @@ class Registry:
     def values(self) -> Dict[str, float]:
         """Flat {name{labels}: value} view — /debug/vars and bench rows.
         Histograms flatten to _sum/_count only (buckets stay in snapshot())."""
-        out: Dict[str, float] = {}
-        for name, fam in sorted(self.snapshot().items()):
-            for s in fam["samples"]:
-                ls = _label_str(tuple(sorted(s["labels"])),
-                                tuple(v for _, v in sorted(s["labels"].items())))
-                if fam["type"] == "histogram":
-                    out[f"{name}_sum{ls}"] = s["sum"]
-                    out[f"{name}_count{ls}"] = s["count"]
-                else:
-                    out[f"{name}{ls}"] = s["value"]
-        return out
+        return values_from_snapshot(self.snapshot())
+
+
+def values_from_snapshot(snap: dict) -> Dict[str, float]:
+    """Flat {name{labels}: value} view of a snapshot() dump — shared by
+    Registry.values() and `simon metrics --diff`, so live and saved dumps
+    flatten identically (same sample keys, same histogram _sum/_count
+    treatment) and a diff can line them up one-to-one."""
+    out: Dict[str, float] = {}
+    for name, fam in sorted(snap.items()):
+        for s in fam.get("samples", []):
+            labels = s.get("labels", {})
+            ls = _label_str(tuple(sorted(labels)),
+                            tuple(v for _, v in sorted(labels.items())))
+            if fam.get("type") == "histogram":
+                out[f"{name}_sum{ls}"] = s.get("sum", 0.0)
+                out[f"{name}_count{ls}"] = s.get("count", 0)
+            else:
+                out[f"{name}{ls}"] = s.get("value", 0.0)
+    return out
 
 
 def render_text_from_snapshot(snap: dict) -> str:

@@ -518,13 +518,38 @@ def feasibility(
     return feasible, stages
 
 
-@shaped(g="[] i32", feasible="[N] bool", ret="[N] f32")
-def scores(
+# Per-plugin score components in the EXACT summation order of the original
+# fused total (left-associated adds of weighted terms): summing the dict's
+# entries in this order reproduces the historical `scores` expression tree
+# bit for bit, so the refactor cannot drift placements. simonxray
+# (obs/xray.py) reads the same dict per node for its decision records.
+COMPONENT_ORDER = (
+    "least", "balanced", "openlocal", "simon", "nodeaff", "taint",
+    "interpod", "selector_spread", "topology_spread", "avoid", "image",
+    "extra",
+)
+
+
+def components_total(comp: dict) -> jax.Array:
+    """Fold per-plugin components into the total, preserving the summation
+    order (and therefore the f32 rounding) of the pre-refactor `scores`."""
+    total = comp[COMPONENT_ORDER[0]]
+    for key in COMPONENT_ORDER[1:]:
+        total = total + comp[key]
+    return total
+
+
+@shaped(g="[] i32", feasible="[N] bool")
+def score_components(
     tb: Tables, cry: Carry, g, feasible, n_zones: int, enable_storage: bool = True,
     w: ScoreWeights = DEFAULT_WEIGHTS,
-) -> jax.Array:
-    """Weighted sum of all normalized plugin scores over the feasible set ([N] f32).
-    `w` is STATIC (--default-scheduler-config weights fold in as constants)."""
+) -> dict:
+    """All normalized, WEIGHTED plugin score terms over the feasible set —
+    {name: [N] f32} in COMPONENT_ORDER. `w` is STATIC
+    (--default-scheduler-config weights fold in as constants). The engine's
+    scheduling paths consume the sum (`scores` below, unchanged semantics);
+    the xray flight recorder fetches the dict itself for per-plugin
+    breakdowns of the chosen node and its runner-ups."""
     F = feasible
     alloc_cm = tb.alloc[:, (CPU_I, MEM_I)]
     used = cry.nonzero + tb.grp_nonzero[g][None, :]
@@ -606,21 +631,32 @@ def scores(
     else:
         openlocal = 0.0
 
-    total = (
-        w.least * least
-        + w.balanced * balanced
-        + w.openlocal * openlocal
-        + (w.simon + w.gpushare) * simon  # Open-Gpu-Share Score ≡ Simon Score
-        + w.nodeaff * nodeaff
-        + w.taint * taint
-        + w.interpod * interpod
-        + w.ss * selector_spread
-        + w.pts * pts
-        + w.avoid * tb.avoid_raw[g]
-        + w.image * tb.image_raw[g]
-        + tb.extra_raw[g]  # out-of-tree plugins, pre-weighted at encode time
-    )
-    return total
+    return {
+        "least": w.least * least,
+        "balanced": w.balanced * balanced,
+        "openlocal": w.openlocal * openlocal,
+        "simon": (w.simon + w.gpushare) * simon,  # Open-Gpu-Share Score ≡ Simon Score
+        "nodeaff": w.nodeaff * nodeaff,
+        "taint": w.taint * taint,
+        "interpod": w.interpod * interpod,
+        "selector_spread": w.ss * selector_spread,
+        "topology_spread": w.pts * pts,
+        "avoid": w.avoid * tb.avoid_raw[g],
+        "image": w.image * tb.image_raw[g],
+        "extra": tb.extra_raw[g],  # out-of-tree plugins, pre-weighted at encode time
+    }
+
+
+@shaped(g="[] i32", feasible="[N] bool", ret="[N] f32")
+def scores(
+    tb: Tables, cry: Carry, g, feasible, n_zones: int, enable_storage: bool = True,
+    w: ScoreWeights = DEFAULT_WEIGHTS,
+) -> jax.Array:
+    """Weighted sum of all normalized plugin scores over the feasible set
+    ([N] f32) — `components_total` over `score_components`, summed in the
+    historical order so the split-out components cannot drift the total."""
+    return components_total(
+        score_components(tb, cry, g, feasible, n_zones, enable_storage, w=w))
 
 
 @shaped(g="[] i32", choice="[] i32", do="[] bool")
@@ -705,6 +741,31 @@ feasibility_jit = jax.jit(
     feasibility,
     static_argnames=("enable_gpu", "enable_storage", "include_dns",
                      "include_interpod", "filters"),
+)
+
+
+@shaped(g="[] i32", forced="[] i32", valid="[] bool")
+def explain_pod(
+    tb: Tables, cry: Carry, g, forced, valid, n_zones: int,
+    enable_gpu: bool = True, enable_storage: bool = True,
+    w: ScoreWeights = DEFAULT_WEIGHTS, filters: FilterFlags = DEFAULT_FILTERS,
+):
+    """One fused diagnostics dispatch for the xray flight recorder: the
+    per-stage filter masks, the total score, and the per-plugin score
+    components for one scheduling group against a carry — everything a
+    decision record needs, fetched once per (group, segment) instead of once
+    per pod. Returns (feasible [N] bool, stages {name: [N] bool},
+    total [N] f32, components {name: [N] f32})."""
+    feasible, stages = feasibility(
+        tb, cry, g, forced, valid, enable_gpu, enable_storage, filters=filters)
+    comp = score_components(tb, cry, g, feasible, n_zones, enable_storage, w=w)
+    return feasible, stages, components_total(comp), comp
+
+
+explain_jit = jax.jit(
+    explain_pod,
+    static_argnames=("n_zones", "enable_gpu", "enable_storage", "w",
+                     "filters"),
 )
 
 
@@ -1091,15 +1152,22 @@ class AffinityWaveState(NamedTuple):
     cnt_ss: jax.Array    # [1, D+1] f32: SelectorSpread counter row
     placed: jax.Array    # [] i32
     last: jax.Array      # [] i32: last epoch's take (progress flag)
+    ep_stats: jax.Array  # [3] i32: (epochs run, head-fallback epochs,
+    #                      multi-rounds that took >= 1 entry) — the xray /
+    #                      segment-timing attribution counters; three scalar
+    #                      adds per epoch, negligible against the [N, B] table
 
 
-@partial(jax.jit, static_argnames=("ss_live", "w", "filters", "block", "n_zones"))
+@partial(jax.jit,
+         static_argnames=("ss_live", "w", "filters", "block", "n_zones",
+                          "stats"))
 @shaped(g="[] i32", m="[] i32", cap1="[] bool")
 def schedule_affinity_wave(tb: Tables, cry: Carry, g, m, cap1,
                            ss_live: bool = False,
                            w: ScoreWeights = DEFAULT_WEIGHTS,
                            filters: FilterFlags = DEFAULT_FILTERS,
-                           block: int = WAVE_BLOCK, n_zones: int = 2):
+                           block: int = WAVE_BLOCK, n_zones: int = 2,
+                           stats: bool = False):
     """Epoch-batched wave for groups whose hard predicates read their OWN
     running placements: self-matching DoNotSchedule spread at ANY topology
     cardinality (zone-level included), required InterPodAffinity (incl. the
@@ -1108,7 +1176,10 @@ def schedule_affinity_wave(tb: Tables, cry: Carry, g, m, cap1,
     and a live SelectorSpread score — the serial one-pod-per-cycle process
     reproduced bit-for-bit in a few device iterations per segment instead of
     one scan step per pod. Returns (new carry, per-node counts [N] i32,
-    placed i32).
+    placed i32); with `stats=True` (static — a distinct compiled program, so
+    the engine keys its dispatch signature on it) also a [3] i32 of
+    (epochs, head-fallback epochs, productive multi-rounds) for the xray /
+    Chrome-trace attribution of the fast path.
 
     Exactness architecture (generalizing schedule_wave's argument):
 
@@ -1312,7 +1383,8 @@ def schedule_affinity_wave(tb: Tables, cry: Carry, g, m, cap1,
         return same
 
     def body(state: AffinityWaveState):
-        j, cnt_dns, cnt_aff, cnt_anti, cnt_car, cnt_cw, cnt_ss, placed, _ = state
+        (j, cnt_dns, cnt_aff, cnt_anti, cnt_car, cnt_cw, cnt_ss, placed, _,
+         ep_stats) = state
         avail = capacity - j
         m_rem = (m - placed).astype(jnp.int32)
 
@@ -1487,11 +1559,11 @@ def schedule_affinity_wave(tb: Tables, cry: Carry, g, m, cap1,
         # taken_d counts ENTRIES consumed per domain; cnt units scale by the
         # composed increment (inc_live) where counts are compared
         def round_cond(rs):
-            _, _, got, last_r, _ = rs
+            _, _, got, last_r, _, _ = rs
             return use_multi_pre & (last_r > 0) & (got < m_rem)
 
         def round_body(rs):
-            taken_d, counts_ep, got, _, everb = rs
+            taken_d, counts_ep, got, _, everb, rounds = rs
             cnt_now = cnt_live + taken_d * inc_live
             min_c = jnp.min(jnp.where(edom_live, cnt_now, jnp.inf))
             min_c = jnp.where(jnp.isfinite(min_c), min_c, 0.0)
@@ -1584,7 +1656,8 @@ def schedule_affinity_wave(tb: Tables, cry: Carry, g, m, cap1,
             blocked_d |= use_full & (edom_live | (consumed_d > 0))
             everb = everb | (blocked_d[dom_live] & has_budget)
             taken_d = taken_d + consumed_d * (iota_d < D)
-            return (taken_d, counts_ep + counts_r, got + n_take, n_take, everb)
+            return (taken_d, counts_ep + counts_r, got + n_take, n_take, everb,
+                    rounds + (n_take > 0).astype(jnp.int32))
 
         def round_chain(rs):
             # 4 rounds per device iteration: a drained round is a no-op (zero
@@ -1595,8 +1668,8 @@ def schedule_affinity_wave(tb: Tables, cry: Carry, g, m, cap1,
             return rs
 
         rs0 = (jnp.zeros(D + 1, _F32), jnp.zeros(N, jnp.int32), jnp.int32(0),
-               jnp.int32(1), jnp.zeros(N, bool))
-        _, counts_multi, placed_multi, _, everb = jax.lax.while_loop(
+               jnp.int32(1), jnp.zeros(N, bool), jnp.int32(0))
+        _, counts_multi, placed_multi, _, everb, rounds_run = jax.lax.while_loop(
             round_cond, round_chain, rs0)
 
         # normalizer sandwich: S_lo ⊆ every F_t ⊆ F_hi ⇒ equality at both
@@ -1640,16 +1713,22 @@ def schedule_affinity_wave(tb: Tables, cry: Carry, g, m, cap1,
             upd(cnt_car, dom_car, inc_car),
             upd(cnt_cw, dom_cw, inc_cw),
             upd(cnt_ss, dom_ss, ss_match),
-            placed + m_take, m_take)
+            placed + m_take, m_take,
+            ep_stats + jnp.stack([jnp.int32(1),
+                                  use_head.astype(jnp.int32),
+                                  jnp.where(use_multi, rounds_run,
+                                            jnp.int32(0))]))
 
     def cond(state: AffinityWaveState):
         return (state.last > 0) & (state.placed < m)
 
     final = jax.lax.while_loop(cond, body, AffinityWaveState(
         jnp.zeros(N, jnp.int32), cnt_dns0, cnt_aff0, cnt_anti0, cnt_car0,
-        cnt_cw0, cnt_ss0, jnp.int32(0), jnp.int32(1)))
-    return (_aggregate_commit(tb, cry, g, final.j, False), final.j,
-            final.placed)
+        cnt_cw0, cnt_ss0, jnp.int32(0), jnp.int32(1),
+        jnp.zeros(3, jnp.int32)))
+    out = (_aggregate_commit(tb, cry, g, final.j, False), final.j,
+           final.placed)
+    return out + (final.ep_stats,) if stats else out
 
 
 @partial(jax.jit, static_argnames=("w", "filters", "ss_live", "sa_live", "n_zones"))

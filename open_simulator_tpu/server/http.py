@@ -158,6 +158,7 @@ class Server:
         master: str = "",
         snapshot_fn: Optional[Callable[[], ClusterSnapshot]] = None,
         debug_faults: Optional[bool] = None,
+        xray: Optional[bool] = None,
     ) -> None:
         # /debug/fault-plan is a process-global WRITE endpoint (testing/CI):
         # never enabled by default on a production server. Opt in explicitly
@@ -166,6 +167,18 @@ class Server:
             debug_faults = os.environ.get(
                 "OPEN_SIMULATOR_DEBUG_FAULTS", "") not in ("", "0", "false", "no")
         self.debug_faults = debug_faults
+        # simonxray: opt-in decision recording (constructor, `simon server
+        # --xray`, or OPEN_SIMULATOR_XRAY=1). The server keeps an in-memory
+        # recorder (bounded index, no trace file unless OPEN_SIMULATOR_
+        # XRAY_OUT names one) and serves it on GET /explain/<pod>.
+        if xray is None:
+            xray = os.environ.get(
+                "OPEN_SIMULATOR_XRAY", "") not in ("", "0", "false", "no")
+        self.xray = xray
+        if xray:
+            from ..obs import xray as xray_mod
+
+            xray_mod.enable(os.environ.get("OPEN_SIMULATOR_XRAY_OUT") or None)
         if snapshot_fn is None:
             from ..simulator.live import create_kube_client
 
@@ -406,6 +419,32 @@ class Server:
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)
+                elif self.path.startswith("/explain/"):
+                    # simonxray: one pod's decision record ('/explain/ns/name'
+                    # or a bare unambiguous name), kube-parity event string
+                    # included — the server-side `simon explain`
+                    from urllib.parse import unquote
+
+                    from ..obs import xray as xray_mod
+
+                    rec = xray_mod.active() if server.xray else None
+                    if rec is None:
+                        self._send_err(
+                            404, "xray recording is off (start the server "
+                            "with --xray / OPEN_SIMULATOR_XRAY=1)", "explain")
+                        return
+                    pod = unquote(self.path[len("/explain/"):]).strip("/")
+                    exp = rec.explain(pod)
+                    if exp is None:
+                        self._send_err(
+                            404, f"no decision record for pod {pod!r} (use "
+                            "'namespace/name'; records appear after a "
+                            "deploy/scale simulation runs)", "explain")
+                        return
+                    self._send(200, {
+                        "explanation": exp,
+                        "rendered": xray_mod.render_explanation(exp),
+                    })
                 elif self.path == "/debug/vars":
                     # the profiling surface the reference exposes via pprof
                     # (server.go:152): uptime, rss, recent traced phases, and
@@ -413,10 +452,12 @@ class Server:
                     import resource
 
                     from ..obs import REGISTRY
+                    from ..obs import xray as xray_mod
                     from ..resilience import guard
                     from ..utils.trace import recent_spans
 
                     started = getattr(server, "_t_start", None)
+                    xrec = xray_mod.active() if server.xray else None
                     self._send(200, {
                         "uptime_seconds": (
                             round(time.time() - started, 3) if started else None),
@@ -425,6 +466,15 @@ class Server:
                         # simonguard containment state: quarantined backends,
                         # watchdog config, recent wedge/bisect/failover events
                         "guard": guard.state(),
+                        # simonxray: record counts (incl. the TOTAL
+                        # unscheduled count) + the most recent unscheduled
+                        # pods' kube-parity reasons (bounded sample — the
+                        # full set lives in the trace / `simon explain
+                        # --unscheduled`)
+                        **({"xray": {
+                            **xrec.counts(),
+                            "unscheduled_sample": xrec.unscheduled_summary(),
+                        }} if xrec is not None else {}),
                         "metrics": REGISTRY.values(),
                     })
                 elif self.path == "/debug/fault-plan":

@@ -34,6 +34,7 @@ import numpy as np
 
 from ..core import constants as C
 from ..obs import instruments as obs
+from ..obs import xray
 from ..resilience import faults
 from ..resilience import guard
 from ..core.types import AppResource, NodeStatus, ResourceTypes, SimulateResult, UnscheduledPod
@@ -267,6 +268,11 @@ class Simulator:
         # blocks on every segment's result, so it is OFF unless asked for.
         self._segment_timing = _os.environ.get(
             "OPEN_SIMULATOR_SEGMENT_TIMING") == "1"
+        # simonxray (obs/xray.py): per-attempt staging for the flight
+        # recorder. None unless recording is active — the off path costs one
+        # None-check per schedule/probe call and nothing else (no extra
+        # dispatches, no extra fetches, unchanged dispatch signatures).
+        self._xray_run = None
 
     # ------------------------------------------------------------- state ----------
 
@@ -384,6 +390,11 @@ class Simulator:
         t0 = time.perf_counter()
         try:
             def attempt():
+                # fresh xray staging per ATTEMPT: records of a failed attempt
+                # die with its rolled-back transaction, so a failover replay
+                # never leaves phantom rows (committed records then carry the
+                # full backend_path including the failover)
+                self._xray_run = xray.begin_run("schedule")
                 with self._transaction(memo_pods=pods):
                     if self._track_priorities(pods):
                         from .preemption import schedule_with_preemption
@@ -391,8 +402,11 @@ class Simulator:
                         return schedule_with_preemption(self, pods)
                     return self._schedule_pods_inner(pods)
 
-            return self._run_contained(attempt)
+            result = self._run_contained(attempt)
+            self._xray_commit()
+            return result
         finally:
+            self._xray_run = None
             obs.E2E_SECONDS.observe(time.perf_counter() - t0)
 
     # ------------------------------------------------ guard / failover -------
@@ -447,6 +461,87 @@ class Simulator:
             "device failure contained (%s); failing over to the CPU backend "
             "and replaying the rolled-back batch (backend_path=%s)",
             cause, self.backend_path)
+
+    # ------------------------------------------------------------ xray -------
+
+    def _cfg_digest(self) -> str:
+        """Score-weight / filter-flag digest shared by the dispatch signature
+        (`_dispatch_dims`) and the xray batch records."""
+        return f"{hash((self.score_w, self.filter_flags)) & 0xffffffff:08x}"
+
+    def _xray_commit(self) -> None:
+        """Commit this call's staged decision records. A recorder failure
+        (disk full, unwritable path) must never fail a successful scheduling
+        call: it is logged loudly and recording stops."""
+        run = self._xray_run
+        if run is None:
+            return
+        try:
+            xray.commit_run(run, self.backend_path, self._cfg_digest())
+        except Exception:
+            import logging
+
+            logging.getLogger("open_simulator_tpu").exception(
+                "xray: trace commit failed; disabling recording for this "
+                "process (the scheduling result itself is unaffected)")
+            xray.disable()
+
+    def _xray_preempt(self, pod: dict, node_i: int, victims: List[dict],
+                      reasons: Dict[str, int]) -> None:
+        """Preemption hook (simulator/preemption.py): record the preemptor's
+        authoritative reason + victim chain; victims flip to 'preempted'."""
+        run = self._xray_run
+        if run is None:
+            return
+        run.add_preempt(
+            f"{namespace_of(pod)}/{name_of(pod)}",
+            self.na.names[node_i] if node_i >= 0 else None,
+            [f"{namespace_of(v)}/{name_of(v)}" for v in victims],
+            self._format_reason(pod, reasons, self.na.N), dict(reasons),
+            nominated=node_i >= 0)
+
+    def _xray_set(self, key3: Tuple[int, int, int], tables, carry_start, bt):
+        """Build one decision set — the per-stage masks, total score, and
+        per-plugin components for a (group, forced, segment) key against the
+        segment-START carry (the state the segment's first pick saw) — via
+        ONE fused explain_pod dispatch and ONE packed fetch. Called once per
+        key per batch, never per pod; this is the designated spill point the
+        fetch-in-wave-loop lint rule protects."""
+        g, forced, _segk = key3
+        enable_gpu, enable_storage = getattr(self, "_last_flags", (True, True))
+        jnp = _jax()
+        dims = self._dispatch_dims(bt)
+        # the xray flag joins the signature digest: explain_pod is only ever
+        # compiled on recording runs and can never alias a scheduling kernel
+        obs.record_dispatch("explain_pod", xray=True, zones=bt.n_zones,
+                            gpu=enable_gpu, storage=enable_storage, **dims)
+        feasible, stages, total, comp = guard.supervised(functools.partial(
+            kernels.explain_jit,
+            tables, carry_start, jnp.int32(g), jnp.int32(forced),
+            jnp.asarray(True), n_zones=bt.n_zones, enable_gpu=enable_gpu,
+            enable_storage=enable_storage, w=self.score_w,
+            filters=self.filter_flags,
+        ), site="dispatch", pods=1)
+        n_pad = int(total.shape[0])
+
+        def row(x):
+            # inert components can be python scalars (e.g. openlocal with
+            # storage disabled): broadcast everything to one [1, Npad] row
+            return jnp.broadcast_to(jnp.asarray(x, jnp.float32), (n_pad,))[None]
+
+        rows = ([row(feasible), row(total)]
+                + [row(stages[s]) for s in xray.STAGE_NAMES]
+                + [row(comp[c]) for c in kernels.COMPONENT_ORDER])
+        packed = guard.supervised(
+            lambda: np.asarray(jnp.concatenate(rows, axis=0)),
+            site="fetch", pods=1)[:, :self.na.N]
+        ns = len(xray.STAGE_NAMES)
+        stage_rows = {s: packed[2 + i] > 0.5
+                      for i, s in enumerate(xray.STAGE_NAMES)}
+        comp_rows = {c: packed[2 + ns + i]
+                     for i, c in enumerate(kernels.COMPONENT_ORDER)}
+        return xray.XraySet(g, forced, key3[2], stage_rows, packed[1],
+                            comp_rows, packed[0] > 0.5, self.na.names)
 
     def _count_commits(self, n: int = 1) -> None:
         """The one COMMITS increment path: tracks how many commit events are
@@ -517,6 +612,8 @@ class Simulator:
         progress = Progress("Scheduling pods", len(pods),
                             enabled=not self.disable_progress)
         self._progress = progress if progress.enabled else None
+        xr = self._xray_run
+        direct = None  # lazy xray batch for pre-bound/homeless direct commits
         for pod in pods:
             node_name = (pod.get("spec") or {}).get("nodeName")
             if not node_name:
@@ -527,6 +624,8 @@ class Simulator:
             if self._progress is not None:
                 self._progress.advance(1)
             ni = self.na.index.get(node_name)
+            if xr is not None and direct is None:
+                direct = xr.new_batch(self.na.names, self._cfg_digest(), [])
             if ni is None:
                 # Parity: the reference's fakeclient accepts pods bound to unknown
                 # nodes and getClusterNodeStatus (simulator.go:277-301) silently drops
@@ -534,10 +633,14 @@ class Simulator:
                 pod.pop(SIG_MEMO_KEY, None)
                 self.homeless.append(pod)
                 obs.SCHED_ATTEMPTS.labels(result="homeless").inc()
+                if direct is not None:
+                    direct.add_pod(xray.pod_key(pod), xray.HOMELESS, -1, -1, -1)
             else:
                 self._commit_pod(pod, ni, scheduled=False)
                 obs.SCHED_ATTEMPTS.labels(result="bound").inc()
                 self._count_commits()
+                if direct is not None:
+                    direct.add_pod(xray.pod_key(pod), xray.BOUND, ni, -1, -1)
         failed.extend(self._schedule_run(run))
         progress.close()
         if self.gpu_host.enabled:
@@ -776,10 +879,16 @@ class Simulator:
 
         if self.na.N == 0:
             obs.SCHED_ATTEMPTS.labels(result="unschedulable").inc(len(to_schedule))
-            return [
+            out = [
                 UnscheduledPod(pod, self._format_reason(pod, {}, 0))
                 for pod in to_schedule
             ]
+            if self._xray_run is not None:
+                xb = self._xray_run.new_batch([], self._cfg_digest(), [])
+                for u in out:
+                    xb.add_pod(xray.pod_key(u.pod), xray.UNSCHEDULABLE, -1,
+                               -1, -1, reason=u.reason)
+            return out
         try:
             return self._schedule_run_once(to_schedule)
         except BaseException as e:
@@ -838,6 +947,20 @@ class Simulator:
         for seg in segs:
             obs.SEGMENTS.labels(kind=seg[0]).inc()
             obs.SEGMENT_PODS.labels(kind=seg[0]).inc(seg[2])
+        # simonxray: stage one batch record per dispatch run. want_stats also
+        # turns on the affinity kernel's epoch counters for the segment-timing
+        # breakdown (a distinct compiled program — the flag joins its dispatch
+        # signature below, so stats/no-stats shapes never alias).
+        xr = self._xray_run
+        want_stats = xr is not None or self._segment_timing
+        aff_stats: Dict[int, object] = {}  # outs index -> [3] i32 device array
+        xb = (xr.new_batch(self.na.names, dims["cfg"],
+                           [{"kind": s[0], "start": s[1], "len": s[2],
+                             "group": (s[3] if len(s) > 3 else -1)}
+                            for s in segs])
+              if xr is not None else None)
+        carry0 = carry  # the pre-batch carry: segment k's START state is
+        #                 outs[k-1]'s end carry, or this for k == 0
         # Dispatch every segment asynchronously and fetch ONE concatenated
         # result at the end: the chip may sit behind a tunnel, so a per-segment
         # np.asarray costs a full round trip — 50 segments used to spend ~7s
@@ -897,7 +1020,8 @@ class Simulator:
                 block = kernels.wave_block_for(length, self.na.N)
                 obs.record_dispatch("schedule_affinity_wave", block=block,
                                     ss=ss_live,
-                                    zones=bt.n_zones if ss_live else 2, **dims)
+                                    zones=bt.n_zones if ss_live else 2, **dims,
+                                    **({"stats": True} if want_stats else {}))
                 call = functools.partial(
                     kernels.schedule_affinity_wave,
                     tables, carry, np.int32(g), np.int32(length),
@@ -905,9 +1029,15 @@ class Simulator:
                     w=self.score_w, filters=self.filter_flags,
                     block=block,
                     n_zones=bt.n_zones if ss_live else 2,
+                    stats=want_stats,
                 )
-                carry, counts, _ = guard.supervised(
-                    call, site="dispatch", pods=length)
+                if want_stats:
+                    carry, counts, _, stv = guard.supervised(
+                        call, site="dispatch", pods=length)
+                    aff_stats[len(outs)] = stv
+                else:
+                    carry, counts, _ = guard.supervised(
+                        call, site="dispatch", pods=length)
                 outs.append((seg, counts, carry))
             else:
                 _, start, length, g, cap1, gpu_live = seg
@@ -930,6 +1060,7 @@ class Simulator:
                 # async dispatch to finish, so only ever enabled explicitly
                 import jax as _jax_mod
 
+                # simonlint: ignore[fetch-in-wave-loop] -- the per-segment block IS the measurement (OPEN_SIMULATOR_SEGMENT_TIMING bench-attribution runs only)
                 _jax_mod.block_until_ready(outs[-1][1])
                 obs.SEGMENT_WALL.labels(kind=seg[0]).inc(
                     time.perf_counter() - t_seg)
@@ -960,6 +1091,24 @@ class Simulator:
                     # order; the (length - placed) unschedulable pods stay -1
                     assign = np.repeat(np.arange(counts.shape[0]), counts)
                     choices[start:start + placed] = assign[:placed]
+        if aff_stats:
+            # ONE packed fetch for every affinity segment's epoch counters
+            # (the designated spill point — never a fetch per segment), then
+            # per-segment step events so the PR 6 fast path shows up in the
+            # Chrome trace instead of one opaque dispatch block
+            order = sorted(aff_stats)
+            vals = guard.supervised(
+                lambda: np.asarray(jnp.stack([aff_stats[k] for k in order])),
+                site="fetch", pods=len(order))
+            for k, v in zip(order, vals):
+                st = {"epochs": int(v[0]), "head_fallbacks": int(v[1]),
+                      "rounds": int(v[2])}
+                g = segs[k][3]
+                span.step(f"affinity[g={g}] epochs={st['epochs']} "
+                          f"rounds={st['rounds']} "
+                          f"head_fallbacks={st['head_fallbacks']}")
+                if xb is not None:
+                    xb.segments[k]["stats"] = st
         # Carry snapshots for failure diagnosis against the state the pod
         # actually failed under (the end of ITS segment) — much closer to the
         # reference's mid-batch FitErrors than end-of-batch state. Retained
@@ -972,23 +1121,60 @@ class Simulator:
             }
         else:
             seg_carry_of = {}
+        if xr is not None:
+            # decision sets are evaluated against segment-START state (what
+            # the segment's first pick saw); keep those carries until the
+            # per-pod loop below has built every referenced set
+            seg_start_carry: Dict[int, object] = {
+                k: (outs[k - 1][2] if k > 0 else carry0)
+                for k in range(len(outs))
+            }
+        else:
+            seg_start_carry = {}
         outs = None  # drop the per-segment carry references
         self._last_tables, self._last_carry = bt, final_carry
         span.step("fetch")
 
         progress = getattr(self, "_progress", None)
         reason_cache: Dict[Tuple[int, int, int], Dict[str, int]] = {}
+        set_cache: Dict[Tuple[int, int, int], int] = {}  # key -> run-local sid
+
+        def xray_sid(key: Tuple[int, int, int]) -> int:
+            """Decision set for a (group, forced, segment) key, built once per
+            key per batch against the segment-START carry."""
+            sid = set_cache.get(key)
+            if sid is None:
+                s = self._xray_set(key, tables,
+                                   seg_start_carry.get(key[2], carry0), bt)
+                sid = set_cache[key] = xr.add_set(s)
+            return sid
+
+        if xb is not None:
+            # plain-int views once per batch: per-pod numpy-scalar casts on a
+            # 100k loop are a measurable slice of the recording overhead
+            pg_l = bt.pod_group[:P].tolist()
+            fn_l = bt.forced_node[:P].tolist()
+            seg_l = seg_of.tolist()
         for i, pod in enumerate(to_schedule):
             if progress is not None:
                 progress.advance(1)
             node_i = int(choices[i])
+            if xb is not None:
+                key = (pg_l[i], fn_l[i], seg_l[i])
+            elif node_i < 0:
+                key = (int(bt.pod_group[i]), int(bt.forced_node[i]),
+                       int(seg_of[i]))
+            else:
+                key = None
             if node_i >= 0:
                 self._commit_pod(pod, node_i)
+                if xb is not None:
+                    xb.add_pod(xray.pod_key(pod), xray.SCHEDULED, node_i,
+                               key[2], xray_sid(key), group=key[0])
             else:
                 # Pods of one group share tolerations/requests, so the per-stage
                 # failure counts are identical — diagnose once per
                 # (group, forced, segment), against that segment's end state.
-                key = (int(bt.pod_group[i]), int(bt.forced_node[i]), int(seg_of[i]))
                 reasons = reason_cache.get(key)
                 if reasons is None:
                     reasons = reason_cache[key] = self._explain_reasons(
@@ -997,13 +1183,29 @@ class Simulator:
                     )
                 pod.pop(SIG_MEMO_KEY, None)
                 obs.record_filter_reasons(reasons)
-                failed.append(UnscheduledPod(pod, self._format_reason(pod, reasons, self.na.N)))
+                reason = self._format_reason(pod, reasons, self.na.N)
+                if xb is not None:
+                    sid = xray_sid(key)
+                    xr.sets[sid][1].reasons = dict(reasons)
+                    xb.add_pod(xray.pod_key(pod), xray.UNSCHEDULABLE, -1,
+                               key[2], sid, group=key[0], reason=reason)
+                failed.append(UnscheduledPod(pod, reason))
         placed_n = P - len(failed)
         obs.SCHED_ATTEMPTS.labels(result="scheduled").inc(placed_n)
         if failed:
             obs.SCHED_ATTEMPTS.labels(result="unschedulable").inc(len(failed))
         self._count_commits(placed_n)
         span.step("commit")
+        if xb is not None:
+            # the schedule_run span carries this batch's decision summary
+            # into /debug/vars and the Chrome trace (obs/chrome.py args)
+            span.annotate("xray", {
+                "pods": P, "scheduled": placed_n, "unscheduled": len(failed),
+                "decision_sets": len(set_cache), "segments": xb.segments,
+                "unscheduled_sample": [
+                    {"pod": u.pod.get("metadata", {}).get("name"),
+                     "reason": u.reason} for u in failed[:8]],
+            })
         return failed
 
     # ------------------------------------------------------------- probing -------
@@ -1034,10 +1236,20 @@ class Simulator:
         a probe run would let the second half see placements the first never
         committed, changing the counted semantics)."""
         def attempt():
+            self._xray_run = xray.begin_run("probe")
             with self._transaction():
                 return self._probe_pods_inner(pods)
 
-        return self._run_contained(attempt)
+        try:
+            result = self._run_contained(attempt)
+            if self._xray_run is not None:
+                # probes never materialize placements: one summary record
+                # (counts + backend_path) per call, no per-pod rows
+                self._xray_run.add_probe(result[0], result[1])
+            self._xray_commit()
+            return result
+        finally:
+            self._xray_run = None
 
     def _probe_pods_inner(self, pods: List[dict]) -> Tuple[int, int]:
         run: List[dict] = []
@@ -1232,7 +1444,7 @@ class Simulator:
             "G": int(bt.static_mask.shape[0]),
             "T": int(bt.counter_dom.shape[0]),
             "mesh": self._mesh is not None and self._mesh is not _UNSET,
-            "cfg": f"{hash((self.score_w, self.filter_flags)) & 0xffffffff:08x}",
+            "cfg": self._cfg_digest(),
         }
 
     def _to_device(self, bt: BatchTables):

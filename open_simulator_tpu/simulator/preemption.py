@@ -511,6 +511,10 @@ def schedule_with_preemption(sim, pods: List[dict]) -> List[UnscheduledPod]:
         node_i, victims, reasons = try_preempt(sim, pod)
         obs.PREEMPT_ATTEMPTS.labels(
             outcome="nominated" if node_i >= 0 else "no_candidates").inc()
+        # simonxray: the preemptor's AUTHORITATIVE reason + victim chain come
+        # from this PostFilter pass, not from the discarded batched attempts
+        # the rewind rolled back — record them (victims flip to 'preempted')
+        sim._xray_preempt(pod, node_i, victims if node_i >= 0 else [], reasons)
         if node_i >= 0:
             evict(sim, victims, node_i, pod)
             # evictions change the victim pool WITHOUT appending to

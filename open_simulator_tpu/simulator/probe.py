@@ -411,7 +411,21 @@ class ProbeSession:
             scheduled = self.bound_scheduled + int(placed_s[i])
             out[n] = (scheduled, self.total_known,
                       self._utilization(n, requested_s[i]))
+        self._xray_probes(out)
         return out
+
+    def _xray_probes(self, out) -> None:
+        """simonxray ride-along: one probe record per candidate evaluated by
+        this fan-out dispatch (counts only — sessions never materialize
+        placements), tagged with the session's backend."""
+        from ..obs import xray
+
+        run = xray.begin_run("probe_session")
+        if run is None:
+            return
+        for n, (scheduled, total, _) in sorted(out.items()):
+            run.add_probe(scheduled, total, candidate=n)
+        xray.commit_run(run, [guard.current_backend()])
 
     def _dispatch(self, active_s: np.ndarray):
         S = active_s.shape[0]

@@ -53,6 +53,9 @@ class Span:
         self.threshold = log_if_longer
         self.steps: List[Tuple[str, float]] = []
         self.children: List["Span"] = []
+        self.meta: dict = {}  # annotate(): JSON-able payloads carried into
+        #                       /debug/vars and the Chrome trace event args
+        #                       (simonxray attaches decision summaries here)
         self.failed = False
         self.t0 = 0.0       # perf_counter at __enter__ (shared clock for export)
         self.tid = 0        # thread id at __enter__
@@ -70,6 +73,11 @@ class Span:
         now = time.perf_counter()
         self.steps.append((name, now - self._last))
         self._last = now
+
+    def annotate(self, key: str, value) -> None:
+        """Attach a JSON-able payload to this span (rendered as event args by
+        the Chrome export and included in /debug/vars span dumps)."""
+        self.meta[key] = value
 
     def __exit__(self, exc_type, exc, tb) -> None:
         self.total = time.perf_counter() - self.t0
@@ -103,6 +111,7 @@ class Span:
             "seconds": round(self.total, 6),
             "logged": getattr(self, "logged", False),
             "failed": self.failed,
+            **({"meta": self.meta} if self.meta else {}),
             "steps": [{"name": sn, "seconds": round(st, 6)}
                       for sn, st in self.steps],
             "children": [c.to_dict() for c in self.children],

@@ -1,0 +1,43 @@
+"""Fixture: device->host fetches inside per-segment/epoch/round loop bodies
+(fetch-in-wave-loop). The bad_* half pays one device round trip per loop
+iteration; the ok_* half collects device values and fetches once after the
+loop (the designated spill point), or carries an explicit waiver.
+
+Expected findings: 3 (two in bad_epoch_poll, one in bad_per_segment_fetch).
+"""
+
+import jax
+import numpy as np
+
+
+def bad_per_segment_fetch(segs, outs):
+    total = 0
+    for seg in segs:  # the engine-style per-segment dispatch loop
+        total += int(np.asarray(outs[seg]).sum())  # fetch per iteration
+    return total
+
+
+def bad_epoch_poll(n_epochs, x):
+    y = None
+    for epoch in range(n_epochs):
+        jax.block_until_ready(x)  # blocks the pipeline every epoch
+        y = jax.device_get(x)     # and fetches it again
+    return y
+
+
+def ok_post_loop_spill(segs, outs):
+    acc = []
+    for seg in segs:
+        acc.append(outs[seg])     # device refs only; no sync in the loop
+    return np.asarray(acc)        # ONE fetch at the spill point
+
+
+def ok_waived_blocking_probe(segs, outs):
+    for seg in segs:
+        # simonlint: ignore[fetch-in-wave-loop] -- deliberate per-segment timing probe
+        np.asarray(outs[seg])
+
+
+def ok_plain_loop(items, outs):
+    for item in items:            # not a segment/epoch/round loop
+        np.asarray(outs[item])

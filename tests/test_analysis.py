@@ -159,12 +159,31 @@ def test_naked_dispatch_spares_supervised_forms():
                    if f.rule == "naked-dispatch")
 
 
+def test_fetch_in_wave_loop_rule_fires():
+    # two loops (per-seg fetch; epoch-poll block+get) yield three findings;
+    # the deliberate blocking-probe waiver reports suppressed, not active
+    assert _counts("fetch_wave_hazard.py", "fetch-in-wave-loop") == 3
+    assert _counts("fetch_wave_hazard.py", "fetch-in-wave-loop",
+                   suppressed=True) == 1
+
+
+def test_fetch_in_wave_loop_spares_spill_points_and_plain_loops():
+    # post-loop spills and loops not named per segment/epoch/round are clean
+    fr = analyze_file(str(FIXTURES / "fetch_wave_hazard.py"))
+    src = (FIXTURES / "fetch_wave_hazard.py").read_text().splitlines()
+    ok_start = next(i for i, l in enumerate(src, 1)
+                    if "def ok_post_loop_spill" in l)
+    assert not any(f.line >= ok_start and not f.suppressed
+                   for f in fr.findings if f.rule == "fetch-in-wave-loop")
+
+
 def test_fixture_tree_reports_all_families_and_fails():
     report = analyze_paths([str(FIXTURES)])
     fired = {f.rule for f in report.findings if not f.suppressed}
     assert {"host-sync-in-jit", "recompile-trigger",
             "dtype-drift", "carry-contract", "metric-in-jit",
-            "swallowed-exception", "naked-dispatch"} <= fired
+            "swallowed-exception", "naked-dispatch",
+            "fetch-in-wave-loop"} <= fired
     assert report.active(Severity.WARNING)
     rc = run_lint([str(FIXTURES)])
     assert rc == 1
