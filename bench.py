@@ -184,11 +184,24 @@ def bench_capacity_plan(n_pods=100_000, repeats=1):
         os.environ.pop("MaxCPU", None)
 
 
-def bench_mesh_cpu(n_nodes=1_000, n_pods=10_000, shards=8):
-    """Mesh-sharded product path on a virtual CPU mesh: same workload through
-    Simulator(use_mesh=True) over `shards` devices and the single-device
-    engine, in a subprocess (the CPU device count must be set before backend
-    init). Returns (pods_per_sec, placements_match, error)."""
+def bench_mesh_cpu(n_nodes=1_000, n_pods=10_000, shards=8, hard=False,
+                   check_single=True, repeats=2, timeout=900):
+    """Mesh-sharded product path on a virtual CPU mesh, in a subprocess (the
+    CPU device count must be set before backend init). Measurement protocol
+    matches bench_throughput exactly — fresh synth inputs per repeat, the
+    timer brackets only schedule_pods — so the mesh rows compare 1:1 against
+    the single-chip rows (the old protocol deep-copied the 10k-pod list
+    INSIDE the timed region, ~0.35s of host copying billed to the mesh).
+
+    With check_single the same workload also runs single-device and the
+    per-(node, scheduling-signature) censuses must match bit-for-bit. The row
+    embeds the run's sharding-layout health: reshard_bytes (the
+    simon_reshard_bytes_total counter — carry bytes whose post-dispatch
+    layout diverged from the declared shardings; 0 = chained dispatches never
+    reshard) and transfer_bytes (host→device staging).
+
+    Returns (pods_per_sec, wall_s, scheduled, total, match, reshard_bytes,
+    transfer_bytes, error)."""
     code = f"""
 import json, os, sys, time
 sys.path.insert(0, {repr(REPO)})
@@ -199,27 +212,50 @@ request_cpu_devices({shards})
 force_cpu_platform()
 from open_simulator_tpu.utils.synth import synth_cluster
 from open_simulator_tpu.simulator.engine import Simulator
+from open_simulator_tpu.simulator.encode import scheduling_signature
+from open_simulator_tpu.obs import REGISTRY
 
 def census(sim):
     out = {{}}
     for i, pods in enumerate(sim.pods_on_node):
-        out[i] = len(pods)
+        for p in pods:
+            key = (i, scheduling_signature(p))
+            out[key] = out.get(key, 0) + 1
     return out
 
-nodes, pods = synth_cluster({n_nodes}, {n_pods})
-import copy
-best = None
-for use_mesh in (True, True):  # first run pays the distributed compile
-    sim = Simulator(copy.deepcopy(nodes), use_mesh=True)
+def one_run(use_mesh):
+    nodes, pods = synth_cluster({n_nodes}, {n_pods}, hard_predicates={hard})
+    sim = Simulator(nodes, use_mesh=use_mesh)
     t0 = time.perf_counter()
-    sim.schedule_pods(copy.deepcopy(pods))
+    failed = sim.schedule_pods(pods)
     dt = time.perf_counter() - t0
-    mesh_census = census(sim)
-    if best is None or dt < best:
-        best = dt
-single = Simulator(copy.deepcopy(nodes), use_mesh=False)
-single.schedule_pods(copy.deepcopy(pods))
-print(json.dumps({{"rate": {n_pods} / best, "match": census(single) == mesh_census}}))
+    total = sum(len(p) for p in sim.pods_on_node)
+    return dt, total, total + len(failed), census(sim)
+
+best = None
+n_runs = {repeats} + 1
+for _ in range(n_runs):  # first run pays the distributed compile
+    dt, placed, total, mesh_census = one_run(True)
+    if best is None or dt < best[0]:
+        best = (dt, placed, total, mesh_census)
+dt, placed, total, mesh_census = best
+# snapshot the sharding-health counters BEFORE the single-device comparison
+# run, which would otherwise pollute them: reshard_bytes covers EVERY mesh
+# run (0 across all is the stronger claim), transfer_bytes is per-run (each
+# repeat stages the same tables once)
+vals = REGISTRY.values()
+reshard = int(vals.get("simon_reshard_bytes_total") or 0)
+transfer = int(vals.get("simon_device_transfer_bytes_total") or 0) // n_runs
+match = True
+if {check_single}:
+    _, _, _, single_census = one_run(False)
+    match = single_census == mesh_census
+print(json.dumps({{
+    "rate": placed / dt, "wall_s": dt, "scheduled": placed, "total": total,
+    "match": match,
+    "reshard_bytes": reshard,
+    "transfer_bytes": transfer,
+}}))
 """
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # see the subprocess preamble
@@ -227,13 +263,21 @@ print(json.dumps({{"rate": {n_pods} / best, "match": census(single) == mesh_cens
     try:
         out = subprocess.run(
             [sys.executable, "-c", code], env=env, capture_output=True,
-            text=True, timeout=900,
+            text=True, timeout=timeout,
         )
-        line = out.stdout.strip().splitlines()[-1]
-        data = json.loads(line)
-        return data["rate"], bool(data["match"]), ""
+        data = None
+        for line in reversed(out.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                data = json.loads(line)
+                break
+        if data is None:
+            raise ValueError(f"no row line (rc={out.returncode}, "
+                             f"stderr tail: {out.stderr[-300:]!r})")
+        return (data["rate"], data["wall_s"], data["scheduled"],
+                data["total"], bool(data["match"]), data["reshard_bytes"],
+                data["transfer_bytes"], "")
     except Exception as e:  # the mesh metric is best-effort; report, don't die
-        return 0.0, False, f"{type(e).__name__}: {e}"
+        return 0.0, 0.0, 0, 0, False, -1, -1, f"{type(e).__name__}: {e}"
 
 
 # --------------------------------------------------------------------------
@@ -382,15 +426,46 @@ def _row_agreement():
     }
 
 
-def _row_mesh8():
-    rate, match, err = bench_mesh_cpu()
+def _mesh_row(metric, **kw):
+    (rate, wall, placed, total, match, reshard, transfer,
+     err) = bench_mesh_cpu(**kw)
     return {
-        "metric": "mesh8_cpu_pods_per_sec_10k_pods_1k_nodes",
+        "metric": metric,
         "value": round(rate, 1), "unit": "pods/s",
         "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 4),
+        "wall_s": round(wall, 3), "scheduled": placed, "total": total,
         "placements_match_single_device": match,
+        # sharding-layout health: reshard_bytes must stay 0 (chained
+        # dispatches reuse the declared carry shardings end-to-end); a
+        # nonzero value localizes a layout regression to this row
+        "reshard_bytes": reshard, "transfer_bytes": transfer,
         **({"error": err} if err else {}),
     }
+
+
+def _row_mesh8():
+    return _mesh_row("mesh8_cpu_pods_per_sec_10k_pods_1k_nodes")
+
+
+def _row_mesh8_hard():
+    """The affinity-wave route (zone spread / anti-affinity / taints) under
+    sharding: epoch-batched counter-live segments whose normalizer min/max
+    and winner argmax are the only values crossing shard boundaries."""
+    return _mesh_row("mesh8_hard_pods_per_sec_10k_pods_1k_nodes", hard=True,
+                     timeout=1500)
+
+
+def _row_mesh8_1m():
+    """The scale proof: 1M pods onto 100k nodes only fits as a sharded
+    program (the 'millions of users' shape, ~10x the north star). One timed
+    run — at this size the single-device comparison would double a
+    multi-minute row, and bit-identity is already asserted per-route by the
+    10k mesh rows, tests/test_mesh_sharding.py, and tools/mesh_smoke.py."""
+    row = _mesh_row("mesh8_1m_pods_per_sec_1m_pods_100k_nodes",
+                    n_nodes=100_000, n_pods=1_000_000, check_single=False,
+                    repeats=1, timeout=2700)
+    row["placements_match_single_device"] = None  # not run at this size
+    return row
 
 
 def _row_capacity():
@@ -414,8 +489,8 @@ def _row_capacity():
     }
 
 
-# (name, builder, timeout_s, needs_device_backend). mesh8 always runs on a
-# virtual CPU mesh by definition, so it never probes or occupies the chip.
+# (name, builder, timeout_s, needs_device_backend). mesh8* always run on a
+# virtual CPU mesh by definition, so they never probe or occupy the chip.
 METRICS = [
     ("north_star", _row_north_star, 1800, True),
     ("throughput_10k_1k", _row_throughput_10k_1k, 900, True),
@@ -424,6 +499,8 @@ METRICS = [
     ("xray_overhead", _row_xray_overhead, 1800, True),
     ("agreement", _row_agreement, 1800, True),
     ("mesh8", _row_mesh8, 1200, False),
+    ("mesh8_hard", _row_mesh8_hard, 1800, False),
+    ("mesh8_1m", _row_mesh8_1m, 3000, False),
     ("capacity", _row_capacity, 1800, True),
 ]
 
@@ -563,7 +640,7 @@ def main() -> None:
             if row is None:
                 row = {"metric": name, "error": "metric subprocess failed",
                        "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0}
-            if name == "mesh8":
+            if name.startswith("mesh8"):
                 row["backend"] = "cpu-virtual-mesh"
             else:
                 row["backend"] = "default" if use_device else "cpu-fallback"

@@ -11,6 +11,7 @@ Rule ids (stable — they appear in suppression comments and CI output):
   swallowed-exception  broad except that neither re-raises, returns, logs, nor counts
   naked-dispatch     device-computation call site bypassing the simonguard watchdog
   fetch-in-wave-loop device->host fetch inside a per-segment/epoch/round loop body
+  unsharded-transfer shardingless device_put / jit dispatch in a mesh-aware hot path
 
 Every rule is a pure function ModuleContext -> List[Finding]; file IO,
 suppressions, and exit-code policy live in runner.py.
@@ -23,7 +24,7 @@ from typing import List, Optional, Set
 
 from ..ops.contracts import parse_spec
 from .base import Finding, Severity, register
-from .context import PARTIAL_NAMES, ModuleContext
+from .context import JIT_NAMES, PARTIAL_NAMES, ModuleContext
 
 # ----------------------------------------------------------------- helpers ----
 
@@ -722,4 +723,80 @@ def rule_contract_spec(ctx: ModuleContext) -> List[Finding]:
                                 kw.value.lineno, kw.value.col_offset,
                                 f"@shaped spec for '{kw.arg}' does not parse: {e}",
                             ))
+    return out
+
+
+# ---------------------------------------------------------- unsharded-transfer --
+
+# The sharded dispatch chain (parallel/mesh.py ShardedKernels) only stays
+# reshard-free when every transfer and every jitted dispatch in a mesh-aware
+# hot path declares its layout. A naked jax.device_put lands wherever the
+# default device policy says (then the first sharded consumer pays a
+# reshard); a jit over a dispatch kernel without in_shardings lets GSPMD
+# re-infer per call.
+
+
+def _module_is_mesh_aware(ctx: ModuleContext) -> bool:
+    """True when the module imports the parallel (mesh/sharding) machinery —
+    engine.py, probe.py, and parallel/ itself qualify via their (possibly
+    function-local, possibly relative) `from ..parallel.mesh import ...`."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if "parallel" in mod.split("."):
+                return True
+        elif isinstance(node, ast.Import):
+            if any("parallel" in a.name.split(".") for a in node.names):
+                return True
+    return False
+
+
+@register(
+    "unsharded-transfer", Severity.WARNING,
+    "In a mesh-aware hot path (a module importing parallel/), a "
+    "jax.device_put without an explicit sharding/device argument or a "
+    "jax.jit over a dispatch kernel without in_shardings breaks the "
+    "end-to-end sharding contract: the array lands in the default layout "
+    "(or GSPMD re-infers one per call) and the next chained dispatch pays a "
+    "reshard — the exact regression simon_reshard_bytes_total exists to "
+    "catch at runtime. Pass the sharding explicitly (table_shardings / "
+    "carry_shardings / fanout_shardings), route the dispatch through "
+    "parallel.mesh.sharded_kernels, or whitelist a deliberate host-layout "
+    "transfer with `# simonlint: ignore[unsharded-transfer] -- <why>`.",
+)
+def rule_unsharded_transfer(ctx: ModuleContext) -> List[Finding]:
+    if not _module_is_mesh_aware(ctx):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        r = ctx.resolve(node.func) or ""
+        if r == "jax.device_put":
+            # only a TARGET placement counts: a `src=` keyword names where
+            # the array comes from, committing no output layout at all
+            has_target = len(node.args) >= 2 or any(
+                kw.arg == "device" for kw in node.keywords)
+            if not has_target:
+                out.append(Finding(
+                    "unsharded-transfer", Severity.WARNING, ctx.path,
+                    node.lineno, node.col_offset,
+                    "jax.device_put without an explicit sharding commits the "
+                    "array to the default device layout; the first sharded "
+                    "consumer then reshards it — pass the NamedSharding "
+                    "(table_shardings/carry_shardings/fanout_shardings)",
+                ))
+        elif r in JIT_NAMES and node.args:
+            target = ctx.resolve(node.args[0]) or ""
+            if target.split(".")[-1] not in _DISPATCH_KERNELS:
+                continue
+            if not any(kw.arg == "in_shardings" for kw in node.keywords):
+                out.append(Finding(
+                    "unsharded-transfer", Severity.WARNING, ctx.path,
+                    node.lineno, node.col_offset,
+                    f"jax.jit({target.split('.')[-1]}, ...) in a mesh-aware "
+                    f"module without in_shardings lets GSPMD re-infer the "
+                    f"layout per call — declare in_shardings/out_shardings "
+                    f"(or reuse parallel.mesh.sharded_kernels)",
+                ))
     return out

@@ -60,6 +60,13 @@ SEGMENT_WALL = counter(
 TRANSFER_BYTES = counter(
     "simon_device_transfer_bytes_total",
     "Host->device bytes staged for scheduling/probe table uploads.")
+RESHARD_BYTES = counter(
+    "simon_reshard_bytes_total",
+    "Bytes of carry state whose post-dispatch sharding layout diverged from "
+    "the declared carry shardings — what a chained dispatch would have to "
+    "move across ICI to reconcile. The sharded executables pin out_shardings "
+    "to in_shardings, so this stays 0; nonzero means a mesh dispatch path "
+    "dropped its explicit shardings (parallel/mesh.py carry_reshard_bytes).")
 COMMITS = counter(
     "simon_commits_total",
     "Pods committed onto nodes (placements materialized on cluster state). "

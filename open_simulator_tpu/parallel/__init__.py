@@ -7,6 +7,9 @@ from .distributed import make_global_mesh, node_mesh_local
 from .mesh import (
     NODE_AXIS,
     SCENARIO_AXIS,
+    ShardedKernels,
+    carry_reshard_bytes,
+    extend_tables_on_device,
     fanout_shardings,
     make_node_mesh,
     make_scenario_mesh,
@@ -14,6 +17,7 @@ from .mesh import (
     put_fanout_inputs,
     schedule_batch_on_mesh,
     schedule_scenarios_on_mesh,
+    sharded_kernels,
     table_shardings,
     carry_shardings,
     tables_from_batch,
@@ -26,6 +30,9 @@ __all__ = [
     "node_mesh_local",
     "NODE_AXIS",
     "SCENARIO_AXIS",
+    "ShardedKernels",
+    "carry_reshard_bytes",
+    "extend_tables_on_device",
     "fanout_shardings",
     "make_node_mesh",
     "make_scenario_mesh",
@@ -33,6 +40,7 @@ __all__ = [
     "put_fanout_inputs",
     "schedule_batch_on_mesh",
     "schedule_scenarios_on_mesh",
+    "sharded_kernels",
     "table_shardings",
     "carry_shardings",
     "tables_from_batch",

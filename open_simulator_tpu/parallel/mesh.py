@@ -21,7 +21,7 @@ N must divide the shard count; `pad_batch_tables` appends infeasible phantom nod
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -113,47 +113,50 @@ def to_device_sharded(
     bt: BatchTables, mesh: Mesh
 ) -> Tuple[kernels.Tables, kernels.Carry, BatchTables]:
     """Pad to the mesh's node-shard count and device_put with shardings committed, so
-    `kernels.schedule_batch` compiles a distributed program (XLA propagates the
-    shardings through the scan and inserts the ICI collectives)."""
+    the sharded kernel executables (`sharded_kernels`) receive inputs already in
+    their declared layout — the pad is a no-op when the encoder pre-aligned the
+    node axis (engine.encode_batch), and the batched device_put pre-partitions
+    every table in one host→device staging pass."""
     shards = mesh.shape[NODE_AXIS]
     bt = pad_batch_tables(bt, shards)
     ts, cs = table_shardings(mesh), carry_shardings(mesh)
-    tables = kernels.Tables(*(
-        jax.device_put(np.asarray(v), s) for v, s in zip(tables_from_batch(bt), ts)
-    ))
-    carry = kernels.Carry(
-        requested=jax.device_put(bt.seed_requested, cs.requested),
-        nonzero=jax.device_put(bt.seed_nonzero, cs.nonzero),
-        port_used=jax.device_put(bt.seed_port_used, cs.port_used),
-        counter=jax.device_put(bt.seed_counter, cs.counter),
-        carrier=jax.device_put(bt.seed_carrier, cs.carrier),
-        dev_used=jax.device_put(bt.seed_dev_used, cs.dev_used),
-        vg_req=jax.device_put(bt.seed_vg_req, cs.vg_req),
-        sdev_alloc=jax.device_put(bt.seed_sdev_alloc, cs.sdev_alloc),
-    )
+    # ONE batched transfer per struct: device_put over the (arrays, shardings)
+    # pytree pair stages every pre-partitioned leaf together instead of paying
+    # a dispatch per table
+    tables = jax.device_put(
+        kernels.Tables(*(np.asarray(v) for v in tables_from_batch(bt))), ts)
+    carry = jax.device_put(
+        kernels.Carry(
+            requested=bt.seed_requested,
+            nonzero=bt.seed_nonzero,
+            port_used=bt.seed_port_used,
+            counter=bt.seed_counter,
+            carrier=bt.seed_carrier,
+            dev_used=bt.seed_dev_used,
+            vg_req=bt.seed_vg_req,
+            sdev_alloc=bt.seed_sdev_alloc,
+        ), cs)
     return tables, carry, bt
 
 
 def schedule_batch_on_mesh(bt: BatchTables, mesh: Mesh):
-    """Run one schedulePods batch with the node axis sharded over `mesh`.
+    """Run one schedulePods batch with the node axis sharded over `mesh`,
+    through the explicitly-sharded executable set (carry donated: the seed
+    buffers are freed into the scan's output).
 
     Returns (final_carry, choices[P] int32). Choices index the ORIGINAL node list —
     phantom padding is infeasible by construction, so indices never exceed the real N.
     """
     tables, carry, bt = to_device_sharded(bt, mesh)
     enable_gpu, enable_storage = plugin_flags(bt)
-    with mesh:
-        # simonlint: ignore[naked-dispatch] -- multichip dry-run harness, not
-        # an engine hot path: callers own the wedge exposure (bench/tests)
-        final, choices = kernels.schedule_batch(
-            tables, carry,
-            jax.numpy.asarray(bt.pod_group),
-            jax.numpy.asarray(bt.forced_node),
-            jax.numpy.asarray(bt.valid),
-            n_zones=bt.n_zones,
-            enable_gpu=enable_gpu,
-            enable_storage=enable_storage,
-        )
+    sk = sharded_kernels(mesh)
+    final, choices = sk.schedule_batch(
+        tables, carry,
+        bt.pod_group, bt.forced_node, bt.valid,
+        n_zones=bt.n_zones,
+        enable_gpu=enable_gpu,
+        enable_storage=enable_storage,
+    )
     return final, choices
 
 
@@ -273,10 +276,337 @@ def put_fanout_inputs(mesh: Mesh, bt: BatchTables, carry_s_np, active_s_np):
     `with mesh:` block. carry_s_np leaves carry a leading [S] axis; S must be
     divisible by the mesh's scenario-axis size."""
     ts, cs, as_ = fanout_shardings(mesh)
-    tables = kernels.Tables(*(
-        jax.device_put(np.asarray(v), s) for v, s in zip(tables_from_batch(bt), ts)
-    ))
-    carry_s = kernels.Carry(*(
-        jax.device_put(np.asarray(v), s) for v, s in zip(carry_s_np, cs)
-    ))
+    tables = jax.device_put(
+        kernels.Tables(*(np.asarray(v) for v in tables_from_batch(bt))), ts)
+    carry_s = jax.device_put(
+        kernels.Carry(*(np.ascontiguousarray(v) for v in carry_s_np)), cs)
     return tables, carry_s, jax.device_put(np.asarray(active_s_np), as_)
+
+
+# ----------------------------------------------------------------------------
+# Sharded kernel executables: explicit in/out shardings end-to-end.
+#
+# Committing shardings only at to_device_sharded leaves every jit free to
+# re-infer (and silently re-shard) its outputs per call; chained per-segment
+# dispatches then round-trip the carry through whatever layout XLA picked.
+# These wrappers pin BOTH sides of every hot kernel: inputs arrive in the
+# table/carry shardings, outputs leave in the SAME carry shardings, so wave
+# N's output feeds wave N+1 with zero resharding collectives at the boundary
+# — and the carry buffers are donated, so the per-segment/per-epoch loop
+# updates cluster state in place instead of allocating a fresh [N, R] set per
+# dispatch. One executable set is cached per (mesh, donate) and shared by
+# every Simulator/ProbeSession over an equal mesh: a warm second dispatch is
+# zero recompiles.
+# ----------------------------------------------------------------------------
+
+
+def _unwrap(fn):
+    """The undecorated kernel (jax.jit stores it on __wrapped__): re-jitting
+    the wrapped form avoids nesting one jit inside another."""
+    return getattr(fn, "__wrapped__", fn)
+
+
+def _mesh_key(mesh: Mesh) -> tuple:
+    return (mesh.axis_names, tuple(mesh.devices.shape),
+            tuple(d.id for d in mesh.devices.flat))
+
+
+_SHARDED_CACHE: Dict[tuple, "ShardedKernels"] = {}
+
+
+def sharded_kernels(mesh: Mesh, donate: bool = True) -> "ShardedKernels":
+    """The cached sharded-executable set for `mesh`. Instances with equal
+    meshes share one jit cache (ShardedKernels caches its jitted callables
+    per (kernel, donate), and jax.jit keys on sharding equality), so every
+    engine batch / probe round over the same mesh reuses warm executables."""
+    key = _mesh_key(mesh)
+    got = _SHARDED_CACHE.get(key)
+    if got is None:
+        got = _SHARDED_CACHE[key] = ShardedKernels(mesh)
+    return got if donate else got.undonated()
+
+
+class ShardedKernels:
+    """Jitted variants of every hot kernel with explicit in_shardings /
+    out_shardings built from table_shardings/carry_shardings (and the fan-out
+    shardings on a scenario mesh), plus donate_argnums on the carry.
+
+    Call signatures are identical to the `kernels` module functions, so the
+    engine's dispatch loop and the probe fan-out swap between the two by
+    swapping the namespace. Donation is an instance-level mode:
+    `sharded_kernels(mesh, donate=False)` returns a view sharing this
+    instance's jit cache whose dispatches keep their input carry alive (the
+    xray recorder reads segment-start carries after the fact)."""
+
+    def __init__(self, mesh: Mesh, _shared=None, _donate: bool = True) -> None:
+        self.mesh = mesh
+        self.donate = _donate
+        self._built: Dict[tuple, object] = (
+            _shared if _shared is not None else {})
+        self.table_sh = table_shardings(mesh)
+        self.carry_sh = carry_shardings(mesh)
+        self.rep = NamedSharding(mesh, P())
+        self.node_sh = NamedSharding(mesh, P(NODE_AXIS))
+        if SCENARIO_AXIS in mesh.shape:
+            _, self.carry_s_sh, self.active_sh = fanout_shardings(mesh)
+            self.lane_sh = NamedSharding(mesh, P(SCENARIO_AXIS))
+        else:
+            self.carry_s_sh = self.active_sh = self.lane_sh = None
+
+    def undonated(self) -> "ShardedKernels":
+        """A view over the same jit cache whose carry inputs survive the
+        dispatch (donation off) — used while the xray recorder is active."""
+        view = self._built.get("__undonated_view__")
+        if view is None:
+            view = ShardedKernels(self.mesh, _shared=self._built,
+                                  _donate=False)
+            self._built["__undonated_view__"] = view
+        return view
+
+    def _jit(self, name, build, shared: bool = False):
+        # `shared`: donation-independent executables (diagnostics never
+        # donate), so the donating and undonated views reuse one jit
+        key = name if shared else (name, self.donate)
+        fn = self._built.get(key)
+        if fn is None:
+            fn = self._built[key] = build()
+        return fn
+
+    def _sched_jit(self, name, n_dyn, n_static, out_tail, donate_ok=True,
+                   in_head=None):
+        """jit one kernel with explicit shardings. pjit forbids kwargs once
+        in_shardings is set, so statics are positional (static_argnums) and
+        every wrapper below calls in the kernel's declared argument order.
+        `n_dyn` dynamic args follow the (tables, carry) pair (or the fan-out
+        (tables, carry_s, active_s) triple when in_head is given)."""
+        head = in_head if in_head is not None else (self.table_sh,
+                                                    self.carry_sh)
+        first_static = len(head) + n_dyn
+        donate = (1,) if (self.donate and donate_ok) else ()
+        return jax.jit(
+            _unwrap(getattr(kernels, name)),
+            static_argnums=tuple(range(first_static, first_static + n_static)),
+            in_shardings=head + (self.rep,) * n_dyn,
+            out_shardings=out_tail,
+            donate_argnums=donate,
+        )
+
+    # ------------------------------------------------- engine dispatches ----
+
+    def schedule_wave(self, tb, cry, g, m, cap1, *, gpu_live=False,
+                      w=kernels.DEFAULT_WEIGHTS, filters=kernels.DEFAULT_FILTERS,
+                      block=kernels.WAVE_BLOCK, kmax=0):
+        fn = self._jit("schedule_wave", lambda: self._sched_jit(
+            "schedule_wave", 3, 5, (self.carry_sh, self.node_sh, self.rep)))
+        return fn(tb, cry, g, m, cap1, gpu_live, w, filters, block, kmax)
+
+    def schedule_affinity_wave(self, tb, cry, g, m, cap1, *, ss_live=False,
+                               w=kernels.DEFAULT_WEIGHTS,
+                               filters=kernels.DEFAULT_FILTERS,
+                               block=kernels.WAVE_BLOCK, n_zones=2,
+                               stats=False):
+        # the stats flag changes the output arity -> one executable per value
+        tail = ((self.carry_sh, self.node_sh, self.rep, self.rep) if stats
+                else (self.carry_sh, self.node_sh, self.rep))
+        fn = self._jit(f"schedule_affinity_wave:{bool(stats)}",
+                       lambda: self._sched_jit(
+                           "schedule_affinity_wave", 3, 6, tail))
+        return fn(tb, cry, g, m, cap1, ss_live, w, filters, block, n_zones,
+                  stats)
+
+    def schedule_group_serial(self, tb, cry, g, valid, cap1, *,
+                              w=kernels.DEFAULT_WEIGHTS,
+                              filters=kernels.DEFAULT_FILTERS,
+                              ss_live=False, sa_live=False, n_zones=2):
+        fn = self._jit("schedule_group_serial", lambda: self._sched_jit(
+            "schedule_group_serial", 3, 5,
+            (self.carry_sh, self.node_sh, self.rep)))
+        return fn(tb, cry, g, valid, cap1, w, filters, ss_live, sa_live,
+                  n_zones)
+
+    def schedule_batch(self, tb, cry, pod_group, forced_node, valid, *,
+                       n_zones, enable_gpu=True, enable_storage=True,
+                       w=kernels.DEFAULT_WEIGHTS,
+                       filters=kernels.DEFAULT_FILTERS):
+        fn = self._jit("schedule_batch", lambda: self._sched_jit(
+            "schedule_batch", 3, 5, (self.carry_sh, self.rep)))
+        return fn(tb, cry, pod_group, forced_node, valid, n_zones, enable_gpu,
+                  enable_storage, w, filters)
+
+    # ------------------------------------------------------- diagnostics ----
+    # in_shardings only (out_shardings=None): both are one-shot
+    # fetch-to-host diagnostics whose outputs are never chained into another
+    # dispatch, and some output leaves are scalars (inert score components),
+    # which a node-axis out-sharding prefix cannot describe. Never donated:
+    # the engine re-reads the same carry for every (group, forced, segment)
+    # key.
+
+    def feasibility_jit(self, tb, cry, g, forced, valid, *, enable_gpu=True,
+                        enable_storage=True, include_dns=True,
+                        include_interpod=True,
+                        filters=kernels.DEFAULT_FILTERS):
+        fn = self._jit("feasibility_jit", lambda: self._sched_jit(
+            "feasibility_jit", 3, 5, None, donate_ok=False), shared=True)
+        return fn(tb, cry, g, forced, valid, enable_gpu, enable_storage,
+                  include_dns, include_interpod, filters)
+
+    def explain_jit(self, tb, cry, g, forced, valid, *, n_zones,
+                    enable_gpu=True, enable_storage=True,
+                    w=kernels.DEFAULT_WEIGHTS,
+                    filters=kernels.DEFAULT_FILTERS):
+        fn = self._jit("explain_jit", lambda: self._sched_jit(
+            "explain_jit", 3, 5, None, donate_ok=False), shared=True)
+        return fn(tb, cry, g, forced, valid, n_zones, enable_gpu,
+                  enable_storage, w, filters)
+
+    # ------------------------------------------- probe fan-out dispatches ----
+    # Scenario-mesh only (make_scenario_mesh): the [S] candidate axis shards
+    # over SCENARIO_AXIS -- devices buy probe breadth, not replication -- and
+    # the [S]-carry chains donated between segments exactly like the engine's.
+
+    def _fanout_head(self, name):
+        if self.carry_s_sh is None:
+            raise ValueError(
+                f"{name} needs a mesh with a '{SCENARIO_AXIS}' axis "
+                f"(make_scenario_mesh); this mesh has {self.mesh.axis_names}")
+        return (self.table_sh, self.carry_s_sh, self.active_sh)
+
+    def probe_wave_fanout(self, tb, cry_s, active_s, g, m, cap1, *,
+                          gpu_live=False, w=kernels.DEFAULT_WEIGHTS,
+                          filters=kernels.DEFAULT_FILTERS,
+                          block=kernels.WAVE_BLOCK, kmax=0):
+        fn = self._jit("probe_wave_fanout", lambda: self._sched_jit(
+            "probe_wave_fanout", 3, 5, (self.carry_s_sh, self.lane_sh),
+            in_head=self._fanout_head("probe_wave_fanout")))
+        return fn(tb, cry_s, active_s, g, m, cap1, gpu_live, w, filters,
+                  block, kmax)
+
+    def probe_affinity_wave_fanout(self, tb, cry_s, active_s, g, m, cap1, *,
+                                   ss_live=False, w=kernels.DEFAULT_WEIGHTS,
+                                   filters=kernels.DEFAULT_FILTERS,
+                                   block=kernels.WAVE_BLOCK, n_zones=2):
+        fn = self._jit("probe_affinity_wave_fanout", lambda: self._sched_jit(
+            "probe_affinity_wave_fanout", 3, 5,
+            (self.carry_s_sh, self.lane_sh),
+            in_head=self._fanout_head("probe_affinity_wave_fanout")))
+        return fn(tb, cry_s, active_s, g, m, cap1, ss_live, w, filters,
+                  block, n_zones)
+
+    def probe_group_serial_fanout(self, tb, cry_s, active_s, g, valid, cap1,
+                                  *, w=kernels.DEFAULT_WEIGHTS,
+                                  filters=kernels.DEFAULT_FILTERS,
+                                  ss_live=False, sa_live=False, n_zones=2):
+        fn = self._jit("probe_group_serial_fanout", lambda: self._sched_jit(
+            "probe_group_serial_fanout", 3, 5,
+            (self.carry_s_sh, self.lane_sh),
+            in_head=self._fanout_head("probe_group_serial_fanout")))
+        return fn(tb, cry_s, active_s, g, valid, cap1, w, filters, ss_live,
+                  sa_live, n_zones)
+
+    def probe_serial_fanout(self, tb, cry_s, active_s, pod_group, forced_node,
+                            valid, *, n_zones, enable_gpu=True,
+                            enable_storage=True, w=kernels.DEFAULT_WEIGHTS,
+                            filters=kernels.DEFAULT_FILTERS):
+        fn = self._jit("probe_serial_fanout", lambda: self._sched_jit(
+            "probe_serial_fanout", 3, 5, (self.carry_s_sh, self.lane_sh),
+            in_head=self._fanout_head("probe_serial_fanout")))
+        return fn(tb, cry_s, active_s, pod_group, forced_node, valid,
+                  n_zones, enable_gpu, enable_storage, w, filters)
+
+
+def carry_reshard_bytes(carry, shardings) -> int:
+    """Bytes a chained dispatch would move to reconcile `carry`'s actual
+    layout with the declared carry shardings — the regression signal behind
+    simon_reshard_bytes_total and the bench mesh rows' `reshard_bytes` stat.
+    With the sharded executables pinning out_shardings this is provably 0;
+    anything nonzero means a dispatch path dropped its explicit shardings and
+    XLA re-inferred a different layout."""
+    total = 0
+    for leaf, want in zip(carry, shardings):
+        sh = getattr(leaf, "sharding", None)
+        if sh is None:
+            continue
+        if not sh.is_equivalent_to(want, leaf.ndim):
+            total += leaf.nbytes
+    return total
+
+
+# ----------------------------------------------------------------------------
+# Shard-local node-axis growth: the incremental prober's template-column
+# extension without a host round-trip. Every appended column is a verbatim
+# copy of the template column ALREADY RESIDENT on the device (verified
+# bit-identical at session build), and phantom re-padding writes constants —
+# so the whole extension is one compiled concat per table, shard-local under
+# the mesh shardings, transferring zero bytes from the host. Only valid when
+# extend_node_axis would not widen the domain axis (no hostname-keyed
+# counter/carrier rows); probe.ProbeSession falls back to the host re-upload
+# otherwise.
+# ----------------------------------------------------------------------------
+
+# Phantom fills mirror pad_batch_tables exactly: a padded column must be
+# indistinguishable from one it would have produced.
+_EXT_GN_FILL = (
+    ("static_mask", False), ("mask_taint", False), ("mask_unsched", False),
+    ("mask_aff", False), ("mask_extra", False),
+    ("simon_raw", 0), ("nodeaff_raw", 0), ("taint_raw", 0), ("avoid_raw", 0),
+    ("image_raw", 0), ("extra_raw", 0),
+)
+_EXT_DOM_FIELDS = ("counter_dom", "topo_dom", "carr_dom")
+_EXT_NROW_FILL = (
+    ("alloc", 0), ("dev_total", 0), ("vg_cap", 0), ("vg_nameid", 0),
+    ("sdev_cap", 0), ("sdev_media", 0),
+)
+
+
+def _extend_tables_impl(tb: kernels.Tables, n_real: int, k: int,
+                        template_col: int, n_pad_new: int,
+                        sentinel: int) -> kernels.Tables:
+    import jax.numpy as jnp
+
+    pad = n_pad_new - n_real - k
+
+    def cols(a, fill):  # [*, N_old_pad] -> [*, n_pad_new] along the last axis
+        parts = [a[..., :n_real],
+                 jnp.repeat(a[..., template_col:template_col + 1], k, axis=-1)]
+        if pad:
+            parts.append(jnp.full(a.shape[:-1] + (pad,), fill, a.dtype))
+        return jnp.concatenate(parts, axis=-1)
+
+    def rows(a, fill):  # [N_old_pad, *] -> [n_pad_new, *]
+        parts = [a[:n_real],
+                 jnp.repeat(a[template_col:template_col + 1], k, axis=0)]
+        if pad:
+            parts.append(jnp.full((pad,) + a.shape[1:], fill, a.dtype))
+        return jnp.concatenate(parts, axis=0)
+
+    upd = {f: cols(getattr(tb, f), fill) for f, fill in _EXT_GN_FILL}
+    upd.update({f: cols(getattr(tb, f), sentinel) for f in _EXT_DOM_FIELDS})
+    upd.update({f: rows(getattr(tb, f), fill) for f, fill in _EXT_NROW_FILL})
+    upd["node_zone"] = cols(tb.node_zone, 0)
+    return tb._replace(**upd)
+
+
+_EXTEND_JITS: Dict[object, object] = {}
+
+
+def extend_tables_on_device(tables: kernels.Tables, *, n_real: int, k: int,
+                            template_col: int, n_pad_new: int, sentinel: int,
+                            mesh: Optional[Mesh] = None) -> kernels.Tables:
+    """Grow device-resident Tables by k template-column copies (+ phantom
+    re-pad to n_pad_new), entirely on device. `n_real` is the current real
+    column count (old phantom columns are overwritten), `sentinel` the padded
+    domain sentinel id (unchanged by gate). With `mesh`, the program runs
+    under the table shardings so each shard grows locally."""
+    key = _mesh_key(mesh) if mesh is not None else None
+    fn = _EXTEND_JITS.get(key)
+    if fn is None:
+        if mesh is None:
+            fn = jax.jit(_extend_tables_impl,
+                         static_argnums=(1, 2, 3, 4, 5))
+        else:
+            ts = table_shardings(mesh)
+            fn = jax.jit(_extend_tables_impl,
+                         static_argnums=(1, 2, 3, 4, 5),
+                         in_shardings=(ts,), out_shardings=ts)
+        _EXTEND_JITS[key] = fn
+    return fn(tables, n_real, k, template_col, n_pad_new, sentinel)

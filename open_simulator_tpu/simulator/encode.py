@@ -1441,17 +1441,23 @@ def extend_node_axis(
     so every appended column is a verbatim copy of the template column, EXCEPT
     the rows listed in hostname_counters/hostname_carriers: those topologies
     have one domain per node, so each appended node gets a fresh domain id.
-    The domain axis therefore grows by k; the seed/edom sentinel column moves
-    from D to D+k and the new interior columns start at zero (no placed pod
-    can be on an appended node). Seeds for the appended nodes are zero for the
-    same reason — the caller must only append nodes that carry no bound pods."""
+    With hostname rows present the domain axis therefore grows by k: the
+    seed/edom sentinel column moves from D to D+k and the new interior columns
+    start at zero (no placed pod can be on an appended node). WITHOUT hostname
+    rows the domain axis is untouched — the appended columns reuse the
+    template's domain ids verbatim, so repeated extensions never widen the
+    counter tables (and the device-resident growth path in probe.py can
+    extend the node axis shard-locally with no sentinel remap). Seeds for the
+    appended nodes are zero either way — the caller must only append nodes
+    that carry no bound pods."""
     if k <= 0:
         return bt
     import dataclasses
 
     N = bt.alloc.shape[0]
     D = bt.seed_counter.shape[1] - 1
-    newD = D + k
+    per_node = bool(hostname_counters) or bool(hostname_carriers)
+    newD = D + k if per_node else D
 
     def rep_col(a: np.ndarray) -> np.ndarray:  # [*, N, ...] along axis 1
         return np.concatenate(
@@ -1466,6 +1472,8 @@ def extend_node_axis(
             [a, np.zeros((k,) + a.shape[1:], a.dtype)], axis=0)
 
     def widen(a: np.ndarray) -> np.ndarray:  # [*, D+1] -> [*, newD+1]
+        if not per_node:
+            return a  # domain axis unchanged: no widening, no sentinel move
         out = np.zeros(a.shape[:-1] + (newD + 1,), a.dtype)
         out[..., :D] = a[..., :D]
         out[..., newD] = a[..., D]  # sentinel column moves with D
@@ -1475,6 +1483,8 @@ def extend_node_axis(
 
     def dom_ext(dom: np.ndarray, per_node_rows: Sequence[int]) -> np.ndarray:
         ext = rep_col(dom)
+        if not per_node:
+            return ext  # template domain ids replicate verbatim
         ext = np.where(ext == D, newD, ext).astype(np.int32)  # sentinel remap
         for t in per_node_rows:
             ext[t, N:] = new_dom_ids  # fresh hostname domain per appended node

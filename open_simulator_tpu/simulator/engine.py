@@ -515,8 +515,9 @@ class Simulator:
         # compiled on recording runs and can never alias a scheduling kernel
         obs.record_dispatch("explain_pod", xray=True, zones=bt.n_zones,
                             gpu=enable_gpu, storage=enable_storage, **dims)
+        kns, _ = self._kernel_ns(donate=False)  # diagnostics never donate
         feasible, stages, total, comp = guard.supervised(functools.partial(
-            kernels.explain_jit,
+            kns.explain_jit,
             tables, carry_start, jnp.int32(g), jnp.int32(forced),
             jnp.asarray(True), n_zones=bt.n_zones, enable_gpu=enable_gpu,
             enable_storage=enable_storage, w=self.score_w,
@@ -658,7 +659,18 @@ class Simulator:
         # Pad the node axis the same way: the capacity planner re-simulates at N,
         # N+1, N+2... nodes (apply.go:203-259) — bucketed N keeps the XLA compile
         # cache warm across probes. Phantom nodes are infeasible by construction.
-        return pad_batch_tables(bt, bucket_capped(self.na.N, 1024))
+        target = bucket_capped(self.na.N, 1024)
+        mesh = self._resolve_mesh()
+        if mesh is not None:
+            # pre-partition at encode time: align the padded node axis to the
+            # mesh's shard count here (pow2 buckets already divide pow2 shard
+            # counts; this covers the rest), so to_device_sharded's own pad is
+            # provably a no-op and every table transfers pre-partitioned
+            from ..parallel.mesh import NODE_AXIS
+
+            shards = mesh.shape[NODE_AXIS]
+            target += (-target) % shards
+        return pad_batch_tables(bt, target)
 
     def encode_batch_raw(self, to_schedule: List[dict]) -> BatchTables:
         """encode_batch WITHOUT the encoder-axis/node-axis padding: the exact
@@ -695,6 +707,30 @@ class Simulator:
         # then multiples of 2048 (a 10k batch scans 10240 steps, not 16384).
         pad = bucket_capped(len(batch), 2048)
         return build_batch_tables(self.encoder, batch, self.placed, self.match_cache, pad_to=pad)
+
+    def _kernel_ns(self, donate: bool = True):
+        """The dispatch namespace for this simulator: the plain `kernels`
+        module single-device, or the mesh's cached sharded-executable set
+        (parallel/mesh.py ShardedKernels — explicit in/out shardings so
+        chained segments never reshard the carry, donate_argnums so the
+        carry updates in place). `donate=False` keeps every dispatch's input
+        carry alive — required while the xray recorder reads segment-start
+        carries after the dispatch loop. Returns (namespace, sharded)."""
+        mesh = self._resolve_mesh()
+        if mesh is None:
+            return kernels, False
+        from ..parallel.mesh import sharded_kernels
+
+        return sharded_kernels(mesh, donate=donate), True
+
+    def _audit_reshard(self, ns, carry) -> None:
+        """Count any carry leaf whose layout left the declared shardings
+        (simon_reshard_bytes_total; 0 on every sharded-executable path)."""
+        from ..parallel.mesh import carry_reshard_bytes
+
+        b = carry_reshard_bytes(carry, ns.carry_sh)
+        if b:
+            obs.RESHARD_BYTES.inc(b)
 
     def _route_digest(self) -> tuple:
         """Everything _wave_eligibility reads besides the (immutable) group:
@@ -954,6 +990,15 @@ class Simulator:
         xr = self._xray_run
         want_stats = xr is not None or self._segment_timing
         aff_stats: Dict[int, object] = {}  # outs index -> [3] i32 device array
+        # Sharded executables + donation: the carry buffers chain in place
+        # between segments. Donation is OFF while recording — the xray
+        # decision sets are evaluated against segment-START carries AFTER the
+        # dispatch loop, so every segment's input must stay alive then.
+        donate = xr is None
+        kns, sharded = self._kernel_ns(donate=donate)
+        if sharded:
+            dims["donate"] = donate  # donating/kept-alive are distinct
+            # executables; never alias their compile-cache signatures
         xb = (xr.new_batch(self.na.names, dims["cfg"],
                            [{"kind": s[0], "start": s[1], "len": s[2],
                              "group": (s[3] if len(s) > 3 else -1)}
@@ -984,7 +1029,7 @@ class Simulator:
                                     gpu=enable_gpu, storage=enable_storage,
                                     **dims)
                 call = functools.partial(
-                    kernels.schedule_batch,
+                    kns.schedule_batch,
                     tables, carry, pg, fn, vd,
                     n_zones=bt.n_zones, enable_gpu=enable_gpu,
                     enable_storage=enable_storage,
@@ -1001,7 +1046,7 @@ class Simulator:
                                     sa=sa_live,
                                     zones=bt.n_zones if ss_live else 2, **dims)
                 call = functools.partial(
-                    kernels.schedule_group_serial,
+                    kns.schedule_group_serial,
                     tables, carry, np.int32(g), vd, np.bool_(cap1),
                     w=self.score_w, filters=self.filter_flags,
                     # n_zones only shapes the ss_live zone table; pin it for
@@ -1023,7 +1068,7 @@ class Simulator:
                                     zones=bt.n_zones if ss_live else 2, **dims,
                                     **({"stats": True} if want_stats else {}))
                 call = functools.partial(
-                    kernels.schedule_affinity_wave,
+                    kns.schedule_affinity_wave,
                     tables, carry, np.int32(g), np.int32(length),
                     np.bool_(cap1), ss_live=ss_live,
                     w=self.score_w, filters=self.filter_flags,
@@ -1046,7 +1091,7 @@ class Simulator:
                 obs.record_dispatch("schedule_wave", block=block, k=kmax,
                                     gpu_live=gpu_live, **dims)
                 call = functools.partial(
-                    kernels.schedule_wave,
+                    kns.schedule_wave,
                     tables, carry, np.int32(g), np.int32(length),
                     np.bool_(cap1), gpu_live=gpu_live,
                     w=self.score_w, filters=self.filter_flags,
@@ -1055,6 +1100,8 @@ class Simulator:
                 carry, counts, _ = guard.supervised(
                     call, site="dispatch", pods=length)
                 outs.append((seg, counts, carry))
+            if sharded:
+                self._audit_reshard(kns, carry)
             if self._segment_timing:
                 # per-kind wall attribution (bench breakdown): forces the
                 # async dispatch to finish, so only ever enabled explicitly
@@ -1115,11 +1162,18 @@ class Simulator:
         # ONLY for segments that contain a failure: holding every segment's
         # carry would multiply peak device memory by the segment count.
         fail_mask = choices[:P] < 0
-        if fail_mask.any():
+        if fail_mask.any() and not (sharded and donate):
             seg_carry_of: Dict[int, object] = {
                 int(k): outs[int(k)][2] for k in np.unique(seg_of[fail_mask])
             }
         else:
+            # Donated chain: intermediate carry buffers were consumed in
+            # place, so failure diagnosis evaluates against the end-of-batch
+            # carry instead of the failing segment's end state. Reason DETAIL
+            # may differ from the single-device path by the trailing
+            # segments' placements (a documented deviation, like the serial
+            # path's per-attempt vs segment-end gap); placement itself is
+            # identical on both paths.
             seg_carry_of = {}
         if xr is not None:
             # decision sets are evaluated against segment-START state (what
@@ -1279,6 +1333,11 @@ class Simulator:
         P = len(run)
         segs = self._segments(bt, P) if self.use_waves else [("serial", 0, P)]
         dims = self._dispatch_dims(bt)
+        # probes never stage xray decision sets against mid-batch carries, so
+        # the donated sharded chain is always safe here
+        kns, sharded = self._kernel_ns(donate=True)
+        if sharded:
+            dims["donate"] = True
         placed_parts = []
         for seg in segs:
             faults.maybe_fail("dispatch")
@@ -1296,7 +1355,7 @@ class Simulator:
                                     gpu=enable_gpu, storage=enable_storage,
                                     **dims)
                 call = functools.partial(
-                    kernels.schedule_batch,
+                    kns.schedule_batch,
                     tables, carry, pg, fn, vd,
                     n_zones=bt.n_zones, enable_gpu=enable_gpu,
                     enable_storage=enable_storage,
@@ -1313,7 +1372,7 @@ class Simulator:
                                     sa=sa_live,
                                     zones=bt.n_zones if ss_live else 2, **dims)
                 call = functools.partial(
-                    kernels.schedule_group_serial,
+                    kns.schedule_group_serial,
                     tables, carry, np.int32(g), vd, np.bool_(cap1),
                     w=self.score_w, filters=self.filter_flags,
                     # n_zones only shapes the ss_live zone table; pin it for
@@ -1331,7 +1390,7 @@ class Simulator:
                                     ss=ss_live,
                                     zones=bt.n_zones if ss_live else 2, **dims)
                 call = functools.partial(
-                    kernels.schedule_affinity_wave,
+                    kns.schedule_affinity_wave,
                     tables, carry, np.int32(g), np.int32(length),
                     np.bool_(cap1), ss_live=ss_live,
                     w=self.score_w, filters=self.filter_flags,
@@ -1348,7 +1407,7 @@ class Simulator:
                 obs.record_dispatch("schedule_wave", block=block, k=kmax,
                                     gpu_live=gpu_live, **dims)
                 call = functools.partial(
-                    kernels.schedule_wave,
+                    kns.schedule_wave,
                     tables, carry, np.int32(g), np.int32(length),
                     np.bool_(cap1), gpu_live=gpu_live,
                     w=self.score_w, filters=self.filter_flags,
@@ -1357,6 +1416,8 @@ class Simulator:
                 carry, _, placed = guard.supervised(
                     call, site="dispatch", pods=length)
                 placed_parts.append(placed)
+        if sharded:
+            self._audit_reshard(kns, carry)
         self._last_tables, self._last_carry = bt, carry
         faults.maybe_fail("fetch")
         total = int(guard.supervised(
@@ -1496,8 +1557,9 @@ class Simulator:
         jnp = _jax()
 
         enable_gpu, enable_storage = getattr(self, "_last_flags", (True, True))
+        kns, _ = self._kernel_ns(donate=False)  # diagnostics never donate
         feasible, stages = guard.supervised(functools.partial(
-            kernels.feasibility_jit,
+            kns.feasibility_jit,
             tables, carry, jnp.int32(g), jnp.int32(forced), jnp.asarray(True),
             enable_gpu=enable_gpu, enable_storage=enable_storage,
             filters=self.filter_flags,

@@ -63,7 +63,6 @@ import numpy as np
 from ..core import constants as C
 from ..core.types import UnscheduledPod
 from ..obs import instruments as obs
-from ..ops import kernels
 from ..resilience import guard
 from ..utils.objutil import labels_of, match_label_selector, name_of, namespace_of
 from .encode import (
@@ -227,8 +226,9 @@ def _fits(sim, g: int, node_i: int, placed2) -> bool:
     bt = pad_batch_tables(bt, bucket_capped(sim.na.N, 1024))
     tables, carry = sim._to_device(bt)
     enable_gpu, enable_storage = plugin_flags(bt)
+    kns, _ns = sim._kernel_ns(donate=False)  # diagnostics never donate
     feasible, _ = guard.supervised(functools.partial(
-        kernels.feasibility_jit,
+        kns.feasibility_jit,
         tables, carry, jnp.int32(g), jnp.int32(-1), jnp.asarray(True),
         enable_gpu=enable_gpu, enable_storage=enable_storage,
         filters=sim.filter_flags,
@@ -294,8 +294,9 @@ def try_preempt(sim, pod: dict) -> Tuple[int, List[dict], Dict[str, int]]:
     tables, carry = sim._to_device(bt)
     enable_gpu, enable_storage = plugin_flags(bt)
     g, forced = int(bt.pod_group[0]), int(bt.forced_node[0])
+    kns, _ns = sim._kernel_ns(donate=False)  # diagnostics never donate
     feasible, stages = guard.supervised(functools.partial(
-        kernels.feasibility_jit,
+        kns.feasibility_jit,
         tables, carry, jnp.int32(g), jnp.int32(forced), jnp.asarray(True),
         enable_gpu=enable_gpu, enable_storage=enable_storage,
         filters=sim.filter_flags,

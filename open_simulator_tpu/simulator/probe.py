@@ -323,12 +323,21 @@ class ProbeSession:
 
     def ensure_capacity(self, n: int) -> None:
         """Grow the template axis to cover candidate n via the node-axis
-        extension path (append pre-encoded template columns; no re-encode)."""
+        extension path (append pre-encoded template columns; no re-encode).
+
+        When the session holds device-resident tables and the extension
+        cannot widen the domain axis (no hostname-keyed counter/carrier
+        rows), the growth happens SHARD-LOCALLY on the device
+        (mesh.extend_tables_on_device): the template column is already
+        resident, so no table bytes round-trip through the host — only the
+        (numpy) host mirror is rebuilt for seeds and dispatch dims. Hostname
+        rows fall back to the full host re-upload."""
         if n <= self.n_new:
             return
-        self._check_backend()  # _upload below transfers to the session backend
+        self._check_backend()  # the paths below touch the session backend
         target = bucket_capped(self.n_base + n, 1024)
         k = target - (self.n_base + self.n_new)
+        n_real_old = self.n_base + self.n_new
         if self._bt_raw is not None:
             self._bt_raw = extend_node_axis(
                 self._bt_raw, k, self.n_base,
@@ -339,8 +348,40 @@ class ProbeSession:
         self.n_new += k
         self.extensions += 1
         obs.PROBE_EXTENSIONS.inc()
-        if self._bt_raw is not None:
+        if self._bt_raw is None:
+            return
+        if not self._host_counters and not self._host_carriers:
+            self._extend_device(k, n_real_old)
+        else:
             self._upload()
+
+    def _extend_device(self, k: int, n_real_old: int) -> None:
+        """Shard-local growth: re-pad the HOST mirror (numpy only — seeds and
+        dispatch dims read it) and extend the device tables in place from
+        their own template column. simon_device_transfer_bytes_total does not
+        move: zero table bytes cross the host boundary."""
+        faults.maybe_fail("to_device")
+        faults.maybe_fail("oom_to_device")
+        from ..parallel.mesh import extend_tables_on_device
+
+        bt = pad_encoder_axes(self._bt_raw)
+        bt = pad_batch_tables(bt, bucket_capped(self.n_base + self.n_new, 1024))
+        sentinel = bt.seed_counter.shape[1] - 1
+        if sentinel != self._bt.seed_counter.shape[1] - 1:
+            # the no-hostname gate makes this unreachable (the domain axis
+            # cannot widen); if an encoder change ever breaks that, fall back
+            # to the host path rather than corrupt the resident tables
+            self._upload()
+            return
+        self._bt = bt
+        self._n_pad = bt.alloc.shape[0]
+        self._tables = extend_tables_on_device(
+            self._tables, n_real=n_real_old, k=k, template_col=self.n_base,
+            n_pad_new=self._n_pad, sentinel=sentinel, mesh=self._mesh)
+        self._seeds = (bt.seed_requested, bt.seed_nonzero, bt.seed_port_used,
+                       bt.seed_counter, bt.seed_carrier, bt.seed_dev_used,
+                       bt.seed_vg_req, bt.seed_sdev_alloc)
+        self._flags = plugin_flags(bt)
 
     # ------------------------------------------------------------ probing -----
 
@@ -482,6 +523,16 @@ class ProbeSession:
                 "mesh": self._mesh is not None,
                 # w/filters are jit statics on the fan-out kernels too
                 "cfg": f"{hash((sim.score_w, sim.filter_flags)) & 0xffffffff:08x}"}
+        if self._mesh is not None:
+            # the mesh's sharded-executable set: explicit in/out shardings
+            # keep the [S]-carry in its scenario layout across chained
+            # segments (zero resharding), and donation updates it in place
+            from ..parallel.mesh import sharded_kernels
+
+            kns = sharded_kernels(self._mesh, donate=True)
+            dims["donate"] = True
+        else:
+            kns = kernels
         placed_parts = []
         with ctx:
             for seg in self._segs:
@@ -499,7 +550,7 @@ class ProbeSession:
                     obs.record_dispatch(
                         "probe_serial_fanout", P=pad, zones=bt.n_zones,
                         gpu=enable_gpu, storage=enable_storage, **dims)
-                    carry_s, placed = kernels.probe_serial_fanout(
+                    carry_s, placed = kns.probe_serial_fanout(
                         self._tables, carry_s, active,
                         jnp.asarray(pg), jnp.asarray(fn), jnp.asarray(vd),
                         n_zones=bt.n_zones, enable_gpu=enable_gpu,
@@ -516,7 +567,7 @@ class ProbeSession:
                     obs.record_dispatch(
                         "probe_group_serial_fanout", P=pad, ss=ss_live,
                         sa=sa_live, zones=bt.n_zones if ss_live else 2, **dims)
-                    carry_s, placed = kernels.probe_group_serial_fanout(
+                    carry_s, placed = kns.probe_group_serial_fanout(
                         self._tables, carry_s, active,
                         jnp.int32(g), jnp.asarray(vd), jnp.asarray(cap1),
                         w=sim.score_w, filters=sim.filter_flags,
@@ -531,7 +582,7 @@ class ProbeSession:
                     obs.record_dispatch(
                         "probe_affinity_wave_fanout", block=block, ss=ss_live,
                         zones=bt.n_zones if ss_live else 2, **dims)
-                    carry_s, placed = kernels.probe_affinity_wave_fanout(
+                    carry_s, placed = kns.probe_affinity_wave_fanout(
                         self._tables, carry_s, active,
                         jnp.int32(g), jnp.int32(length), jnp.asarray(cap1),
                         ss_live=ss_live, w=sim.score_w,
@@ -544,7 +595,7 @@ class ProbeSession:
                     kmax = kernels.wave_kmax(length, n_real, block)
                     obs.record_dispatch("probe_wave_fanout", block=block,
                                         k=kmax, gpu_live=gpu_live, **dims)
-                    carry_s, placed = kernels.probe_wave_fanout(
+                    carry_s, placed = kns.probe_wave_fanout(
                         self._tables, carry_s, active,
                         jnp.int32(g), jnp.int32(length), jnp.asarray(cap1),
                         kmax=kmax, gpu_live=gpu_live, w=sim.score_w,
@@ -553,6 +604,12 @@ class ProbeSession:
                     )
                 placed_parts.append(placed)
             faults.maybe_fail("fetch")
+            if self._mesh is not None:
+                from ..parallel.mesh import carry_reshard_bytes
+
+                b = carry_reshard_bytes(carry_s, kns.carry_s_sh)
+                if b:
+                    obs.RESHARD_BYTES.inc(b)
             placed_s = np.asarray(jnp.sum(jnp.stack(placed_parts), axis=0))
             requested_s = np.asarray(carry_s.requested)
         return placed_s, requested_s

@@ -1,0 +1,35 @@
+"""unsharded-transfer fixtures: layout-less transfers in a mesh-aware module.
+
+The `from ...parallel.mesh import` below is what makes this module
+"mesh-aware" — the rule only patrols modules that touch the sharding
+machinery (engine.py, probe.py, parallel/), so kernels.py's single-device
+module-level jits stay exempt.
+"""
+
+import jax
+
+from open_simulator_tpu.ops import kernels
+from open_simulator_tpu.parallel.mesh import table_shardings
+
+
+def bad_device_put(x):
+    return jax.device_put(x)  # FINDING: no explicit sharding
+
+
+def bad_jit_dispatch():
+    # FINDING: a dispatch kernel jitted without in_shardings — GSPMD
+    # re-infers the layout per call
+    return jax.jit(kernels.schedule_wave, static_argnames=("block",))
+
+
+def ok_device_put(x, mesh):
+    return jax.device_put(x, table_shardings(mesh).alloc)
+
+
+def ok_jit_with_shardings(mesh):
+    ts = table_shardings(mesh)
+    return jax.jit(kernels.feasibility_jit, in_shardings=(ts,))
+
+
+def ok_non_dispatch_jit(fn):
+    return jax.jit(fn)  # not a dispatch kernel: out of scope

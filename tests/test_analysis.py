@@ -125,6 +125,28 @@ def test_swallowed_exception_rule_fires():
                    suppressed=True) == 1
 
 
+def test_unsharded_transfer_rule_fires():
+    fr = analyze_file(str(FIXTURES / "unsharded_hazard.py"))
+    hits = [f for f in fr.findings
+            if f.rule == "unsharded-transfer" and not f.suppressed]
+    assert len(hits) == 2
+    msgs = "\n".join(f.message for f in hits)
+    assert "device_put without an explicit sharding" in msgs
+    assert "without in_shardings" in msgs
+    # the ok_* half declares its layouts (or jits a non-dispatch fn): clean
+    src = (FIXTURES / "unsharded_hazard.py").read_text().splitlines()
+    ok_start = next(i for i, l in enumerate(src, 1) if "def ok_device_put" in l)
+    assert not any(f.line >= ok_start for f in hits)
+
+
+def test_unsharded_transfer_scoped_to_mesh_aware_modules():
+    # kernels.py jits dispatch kernels with no in_shardings by design (the
+    # single-device variants) — it never imports parallel/, so the rule must
+    # not patrol it
+    fr = analyze_file(str(PACKAGE / "ops" / "kernels.py"))
+    assert not any(f.rule == "unsharded-transfer" for f in fr.findings)
+
+
 def test_swallowed_exception_spares_handled_paths():
     # narrow types, re-raise, logging, metric counting, error returns, and
     # sys.exit all count as handling — the ok_* half of the fixture is clean

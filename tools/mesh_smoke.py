@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""CI smoke for end-to-end GSPMD sharding (fast, CPU-only, 2 virtual shards).
+
+Runs the product path (Simulator with a pinned 2-shard node mesh) against the
+single-device engine on a mixed wave/affinity/serial workload and asserts the
+properties the mesh bench rows rely on, so sharding regressions fail in CI
+instead of in the bench:
+
+- per-(node, scheduling-signature) placement census is BIT-identical to the
+  single-device run (not just >=99% agreement: sharding must be invisible);
+- zero reshard bytes between chained dispatches
+  (simon_reshard_bytes_total == 0: every segment's output carry left the
+  dispatch already in the next segment's declared input sharding);
+- every output carry leaf sits in the declared carry sharding;
+- a watchdog wedge during a SHARDED dispatch fails over to the single-device
+  CPU fallback and resumes from the committed prefix: the first call's
+  placements survive untouched and the replayed call converges to the
+  fault-free final census.
+
+Prints one JSON line with the measured numbers.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# 2 virtual CPU devices BEFORE backend init; config route (see utils/devices)
+from open_simulator_tpu.utils.devices import (  # noqa: E402
+    force_cpu_platform,
+    request_cpu_devices,
+)
+
+request_cpu_devices(2)
+force_cpu_platform()
+os.environ["OPEN_SIMULATOR_MESH"] = "1"
+
+import copy  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from open_simulator_tpu.obs import REGISTRY  # noqa: E402
+from open_simulator_tpu.parallel.mesh import (  # noqa: E402
+    carry_reshard_bytes,
+    make_node_mesh,
+    sharded_kernels,
+)
+from open_simulator_tpu.resilience import guard  # noqa: E402
+from open_simulator_tpu.resilience.faults import (  # noqa: E402
+    FaultPlan,
+    FaultSpec,
+    installed,
+)
+from open_simulator_tpu.simulator.encode import scheduling_signature  # noqa: E402
+from open_simulator_tpu.simulator.engine import Simulator  # noqa: E402
+from open_simulator_tpu.utils.synth import synth_cluster  # noqa: E402
+
+N_NODES = 100
+N_PODS = 900
+
+
+def census(sim):
+    placed = {}
+    for i, node_pods in enumerate(sim.pods_on_node):
+        for p in node_pods:
+            key = (i, scheduling_signature(p))
+            placed[key] = placed.get(key, 0) + 1
+    return placed
+
+
+def run(nodes, pods, use_mesh):
+    sim = Simulator(copy.deepcopy(nodes), use_mesh=use_mesh)
+    failed = sim.schedule_pods(copy.deepcopy(pods))
+    return sim, len(failed)
+
+
+def main() -> int:
+    nodes, pods = synth_cluster(N_NODES, N_PODS, hard_predicates=True)
+
+    mesh_sim, mesh_failed = run(nodes, pods, use_mesh=True)
+    assert mesh_sim._mesh is not None, "mesh path did not engage"
+    single_sim, single_failed = run(nodes, pods, use_mesh=False)
+
+    identical = census(mesh_sim) == census(single_sim)
+    reshard = int(REGISTRY.values().get("simon_reshard_bytes_total") or 0)
+
+    # every final carry leaf sits in its declared sharding
+    sk = sharded_kernels(mesh_sim._mesh)
+    carry_layout_ok = (
+        carry_reshard_bytes(mesh_sim._last_carry, sk.carry_sh) == 0)
+
+    # wedge mid-run on the SHARDED path: committed prefix survives, the
+    # replay (single-device CPU fallback) converges to the fault-free state
+    first, second = pods[:300], pods[300:]
+    base = Simulator(copy.deepcopy(nodes), use_mesh=True)
+    base.schedule_pods(copy.deepcopy(first))
+    committed = census(base)
+    base.schedule_pods(copy.deepcopy(second))
+    baseline = census(base)
+
+    wedged = Simulator(copy.deepcopy(nodes), use_mesh=True)
+    wedged.schedule_pods(copy.deepcopy(first))
+    prefix_ok = census(wedged) == committed
+    with installed(FaultPlan([FaultSpec("watchdog_wedge", 1)])):
+        wedged.schedule_pods(copy.deepcopy(second))
+    failover_ok = (census(wedged) == baseline
+                   and wedged.backend_path[-1] == "cpu"
+                   and census(wedged) is not None
+                   and prefix_ok)
+    guard.reset_for_tests()  # drop the injected quarantine before exiting
+
+    rec = {
+        "nodes": N_NODES, "pods": N_PODS, "shards": 2,
+        "placements_bit_identical": identical,
+        "failed_parity": mesh_failed == single_failed,
+        "reshard_bytes": reshard,
+        "carry_layout_ok": bool(carry_layout_ok),
+        "wedge_failover_resumes_from_prefix": bool(failover_ok),
+    }
+    print(json.dumps(rec), flush=True)
+
+    assert identical, "mesh placements diverged from single-device"
+    assert mesh_failed == single_failed, "failure counts diverged"
+    assert reshard == 0, f"chained dispatches resharded {reshard} bytes"
+    assert carry_layout_ok, "final carry left the declared sharding"
+    assert failover_ok, "sharded wedge failover did not resume from prefix"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
