@@ -15,7 +15,8 @@ statements can carry the waiver above them:
     req = requests.astype(np.float64).copy()
 
 Rule ids are kebab-case; `ignore[a,b]` lists several; the `-- reason` text is
-required by convention (CI reviewers grep for it) but not enforced.
+ENFORCED: a waiver without it is a WARNING-severity `suppression-reason`
+finding (rules.py), which a bare `ignore[*]` deliberately cannot cover.
 """
 
 from __future__ import annotations
@@ -95,6 +96,7 @@ def register(rule_id: str, severity: Severity, doc: str):
 
 
 _SUPPRESS_RE = re.compile(r"#\s*simonlint:\s*ignore\[([A-Za-z0-9_\-,\s*]+)\]")
+_REASON_RE = re.compile(r"\s*--\s*\S")
 
 
 def suppressions_for(source_lines: Sequence[str]) -> Dict[int, frozenset]:
@@ -102,7 +104,9 @@ def suppressions_for(source_lines: Sequence[str]) -> Dict[int, frozenset]:
 
     A trailing comment suppresses its own line; a comment-only line
     suppresses the next line (chains of comment-only lines all bind to the
-    first code line below them). `*` suppresses every rule.
+    first code line below them). `*` suppresses every rule. A waiver WITHOUT
+    its `-- reason` text cannot suppress `suppression-reason` — the hygiene
+    finding that flags it — even by naming it explicitly.
     """
     out: Dict[int, set] = {}
     pending: set = set()
@@ -110,6 +114,8 @@ def suppressions_for(source_lines: Sequence[str]) -> Dict[int, frozenset]:
         m = _SUPPRESS_RE.search(raw)
         stripped = raw.strip()
         here = {r.strip() for r in m.group(1).split(",") if r.strip()} if m else set()
+        if m and not _REASON_RE.match(raw[m.end():]):
+            here.discard("suppression-reason")
         if stripped.startswith("#") or (not stripped and pending):
             # comment-only waivers (and any blank lines after them) carry
             # forward to the next code line
@@ -125,4 +131,10 @@ def is_suppressed(finding: Finding, supp: Dict[int, frozenset]) -> bool:
     rules = supp.get(finding.line)
     if not rules:
         return False
+    if finding.rule == "suppression-reason":
+        # the waiver-hygiene rule is only waivable by an explicit REASONED
+        # ignore[suppression-reason] (suppressions_for drops it from bare
+        # waivers); a bare `ignore[*]` would otherwise self-suppress the
+        # very finding that flags it
+        return finding.rule in rules
     return finding.rule in rules or "*" in rules

@@ -12,6 +12,9 @@ Rule ids (stable — they appear in suppression comments and CI output):
   naked-dispatch     device-computation call site bypassing the simonguard watchdog
   fetch-in-wave-loop device->host fetch inside a per-segment/epoch/round loop body
   unsharded-transfer shardingless device_put / jit dispatch in a mesh-aware hot path
+  config-scope-across-thread  jax config scope entered in one thread, work
+                     submitted to another inside it
+  suppression-reason a `simonlint: ignore[...]` waiver without its `-- reason`
 
 Every rule is a pure function ModuleContext -> List[Finding]; file IO,
 suppressions, and exit-code policy live in runner.py.
@@ -23,7 +26,7 @@ import ast
 from typing import List, Optional, Set
 
 from ..ops.contracts import parse_spec
-from .base import Finding, Severity, register
+from .base import _REASON_RE, _SUPPRESS_RE, Finding, Severity, register
 from .context import JIT_NAMES, PARTIAL_NAMES, ModuleContext
 
 # ----------------------------------------------------------------- helpers ----
@@ -799,4 +802,126 @@ def rule_unsharded_transfer(ctx: ModuleContext) -> List[Finding]:
                     f"layout per call — declare in_shardings/out_shardings "
                     f"(or reuse parallel.mesh.sharded_kernels)",
                 ))
+    return out
+
+
+# ------------------------------------------------ config-scope-across-thread --
+
+# JAX config context managers whose effect is THREAD-LOCAL: entering one and
+# then handing work to another thread silently drops the scope for that work
+# (jax's config stack lives in a per-thread structure that copy_context()
+# does not carry). This is the exact PR 5 failure class: a post-failover
+# dispatch wrapped in `with jax.default_device(cpu)` kept landing on the
+# quarantined backend because the dispatch ran in the watchdog's worker
+# thread. The fix — re-entering the scope INSIDE the worker (guard.supervised
+# does this) — leaves no `with` wrapping a cross-thread submission, so a
+# clean tree has zero findings.
+_JAX_SCOPE_CMS = {
+    "jax.default_device", "jax.disable_jit", "jax.default_matmul_precision",
+    "jax.transfer_guard", "jax.log_compiles", "jax.debug_nans",
+    "jax.checking_leaks", "jax.enable_checks",
+}
+# a constructed Thread/Timer/Process runs its target on another thread even
+# if .start() happens later; to_thread/run_in_executor submit directly
+_THREAD_FACTORIES = {
+    "threading.Thread", "threading.Timer", "multiprocessing.Process",
+    "asyncio.to_thread",
+}
+_SUBMIT_ATTRS = {"submit", "run_in_executor", "apply_async", "map_async"}
+
+
+@register(
+    "config-scope-across-thread", Severity.ERROR,
+    "A jax config context manager (jax.default_device / disable_jit / "
+    "default_matmul_precision / ...) is entered in one thread while work is "
+    "submitted to another inside the scope (executor.submit, "
+    "threading.Thread/Timer targets, asyncio.to_thread). JAX config scopes "
+    "are thread-local and are NOT carried by copy_context(): the submitted "
+    "work runs with the scope silently absent — the post-failover "
+    "wrong-backend dispatch bug. Re-enter the scope inside the worker "
+    "(the guard.supervised pattern), or whitelist work that provably never "
+    "touches jax with `# simonlint: ignore[config-scope-across-thread] -- "
+    "<why>`.",
+)
+def rule_config_scope_across_thread(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        scope: Optional[str] = None
+        for item in node.items:
+            expr = item.context_expr
+            target = expr.func if isinstance(expr, ast.Call) else expr
+            r = ctx.resolve(target)
+            if r in _JAX_SCOPE_CMS:
+                scope = r
+                break
+        if scope is None:
+            continue
+        for sub in _walk_no_defs(node.body):
+            if not isinstance(sub, ast.Call):
+                continue
+            r = ctx.resolve(sub.func) or ""
+            hazard: Optional[str] = None
+            if r in _THREAD_FACTORIES:
+                hazard = f"{r}(...)"
+            elif (isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _SUBMIT_ATTRS):
+                hazard = f".{sub.func.attr}(...)"
+            if hazard:
+                out.append(Finding(
+                    "config-scope-across-thread", Severity.ERROR, ctx.path,
+                    sub.lineno, sub.col_offset,
+                    f"{hazard} inside `with {scope}(...)`: jax config scopes "
+                    f"are thread-local, so the submitted work runs with the "
+                    f"scope silently dropped — re-enter the scope inside the "
+                    f"worker (guard.supervised pattern)",
+                ))
+    return out
+
+
+# ---------------------------------------------------------- suppression-reason --
+
+
+def _waiver_anchor(lines: List[str], lineno: int) -> int:
+    """The code line a waiver at `lineno` binds to, mirroring
+    base.suppressions_for: a trailing comment binds to its own line, a
+    comment-only line carries forward to the first code line below. The
+    finding anchors THERE so a reasoned ignore[suppression-reason] waiver
+    covers it through the normal suppression mechanics."""
+    if not lines[lineno - 1].lstrip().startswith("#"):
+        return lineno
+    for i in range(lineno + 1, len(lines) + 1):
+        stripped = lines[i - 1].strip()
+        if stripped and not stripped.startswith("#"):
+            return i
+    return lineno
+
+
+@register(
+    "suppression-reason", Severity.WARNING,
+    "A `# simonlint: ignore[...]` waiver without its `-- reason` text. Every "
+    "suppression is a claim that a hazard is deliberate; the reason is the "
+    "evidence reviewers audit. Bare waivers rot: nobody can tell a sanctioned "
+    "device boundary from a silenced bug. (This finding is itself only "
+    "waivable by an explicit reasoned `ignore[suppression-reason]` — a bare "
+    "`ignore[*]` does not cover it.)",
+)
+def rule_suppression_reason(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for lineno, raw in enumerate(ctx.lines, start=1):
+        m = _SUPPRESS_RE.search(raw)
+        if m is None:
+            continue
+        if _REASON_RE.match(raw[m.end():]):  # the same test base.py applies
+            continue
+        anchor = _waiver_anchor(ctx.lines, lineno)
+        where = "" if anchor == lineno else f" (waiver at line {lineno})"
+        out.append(Finding(
+            "suppression-reason", Severity.WARNING, ctx.path,
+            anchor, m.start(),
+            f"waiver ignore[{m.group(1).strip()}] carries no `-- reason` "
+            f"text{where} — state why the hazard is deliberate so reviewers "
+            f"can audit it",
+        ))
     return out

@@ -167,6 +167,14 @@ def build_parser() -> argparse.ArgumentParser:
              "given paths (default: the open_simulator_tpu package)")
     p_lint.add_argument("lint_args", nargs=argparse.REMAINDER)
 
+    p_audit = sub.add_parser(
+        "audit", add_help=False,
+        help="Run simonaudit: lower every registered hot kernel on CPU and "
+             "diff its compile-time dispatch certificate (collective census, "
+             "donation, host-callback escapes, recompile digest) against the "
+             "goldens in tests/golden/audit/ (--check / --update)")
+    p_audit.add_argument("audit_args", nargs=argparse.REMAINDER)
+
     p_server = sub.add_parser("server", help="Start a HTTP server that simulates "
                                              "deploy/scale requests against a live cluster")
     p_server.add_argument("--kubeconfig", default="", help="path of the kubeconfig file")
@@ -306,6 +314,14 @@ def cmd_lint(args) -> int:
     from ..analysis.runner import run_lint
 
     return run_lint(args.lint_args)
+
+
+def cmd_audit(args) -> int:
+    """simonaudit — compile-time dispatch certificates (analysis/hlo.py).
+    Normally short-circuited in main(); this handles parse_args callers."""
+    from ..analysis.hlo import run_audit
+
+    return run_audit(args.audit_args)
 
 
 def cmd_server(args) -> int:
@@ -509,12 +525,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         from ..analysis.runner import run_lint
 
         return run_lint(argv[1:])
+    if argv[:1] == ["audit"]:
+        # same REMAINDER workaround; run_audit owns its own --help, and must
+        # set the virtual-CPU device flag before anything imports jax
+        from ..analysis.hlo import run_audit
+
+        return run_audit(argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
     from ..parity import cmd_parity
 
     handlers = {
         "apply": cmd_apply,
+        "audit": cmd_audit,
         "explain": cmd_explain,
         "lint": cmd_lint,
         "metrics": cmd_metrics,

@@ -2007,3 +2007,77 @@ def probe_serial_fanout(tb: Tables, cry_s: Carry, active_s, pod_group,
         return c2, jnp.sum((choices >= 0).astype(jnp.int32))
 
     return jax.vmap(one)(cry_s, active_s)
+
+
+# ---------------------------------------------------------------------------
+# Auditable hot-kernel registry (simonaudit, analysis/hlo.py).
+#
+# Every kernel the engine/prober dispatches on a hot path is declared here so
+# the compile-time auditor can lower it WITHOUT knowing each signature: the
+# three dynamic args that follow the (tables, carry[, active_s]) head, the
+# out-sharding tail (symbols resolved by parallel.mesh.ShardedKernels), and
+# the canonical static values the engine passes on its default route. Adding
+# a hot kernel without registering it here fails tests/test_audit.py's
+# coverage check; changing a static default changes the audit's dispatch
+# digest and trips `simon audit --check` until the goldens are reviewed.
+# ---------------------------------------------------------------------------
+
+
+class HotKernelSpec(NamedTuple):
+    """One auditable dispatch: how to build its jit and canonical arguments.
+
+    dyn:     the 3 dynamic-arg tokens after the head, resolved by the auditor
+             ('g' / 'm' / 'cap1' / 'forced' / 'valid1' scalars, 'valid_p' /
+             'pod_group' / 'forced_node' [P] arrays).
+    out:     out-sharding tail symbols ('carry'/'carry_s'/'node'/'lane'/'rep');
+             None marks a diagnostics kernel (fetch-to-host outputs, never
+             donated, no out_shardings).
+    statics: n_zones -> the canonical static tuple, in declared order — the
+             values the engine's default route folds into the compiled program.
+    fanout:  head is the (tables, carry_s, active_s) probe triple on a
+             scenario mesh instead of the engine's (tables, carry) pair.
+    """
+
+    dyn: Tuple[str, ...]
+    out: Tuple[str, ...] | None
+    statics: "object"
+    fanout: bool = False
+
+
+HOT_KERNELS = {
+    "schedule_wave": HotKernelSpec(
+        ("g", "m", "cap1"), ("carry", "node", "rep"),
+        lambda nz: (False, DEFAULT_WEIGHTS, DEFAULT_FILTERS, WAVE_BLOCK, 0)),
+    "schedule_affinity_wave": HotKernelSpec(
+        ("g", "m", "cap1"), ("carry", "node", "rep"),
+        lambda nz: (False, DEFAULT_WEIGHTS, DEFAULT_FILTERS, WAVE_BLOCK, nz,
+                    False)),
+    "schedule_group_serial": HotKernelSpec(
+        ("g", "valid_p", "cap1"), ("carry", "node", "rep"),
+        lambda nz: (DEFAULT_WEIGHTS, DEFAULT_FILTERS, False, False, nz)),
+    "schedule_batch": HotKernelSpec(
+        ("pod_group", "forced_node", "valid_p"), ("carry", "rep"),
+        lambda nz: (nz, False, False, DEFAULT_WEIGHTS, DEFAULT_FILTERS)),
+    "feasibility_jit": HotKernelSpec(
+        ("g", "forced", "valid1"), None,
+        lambda nz: (False, False, True, True, DEFAULT_FILTERS)),
+    "explain_jit": HotKernelSpec(
+        ("g", "forced", "valid1"), None,
+        lambda nz: (nz, False, False, DEFAULT_WEIGHTS, DEFAULT_FILTERS)),
+    "probe_wave_fanout": HotKernelSpec(
+        ("g", "m", "cap1"), ("carry_s", "lane"),
+        lambda nz: (False, DEFAULT_WEIGHTS, DEFAULT_FILTERS, WAVE_BLOCK, 0),
+        fanout=True),
+    "probe_affinity_wave_fanout": HotKernelSpec(
+        ("g", "m", "cap1"), ("carry_s", "lane"),
+        lambda nz: (False, DEFAULT_WEIGHTS, DEFAULT_FILTERS, WAVE_BLOCK, nz),
+        fanout=True),
+    "probe_group_serial_fanout": HotKernelSpec(
+        ("g", "valid_p", "cap1"), ("carry_s", "lane"),
+        lambda nz: (DEFAULT_WEIGHTS, DEFAULT_FILTERS, False, False, nz),
+        fanout=True),
+    "probe_serial_fanout": HotKernelSpec(
+        ("pod_group", "forced_node", "valid_p"), ("carry_s", "lane"),
+        lambda nz: (nz, False, False, DEFAULT_WEIGHTS, DEFAULT_FILTERS),
+        fanout=True),
+}

@@ -141,8 +141,10 @@ def to_device_sharded(
 
 def schedule_batch_on_mesh(bt: BatchTables, mesh: Mesh):
     """Run one schedulePods batch with the node axis sharded over `mesh`,
-    through the explicitly-sharded executable set (carry donated: the seed
-    buffers are freed into the scan's output).
+    through the explicitly-sharded executable set (carry donated into the
+    scan's output where dispatching donated executables is sound — see
+    donation_runtime_safe; multi-device CPU meshes downgrade to the
+    undonated view).
 
     Returns (final_carry, choices[P] int32). Choices index the ORIGINAL node list —
     phantom padding is infeasible by construction, so indices never exceed the real N.
@@ -314,16 +316,40 @@ def _mesh_key(mesh: Mesh) -> tuple:
 _SHARDED_CACHE: Dict[tuple, "ShardedKernels"] = {}
 
 
+def donation_runtime_safe(mesh: Mesh) -> bool:
+    """Whether DISPATCHING donated executables is sound on this mesh.
+
+    On multi-device CPU meshes the XLA:CPU async runtime intermittently
+    corrupts the in-place-aliased carry of a donated dispatch (~1/3 of
+    dispatches under a warm compile cache: garbage leaves with otherwise
+    correct outputs, and — worse — a watchdog-abandoned zombie dispatch
+    keeps writing into donated buffers the engine still owns, which is how
+    the wedge-failover smoke intermittently diverged). Observed on the
+    probe fan-out, the one-shot batch helper, AND the engine chain;
+    device-side copies and block_until_ready before the fetch still read
+    garbage, pinning it to the aliased execution itself. Donation stays on
+    for accelerator meshes (the production perf story) and single-device
+    meshes; LOWERING a donated executable is always safe — simonaudit
+    certifies the donated artifact without ever executing it."""
+    devs = list(mesh.devices.flat)
+    return len(devs) <= 1 or any(d.platform != "cpu" for d in devs)
+
+
 def sharded_kernels(mesh: Mesh, donate: bool = True) -> "ShardedKernels":
     """The cached sharded-executable set for `mesh`. Instances with equal
     meshes share one jit cache (ShardedKernels caches its jitted callables
     per (kernel, donate), and jax.jit keys on sharding equality), so every
-    engine batch / probe round over the same mesh reuses warm executables."""
+    engine batch / probe round over the same mesh reuses warm executables.
+
+    Donation requests are downgraded to the undonated view whenever
+    dispatching donated executables is unsound on this mesh
+    (donation_runtime_safe): same layouts, same jit cache, inputs kept
+    alive."""
     key = _mesh_key(mesh)
     got = _SHARDED_CACHE.get(key)
     if got is None:
         got = _SHARDED_CACHE[key] = ShardedKernels(mesh)
-    return got if donate else got.undonated()
+    return got if (donate and donation_runtime_safe(mesh)) else got.undonated()
 
 
 class ShardedKernels:
@@ -391,13 +417,56 @@ class ShardedKernels:
             donate_argnums=donate,
         )
 
+    def _tail_shardings(self, symbols):
+        """Resolve a HOT_KERNELS out-tail symbol tuple to shardings."""
+        table = {"carry": self.carry_sh, "carry_s": self.carry_s_sh,
+                 "node": self.node_sh, "lane": self.lane_sh, "rep": self.rep}
+        return tuple(table[s] for s in symbols)
+
+    def _kernel_jit(self, name, stats=False):
+        """The cached explicitly-sharded jit for registry kernel `name` —
+        the single source of truth the wrapper methods AND the simonaudit
+        lowering path (analysis/hlo.py via `lowerable`) share, so the audited
+        executable is byte-for-byte the one the engine dispatches."""
+        if stats and name != "schedule_affinity_wave":
+            # only the affinity wave has a stats output variant; silently
+            # widening another kernel's out-tail would cache a wrong-arity
+            # executable under its plain key
+            raise ValueError(f"{name} has no stats variant")
+        spec = kernels.HOT_KERNELS[name]
+        n_static = len(spec.statics(2))
+        if spec.out is None:  # diagnostics: never donated, no out_shardings
+            return self._jit(name, lambda: self._sched_jit(
+                name, 3, n_static, None, donate_ok=False), shared=True)
+        tail = spec.out + (("rep",) if stats else ())
+        # the stats flag changes the output arity -> one executable per value
+        key = f"{name}:{bool(stats)}" if name == "schedule_affinity_wave" \
+            else name
+        head = self._fanout_head(name) if spec.fanout else None
+        return self._jit(key, lambda: self._sched_jit(
+            name, 3, n_static, self._tail_shardings(tail), in_head=head))
+
+    def lowerable(self, name, *, n_zones=2, stats=False):
+        """(jit_fn, spec, meta) for simonaudit: the sharded executable
+        builder for `name` plus everything the auditor needs to lower it
+        abstractly — canonical statics, head arity, and where donation is
+        declared. The jit object is the SAME cached one the dispatch
+        wrappers use."""
+        spec = kernels.HOT_KERNELS[name]
+        statics = spec.statics(n_zones)
+        if name == "schedule_affinity_wave":
+            statics = statics[:-1] + (bool(stats),)
+        donated = (1,) if (spec.out is not None and self.donate) else ()
+        meta = {"head": 3 if spec.fanout else 2, "statics": statics,
+                "donate_argnums": donated}
+        return self._kernel_jit(name, stats=stats), spec, meta
+
     # ------------------------------------------------- engine dispatches ----
 
     def schedule_wave(self, tb, cry, g, m, cap1, *, gpu_live=False,
                       w=kernels.DEFAULT_WEIGHTS, filters=kernels.DEFAULT_FILTERS,
                       block=kernels.WAVE_BLOCK, kmax=0):
-        fn = self._jit("schedule_wave", lambda: self._sched_jit(
-            "schedule_wave", 3, 5, (self.carry_sh, self.node_sh, self.rep)))
+        fn = self._kernel_jit("schedule_wave")
         return fn(tb, cry, g, m, cap1, gpu_live, w, filters, block, kmax)
 
     def schedule_affinity_wave(self, tb, cry, g, m, cap1, *, ss_live=False,
@@ -405,12 +474,7 @@ class ShardedKernels:
                                filters=kernels.DEFAULT_FILTERS,
                                block=kernels.WAVE_BLOCK, n_zones=2,
                                stats=False):
-        # the stats flag changes the output arity -> one executable per value
-        tail = ((self.carry_sh, self.node_sh, self.rep, self.rep) if stats
-                else (self.carry_sh, self.node_sh, self.rep))
-        fn = self._jit(f"schedule_affinity_wave:{bool(stats)}",
-                       lambda: self._sched_jit(
-                           "schedule_affinity_wave", 3, 6, tail))
+        fn = self._kernel_jit("schedule_affinity_wave", stats=stats)
         return fn(tb, cry, g, m, cap1, ss_live, w, filters, block, n_zones,
                   stats)
 
@@ -418,9 +482,7 @@ class ShardedKernels:
                               w=kernels.DEFAULT_WEIGHTS,
                               filters=kernels.DEFAULT_FILTERS,
                               ss_live=False, sa_live=False, n_zones=2):
-        fn = self._jit("schedule_group_serial", lambda: self._sched_jit(
-            "schedule_group_serial", 3, 5,
-            (self.carry_sh, self.node_sh, self.rep)))
+        fn = self._kernel_jit("schedule_group_serial")
         return fn(tb, cry, g, valid, cap1, w, filters, ss_live, sa_live,
                   n_zones)
 
@@ -428,8 +490,7 @@ class ShardedKernels:
                        n_zones, enable_gpu=True, enable_storage=True,
                        w=kernels.DEFAULT_WEIGHTS,
                        filters=kernels.DEFAULT_FILTERS):
-        fn = self._jit("schedule_batch", lambda: self._sched_jit(
-            "schedule_batch", 3, 5, (self.carry_sh, self.rep)))
+        fn = self._kernel_jit("schedule_batch")
         return fn(tb, cry, pod_group, forced_node, valid, n_zones, enable_gpu,
                   enable_storage, w, filters)
 
@@ -445,8 +506,7 @@ class ShardedKernels:
                         enable_storage=True, include_dns=True,
                         include_interpod=True,
                         filters=kernels.DEFAULT_FILTERS):
-        fn = self._jit("feasibility_jit", lambda: self._sched_jit(
-            "feasibility_jit", 3, 5, None, donate_ok=False), shared=True)
+        fn = self._kernel_jit("feasibility_jit")
         return fn(tb, cry, g, forced, valid, enable_gpu, enable_storage,
                   include_dns, include_interpod, filters)
 
@@ -454,8 +514,7 @@ class ShardedKernels:
                     enable_gpu=True, enable_storage=True,
                     w=kernels.DEFAULT_WEIGHTS,
                     filters=kernels.DEFAULT_FILTERS):
-        fn = self._jit("explain_jit", lambda: self._sched_jit(
-            "explain_jit", 3, 5, None, donate_ok=False), shared=True)
+        fn = self._kernel_jit("explain_jit")
         return fn(tb, cry, g, forced, valid, n_zones, enable_gpu,
                   enable_storage, w, filters)
 
@@ -475,9 +534,7 @@ class ShardedKernels:
                           gpu_live=False, w=kernels.DEFAULT_WEIGHTS,
                           filters=kernels.DEFAULT_FILTERS,
                           block=kernels.WAVE_BLOCK, kmax=0):
-        fn = self._jit("probe_wave_fanout", lambda: self._sched_jit(
-            "probe_wave_fanout", 3, 5, (self.carry_s_sh, self.lane_sh),
-            in_head=self._fanout_head("probe_wave_fanout")))
+        fn = self._kernel_jit("probe_wave_fanout")
         return fn(tb, cry_s, active_s, g, m, cap1, gpu_live, w, filters,
                   block, kmax)
 
@@ -485,10 +542,7 @@ class ShardedKernels:
                                    ss_live=False, w=kernels.DEFAULT_WEIGHTS,
                                    filters=kernels.DEFAULT_FILTERS,
                                    block=kernels.WAVE_BLOCK, n_zones=2):
-        fn = self._jit("probe_affinity_wave_fanout", lambda: self._sched_jit(
-            "probe_affinity_wave_fanout", 3, 5,
-            (self.carry_s_sh, self.lane_sh),
-            in_head=self._fanout_head("probe_affinity_wave_fanout")))
+        fn = self._kernel_jit("probe_affinity_wave_fanout")
         return fn(tb, cry_s, active_s, g, m, cap1, ss_live, w, filters,
                   block, n_zones)
 
@@ -496,10 +550,7 @@ class ShardedKernels:
                                   *, w=kernels.DEFAULT_WEIGHTS,
                                   filters=kernels.DEFAULT_FILTERS,
                                   ss_live=False, sa_live=False, n_zones=2):
-        fn = self._jit("probe_group_serial_fanout", lambda: self._sched_jit(
-            "probe_group_serial_fanout", 3, 5,
-            (self.carry_s_sh, self.lane_sh),
-            in_head=self._fanout_head("probe_group_serial_fanout")))
+        fn = self._kernel_jit("probe_group_serial_fanout")
         return fn(tb, cry_s, active_s, g, valid, cap1, w, filters, ss_live,
                   sa_live, n_zones)
 
@@ -507,9 +558,7 @@ class ShardedKernels:
                             valid, *, n_zones, enable_gpu=True,
                             enable_storage=True, w=kernels.DEFAULT_WEIGHTS,
                             filters=kernels.DEFAULT_FILTERS):
-        fn = self._jit("probe_serial_fanout", lambda: self._sched_jit(
-            "probe_serial_fanout", 3, 5, (self.carry_s_sh, self.lane_sh),
-            in_head=self._fanout_head("probe_serial_fanout")))
+        fn = self._kernel_jit("probe_serial_fanout")
         return fn(tb, cry_s, active_s, pod_group, forced_node, valid,
                   n_zones, enable_gpu, enable_storage, w, filters)
 

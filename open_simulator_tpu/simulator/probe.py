@@ -526,11 +526,16 @@ class ProbeSession:
         if self._mesh is not None:
             # the mesh's sharded-executable set: explicit in/out shardings
             # keep the [S]-carry in its scenario layout across chained
-            # segments (zero resharding), and donation updates it in place
-            from ..parallel.mesh import sharded_kernels
+            # segments (zero resharding), and the donated [S]-carry chain
+            # updates in place — except where dispatching donated
+            # executables is unsound (multi-device CPU meshes: the factory
+            # downgrades to the undonated view; see
+            # parallel.mesh.donation_runtime_safe, found when this path
+            # intermittently fetched garbage `requested` leaves)
+            from ..parallel.mesh import donation_runtime_safe, sharded_kernels
 
             kns = sharded_kernels(self._mesh, donate=True)
-            dims["donate"] = True
+            dims["donate"] = donation_runtime_safe(self._mesh)
         else:
             kns = kernels
         placed_parts = []

@@ -1,0 +1,23 @@
+"""Fixture: suppression waivers without their `-- reason` text
+(suppression-reason). The bare waiver still suppresses its target rule, but
+is itself a WARNING finding; the reasoned forms are clean."""
+
+import numpy as np
+
+
+def bare_trailing_waiver():
+    return np.zeros(4, np.float64)  # simonlint: ignore[dtype-drift]
+
+
+def bare_comment_only_waiver():
+    # simonlint: ignore[dtype-drift]
+    return np.ones(4, np.float64)
+
+
+def reasoned_waiver_is_clean():
+    return np.zeros(4, np.float64)  # simonlint: ignore[dtype-drift] -- fixture: host staging buffer
+
+
+def reasoned_comment_only_is_clean():
+    # simonlint: ignore[dtype-drift] -- fixture: host staging buffer
+    return np.ones(4, np.float64)

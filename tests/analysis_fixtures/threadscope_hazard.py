@@ -1,0 +1,65 @@
+"""Fixture: jax config scopes entered in one thread, work submitted to
+another inside them (config-scope-across-thread). The hazard half submits
+from inside the scope; the ok half re-enters the scope in the worker (the
+guard.supervised pattern) or submits outside the scope."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+
+
+def dispatch(x):
+    return x
+
+
+def hazard_submit_in_default_device(pool: ThreadPoolExecutor, x):
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        return pool.submit(dispatch, x)  # scope dropped in the worker
+
+
+def hazard_thread_in_disable_jit(x):
+    with jax.disable_jit():
+        t = threading.Thread(target=dispatch, args=(x,))
+        t.start()
+    return t
+
+
+def hazard_timer_in_matmul_precision(x):
+    with jax.default_matmul_precision("float32"):
+        threading.Timer(0.1, dispatch, args=(x,)).start()
+
+
+def hazard_run_in_executor(loop, x):
+    with jax.transfer_guard("disallow"):
+        return loop.run_in_executor(None, dispatch, x)
+
+
+def suppressed_submit(pool: ThreadPoolExecutor, x):
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        # simonlint: ignore[config-scope-across-thread] -- fixture: the task provably never touches jax
+        return pool.submit(dispatch, x)
+
+
+def ok_reenter_scope_in_worker(pool: ThreadPoolExecutor, x):
+    cpu = jax.devices("cpu")[0]
+
+    def task():
+        with jax.default_device(cpu):  # the fix: scope re-entered in-thread
+            return dispatch(x)
+
+    return pool.submit(task)
+
+
+def ok_submit_outside_scope(pool: ThreadPoolExecutor, x):
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        y = dispatch(x)
+    return pool.submit(dispatch, y)
+
+
+def ok_plain_with_block(lock, pool: ThreadPoolExecutor, x):
+    with lock:  # not a jax config scope
+        return pool.submit(dispatch, x)

@@ -348,3 +348,169 @@ def test_check_args_enforces_rank_dtype_and_axis_consistency():
         contracts.check_args(f, ok_a.reshape(2, 2), ok_b)
     with pytest.raises(TypeError):  # symbolic axis inconsistency
         contracts.check_args(f, ok_a, np.zeros(5, np.int32))
+
+
+# ------------------------------------------- config-scope-across-thread --
+
+
+def test_config_scope_across_thread_rule_fires():
+    # submit/Thread/Timer/run_in_executor inside four jax config scopes
+    assert _counts("threadscope_hazard.py", "config-scope-across-thread") == 4
+    # the provably-jax-free task carries a reasoned waiver
+    assert _counts("threadscope_hazard.py", "config-scope-across-thread",
+                   suppressed=True) == 1
+
+
+def test_config_scope_spares_reentry_and_plain_scopes():
+    # re-entering the scope INSIDE the worker (the guard.supervised pattern),
+    # submitting after the scope closed, and non-jax `with` blocks are clean
+    fr = analyze_file(str(FIXTURES / "threadscope_hazard.py"))
+    src = (FIXTURES / "threadscope_hazard.py").read_text().splitlines()
+    ok_start = next(i for i, l in enumerate(src, 1)
+                    if "def ok_reenter_scope_in_worker" in l)
+    assert not any(f.line >= ok_start for f in fr.findings
+                   if f.rule == "config-scope-across-thread")
+
+
+# ------------------------------------------------- suppression-reason --
+
+
+def test_suppression_reason_rule_fires():
+    # two bare waivers (trailing + comment-only) are findings...
+    assert _counts("bare_waiver_hazard.py", "suppression-reason") == 2
+    # ...but still suppress their target rule (the waiver works, the hygiene
+    # finding is separate), and the reasoned forms are clean
+    assert _counts("bare_waiver_hazard.py", "dtype-drift", suppressed=True) == 4
+    assert _counts("bare_waiver_hazard.py", "dtype-drift") == 0
+
+
+def test_suppression_reason_not_covered_by_star():
+    from open_simulator_tpu.analysis.base import Finding, is_suppressed
+
+    supp = suppressions_for(["x = 1  # simonlint: ignore[*]"])
+    f = Finding("suppression-reason", Severity.WARNING, "p.py", 1, 0, "m")
+    assert not is_suppressed(f, supp)  # a bare star cannot self-suppress
+    supp = suppressions_for(
+        ["x = 1  # simonlint: ignore[suppression-reason] -- audited waiver"])
+    assert is_suppressed(f, supp)  # explicit (reasoned) waiver still works
+
+
+# --------------------------------------------------- registry self-test --
+
+
+def test_every_registered_rule_has_fixture_coverage():
+    """New rules can't ship untested: every registered rule id must produce
+    at least one finding somewhere in tests/analysis_fixtures/, and the
+    clean module must stay clean under the full registry."""
+    report = analyze_paths([str(FIXTURES)])
+    fired = {f.rule for f in report.findings}  # suppressed findings count
+    missing = set(RULE_REGISTRY) - fired
+    assert not missing, f"rules with no fixture coverage: {sorted(missing)}"
+    assert analyze_file(str(FIXTURES / "clean_module.py")).findings == []
+
+
+# -------------------------------------------------------------- cache --
+
+
+def test_cache_roundtrip_identical_findings(tmp_path):
+    from open_simulator_tpu.analysis.runner import LintCache
+
+    cpath = str(tmp_path / "cache.json")
+    target = str(FIXTURES / "dtype_hazard.py")
+    r1 = analyze_paths([target], cache=LintCache(cpath))
+    assert (r1.cache_hits, r1.cache_misses) == (0, 1)
+    r2 = analyze_paths([target], cache=LintCache(cpath))
+    assert (r2.cache_hits, r2.cache_misses) == (1, 0)
+    assert ([f.to_json() for f in r2.findings]
+            == [f.to_json() for f in r1.findings])
+
+
+def test_cache_misses_on_content_change_and_select_filters(tmp_path):
+    from open_simulator_tpu.analysis.runner import LintCache
+
+    cpath = str(tmp_path / "cache.json")
+    mod = tmp_path / "mod.py"
+    mod.write_text("import numpy as np\nx = np.zeros(3, np.float64)\n")
+    analyze_paths([str(mod)], cache=LintCache(cpath))
+    # unchanged: hit, and --select filters the cached full-rule entry
+    r = analyze_paths([str(mod)], select=["dtype-drift"],
+                      cache=LintCache(cpath))
+    assert r.cache_hits == 1
+    assert {f.rule for f in r.findings} == {"dtype-drift"}
+    # edit: same path, new content hash -> miss, fresh findings
+    mod.write_text("import numpy as np\nx = np.zeros(3, np.float32)\n")
+    r = analyze_paths([str(mod)], cache=LintCache(cpath))
+    assert r.cache_misses == 1
+    assert r.findings == []
+
+
+def test_cache_invalidated_by_ruleset_digest(tmp_path):
+    from open_simulator_tpu.analysis.runner import LintCache
+
+    cpath = tmp_path / "cache.json"
+    target = str(FIXTURES / "dtype_hazard.py")
+    analyze_paths([target], cache=LintCache(str(cpath)))
+    doc = json.loads(cpath.read_text())
+    assert doc["files"]
+    doc["ruleset"] = "0" * 16  # a rule changed since this cache was written
+    cpath.write_text(json.dumps(doc))
+    stale = LintCache(str(cpath))
+    assert stale.files == {}  # fully invalidated, everything re-analyzes
+
+
+def test_cli_cache_flag_and_exit_codes(tmp_path):
+    cpath = str(tmp_path / "cache.json")
+    target = str(FIXTURES / "dtype_hazard.py")
+    assert run_lint(["--cache", cpath, target]) == 1   # cold
+    assert run_lint(["--cache", cpath, target]) == 1   # warm, same verdict
+
+
+def test_bare_self_waiver_cannot_suppress_suppression_reason():
+    from open_simulator_tpu.analysis.base import Finding, is_suppressed
+
+    # a BARE waiver naming the hygiene rule itself must not self-suppress
+    supp = suppressions_for(
+        ["x = 1  # simonlint: ignore[dtype-drift,suppression-reason]"])
+    f = Finding("suppression-reason", Severity.WARNING, "p.py", 1, 0, "m")
+    assert not is_suppressed(f, supp)
+    assert "dtype-drift" in supp[1]  # the other waiver still works (and is bare)
+
+
+def test_ruleset_digest_covers_contract_grammar_and_driver(monkeypatch, tmp_path):
+    """contract-spec findings depend on ops/contracts.py parse_spec and the
+    cache schema lives in runner.py: both must be in the digest's source set,
+    and a content change in any listed source must change the digest
+    (exercised hermetically on tmp copies, never the tracked files)."""
+    import shutil
+
+    from open_simulator_tpu.analysis import runner
+
+    names = [Path(p).name for p in runner._DIGEST_SOURCES]
+    assert "contracts.py" in names and "runner.py" in names
+    copies = []
+    for p in runner._DIGEST_SOURCES:
+        dst = tmp_path / Path(p).name
+        shutil.copy(p, dst)
+        copies.append(str(dst))
+    monkeypatch.setattr(runner, "_DIGEST_SOURCES", tuple(copies))
+    before = runner.ruleset_digest()
+    with open(copies[-1], "ab") as fh:  # the contracts.py copy
+        fh.write(b"\n# digest probe\n")
+    assert runner.ruleset_digest() != before
+
+
+def test_suppression_reason_comment_only_waiver_is_waivable(tmp_path):
+    """The finding for a comment-only bare waiver anchors to the code line
+    the waiver binds to, so a reasoned ignore[suppression-reason] above the
+    stack (or trailing on the code line) covers it via the normal
+    suppression mechanics."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import numpy as np\n"
+        "\n"
+        "# simonlint: ignore[suppression-reason] -- audited: generated code\n"
+        "# simonlint: ignore[dtype-drift]\n"
+        "x = np.zeros(3, np.float64)\n")
+    fr = analyze_file(str(mod))
+    hits = [f for f in fr.findings if f.rule == "suppression-reason"]
+    assert len(hits) == 1 and hits[0].suppressed and hits[0].line == 5

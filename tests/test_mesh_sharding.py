@@ -105,25 +105,30 @@ def _encode_unconstrained(n_nodes=26, n_pods=32):
     return sim, sim.encode_batch(pods)
 
 
-def test_donation_frees_old_carry_buffer():
+def test_donation_gated_off_on_multi_device_cpu_mesh():
+    """Dispatching donated executables on a multi-device CPU mesh is unsound
+    under the XLA:CPU async runtime (intermittent in-place corruption — see
+    parallel.mesh.donation_runtime_safe), so the factory must downgrade a
+    donate=True request to the undonated view: inputs stay alive. The
+    donated artifact itself is still certified (AOT, never executed) by
+    simonaudit's goldens — donation.aliased == 8/8 for every engine kernel."""
+    from open_simulator_tpu.parallel.mesh import donation_runtime_safe
+
     mesh = make_node_mesh(8)
+    assert not donation_runtime_safe(mesh)  # 8 virtual CPU devices
     sim, bt = _encode_unconstrained()
     tables, carry, bt = to_device_sharded(bt, mesh)
-    sk = sharded_kernels(mesh)
+    sk = sharded_kernels(mesh, donate=True)  # downgraded by the factory
+    assert sk.donate is False
     final, choices = sk.schedule_batch(
         tables, carry, bt.pod_group, bt.forced_node, bt.valid,
         n_zones=bt.n_zones, enable_gpu=False, enable_storage=False)
     jax.block_until_ready(final)
-    assert carry.requested.is_deleted(), "donated carry buffer still alive"
+    assert not carry.requested.is_deleted(), "carry donated despite the gate"
     assert not tables.alloc.is_deleted()  # tables are never donated
 
-    # the undonated view (xray mode) keeps its input carry alive
-    tables2, carry2, _ = to_device_sharded(bt, mesh)
-    final2, _ = sk.undonated().schedule_batch(
-        tables2, carry2, bt.pod_group, bt.forced_node, bt.valid,
-        n_zones=bt.n_zones, enable_gpu=False, enable_storage=False)
-    jax.block_until_ready(final2)
-    assert not carry2.requested.is_deleted()
+    # the explicit undonated view is the same object (shared jit cache)
+    assert sharded_kernels(mesh, donate=False) is sk
 
 
 def test_chained_dispatches_zero_reshard():
@@ -288,3 +293,44 @@ def test_hostname_rows_fall_back_to_host_reupload():
     got = session.probe_many([14])[14]
     sim = Simulator(base + new_fake_nodes(template, 14))
     assert (got[0], got[1]) == sim.probe_pods(list(pods))
+
+
+def test_probe_fanout_utilization_stable_across_repeated_sessions():
+    """Regression (found while goldening simonaudit's donation certificates):
+    a DONATED fan-out dispatch of the [S, N, R] carry on a scenario mesh
+    intermittently corrupted the fetched `requested` leaf on the XLA:CPU
+    runtime (~1/3 of dispatches under a warm compile cache) — garbage
+    utilization with correct placed counts. The probe path now dispatches
+    the undonated view; several fresh sessions must agree exactly."""
+    base = [make_node(f"base-{i}", cpu="8", memory="16Gi") for i in range(2)]
+    template = make_node("tpl", cpu="8", memory="16Gi")
+    pods = [make_pod(f"p-{i}", cpu="2", memory="2Gi") for i in range(40)]
+    ns = [0, 3, 5, 7, 11]
+    plain = ProbeSession.try_build(base, template, list(pods), n_new=12)
+    want = plain.probe_many(ns)
+    for _ in range(4):
+        s = ProbeSession.try_build(base, template, list(pods), n_new=12,
+                                   mesh=make_scenario_mesh(4))
+        assert s.probe_many(ns) == want
+
+
+def test_donation_still_frees_carry_on_single_device_mesh():
+    """Where donation stays ENABLED (donation_runtime_safe: single-device
+    meshes, accelerators), a donated dispatch must actually free its input
+    carry — the end-to-end donation behavior the audit's AOT certificates
+    cannot observe. A dispatch-time regression that stops donating would
+    pass the goldens but fail here."""
+    from open_simulator_tpu.parallel.mesh import donation_runtime_safe
+
+    mesh = make_node_mesh(1)
+    assert donation_runtime_safe(mesh)
+    sim, bt = _encode_unconstrained()
+    tables, carry, bt = to_device_sharded(bt, mesh)
+    sk = sharded_kernels(mesh, donate=True)
+    assert sk.donate is True
+    final, choices = sk.schedule_batch(
+        tables, carry, bt.pod_group, bt.forced_node, bt.valid,
+        n_zones=bt.n_zones, enable_gpu=False, enable_storage=False)
+    jax.block_until_ready(final)
+    assert carry.requested.is_deleted(), "donated carry buffer still alive"
+    assert not tables.alloc.is_deleted()  # tables are never donated

@@ -1,13 +1,20 @@
 #!/usr/bin/env python3
 """CI entry for simonlint: lint the package tree, record the bench, gate the build.
 
-    python tools/run_analysis.py                  # lint open_simulator_tpu/, update BENCH_ANALYSIS.json
+    python tools/run_analysis.py                  # cold+warm lint of open_simulator_tpu/,
+                                                  # update BENCH_ANALYSIS.json
     python tools/run_analysis.py --no-bench p1 p2 # lint explicit paths, no bench record
+    python tools/run_analysis.py --format json    # one-off flagged run; never rewrites
+                                                  # BENCH_ANALYSIS.json (bare run only)
 
 Equivalent to `python -m open_simulator_tpu.cli lint` plus the repo-root
 bench bookkeeping: BENCH_ANALYSIS.json tracks analyzer wall time (budget:
 <10s on the full tree) and per-rule finding counts so a future PR that slows
-the pass down or starts leaning on suppressions shows up in the diff."""
+the pass down or starts leaning on suppressions shows up in the diff. The
+bare invocation runs the tree TWICE — a cold pass against a cleared
+.simonlint_cache.json, then a warm cache-backed pass — and records both
+timings, proving the content-hash cache keeps the warm path inside budget as
+the repo grows."""
 
 from __future__ import annotations
 
@@ -18,7 +25,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
 
-_VALUE_FLAGS = {"--format", "--select", "--fail-on", "--bench-out"}
+_VALUE_FLAGS = {"--format", "--select", "--fail-on", "--bench-out", "--cache"}
 
 
 def _has_positional(args) -> bool:
@@ -35,14 +42,41 @@ def _has_positional(args) -> bool:
     return False
 
 
+def _bench_cold_warm() -> int:
+    """The default CI/bench path: cold pass (cleared cache) + warm pass over
+    the package tree, both recorded in BENCH_ANALYSIS.json."""
+    from open_simulator_tpu.analysis.runner import (
+        LintCache, Severity, analyze_paths, format_human, write_bench)
+
+    cache_path = os.path.join(REPO_ROOT, ".simonlint_cache.json")
+    if os.path.exists(cache_path):
+        os.remove(cache_path)  # an honest cold timing, not a stale-hit mix
+    tree = os.path.join(REPO_ROOT, "open_simulator_tpu")
+    cold = analyze_paths([tree], cache=LintCache(cache_path))
+    warm = analyze_paths([tree], cache=LintCache(cache_path))
+    print(format_human(cold))
+    print(f"simonlint warm pass: {warm.elapsed_s:.2f}s "
+          f"({warm.cache_hits} hit(s), {warm.cache_misses} miss(es))")
+    write_bench(cold, os.path.join(REPO_ROOT, "BENCH_ANALYSIS.json"),
+                warm=warm)
+    return 1 if cold.active(Severity.WARNING) else 0
+
+
 def main(argv=None) -> int:
     from open_simulator_tpu.analysis.runner import run_lint
 
     args = list(sys.argv[1:] if argv is None else argv)
     if "--no-bench" in args:
         args.remove("--no-bench")
-    elif "--bench-out" not in args:
-        args = ["--bench-out", os.path.join(REPO_ROOT, "BENCH_ANALYSIS.json")] + args
+        if not _has_positional(args):
+            args.append(os.path.join(REPO_ROOT, "open_simulator_tpu"))
+        return run_lint(args)
+    if not args:
+        return _bench_cold_warm()
+    # flagged invocations never rewrite BENCH_ANALYSIS.json: only the bare
+    # cold+warm run produces the full record (a legacy single-pass write
+    # would silently drop the warm-cache fields); pass --bench-out FILE
+    # explicitly to record a one-off run elsewhere
     if not _has_positional(args):
         args.append(os.path.join(REPO_ROOT, "open_simulator_tpu"))
     return run_lint(args)

@@ -1,0 +1,35 @@
+#!/usr/bin/env python3
+"""CI entry for simonaudit: certificate-check every registered hot kernel.
+
+    python tools/run_audit.py --check          # the CI gate (default mode)
+    python tools/run_audit.py --update         # regenerate tests/golden/audit/
+
+Equivalent to `python -m open_simulator_tpu.cli audit` with the repo-root
+golden directory; defaults to --check so a bare CI invocation is the gate.
+The virtual-CPU device flag is set here, before jax can initialize."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from open_simulator_tpu.utils.devices import (  # noqa: E402
+    force_cpu_platform, request_cpu_devices)
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not any(a in ("--check", "--update", "--help", "-h") for a in args):
+        args.insert(0, "--check")
+    request_cpu_devices(8)
+    force_cpu_platform()
+    from open_simulator_tpu.analysis.hlo import run_audit
+
+    return run_audit(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
