@@ -170,6 +170,9 @@ def _dyn_abs(token: str, P: int):
         "g": ((), np.int32), "m": ((), np.int32), "forced": ((), np.int32),
         "cap1": ((), np.bool_), "valid1": ((), np.bool_),
         "valid_p": ((P,), np.bool_),
+        "valid_sp": ((S_LANES, P), np.bool_),  # serve fan-out per-lane masks
+        "g_s": ((S_LANES,), np.int32), "m_s": ((S_LANES,), np.int32),
+        "cap1_s": ((S_LANES,), np.bool_),      # serve wave per-lane (g, m)
         "pod_group": ((P,), np.int32), "forced_node": ((P,), np.int32),
     }
     shape, dtype = kinds[token]
@@ -223,13 +226,13 @@ def collective_census(hlo_text: str) -> Dict[str, Dict[str, int]]:
     return out
 
 
-def _alias_count(hlo_text: str) -> int:
-    """Aliased buffer count from the module header's input_output_alias
-    block (nested braces: balance by hand, regexes can't)."""
+def _alias_block(hlo_text: str) -> str:
+    """The module header's input_output_alias block text (nested braces:
+    balance by hand, regexes can't), or '' when absent."""
     head = hlo_text.split("\n", 1)[0]
     start = head.find("input_output_alias={")
     if start < 0:
-        return 0
+        return ""
     i = head.index("{", start)
     depth = 0
     for j in range(i, len(head)):
@@ -238,8 +241,35 @@ def _alias_count(hlo_text: str) -> int:
         elif head[j] == "}":
             depth -= 1
             if depth == 0:
-                return len(_ALIAS_ENTRY_RE.findall(head[i:j + 1]))
-    return 0
+                return head[i:j + 1]
+    return ""
+
+
+def _alias_count(hlo_text: str) -> int:
+    """Aliased buffer count from the module header's input_output_alias block."""
+    return len(_ALIAS_ENTRY_RE.findall(_alias_block(hlo_text)))
+
+
+def image_alias_count(lowered, n_image_params: int) -> int:
+    """Donated leaves inside the shared-image table range: the first
+    `n_image_params` flattened argument leaves (the `tables` head is always
+    argument 0) of the lowered artifact's args_info. jax.stages.Lowered
+    records per-leaf donation EXACTLY as declared to XLA (donated_invars),
+    and unlike the optimized HLO's input_output_alias header it is immune to
+    unused-parameter pruning renumbering the entries.
+
+    The serving subsystem keeps one long-lived device-resident cluster image
+    that every dispatch reads; donating any of its leaves would let a
+    watchdog-abandoned zombie dispatch keep writing into buffers every other
+    request still reads (the PR 9 hazard, now on shared state). The carry is
+    the ONLY legal donation target, so a donated table leaf is a
+    certification failure — on every kernel, since the engine's tables are
+    equally long-lived across segments."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(
+        lowered.args_info, is_leaf=lambda x: hasattr(x, "donated"))
+    return sum(1 for a in leaves[:n_image_params] if a.donated)
 
 
 def escape_census(hlo_text: str) -> Tuple[List[str], List[str]]:
@@ -338,7 +368,8 @@ def audit_kernel(name: str, bucket_key: str, shards: int) -> dict:
     statics = meta["statics"]
     args = head_abs + dyn_abs + statics
 
-    compiled = jfn.lower(*args).compile()
+    lowered = jfn.lower(*args)
+    compiled = lowered.compile()
     text = compiled.as_text()
     colls = collective_census(text)
     custom, host = escape_census(text)
@@ -360,6 +391,10 @@ def audit_kernel(name: str, bucket_key: str, shards: int) -> dict:
             "declared": declared,
             "aliased": aliased,
             "held": aliased >= declared,
+            # the cluster-image/table head must NEVER be donated into an
+            # output: structural non-donatability of shared state (serve/)
+            "image_leaf_aliased": image_alias_count(
+                lowered, len(kernels.Tables._fields)),
         },
         "carry_promotions": _carry_promotions(
             name, spec, statics, head_abs, dyn_abs),
@@ -407,7 +442,8 @@ def audit_wave_chain(bucket_key: str, shards: int) -> dict:
               out_shardings=(cs, node_sh, rep), donate_argnums=(1,))
     args = head_abs + dyn_abs
     t1 = jax.jit(single, **kw).lower(*args).compile().as_text()
-    t2 = jax.jit(chain, **kw).lower(*args).compile().as_text()
+    low2 = jax.jit(chain, **kw).lower(*args)
+    t2 = low2.compile().as_text()
     c1 = collective_census(t1)
     c2 = collective_census(t2)
     n1 = sum(c["count"] for c in c1.values())
@@ -430,7 +466,9 @@ def audit_wave_chain(bucket_key: str, shards: int) -> dict:
         "custom_calls": custom,
         "host_callbacks": host,
         "donation": {"declared": declared, "aliased": aliased,
-                     "held": aliased >= declared},
+                     "held": aliased >= declared,
+                     "image_leaf_aliased": image_alias_count(
+                         low2, len(kernels.Tables._fields))},
         "carry_promotions": [],
     }
     cert["budget"] = _budget_for(cert)
@@ -635,6 +673,13 @@ def check_cert(live: dict, golden: dict) -> List[str]:
     if budget.get("require_donation") and not ldon["held"]:
         out.append(f"{where}: donation no longer held "
                    f"({ldon['aliased']}/{ldon['declared']} aliased)")
+    if ldon.get("image_leaf_aliased", 0):
+        # unconditional (no golden opt-out): a table/cluster-image leaf
+        # aliased into an output means a dispatch can write into shared
+        # long-lived state — the serve zombie-write hazard, never budgetable
+        out.append(f"{where}: {ldon['image_leaf_aliased']} shared-image "
+                   f"table leaf(s) aliased into outputs — image/table "
+                   f"buffers are structurally non-donatable")
     gprom = {p["leaf"] for p in golden.get("carry_promotions", [])}
     for p in live.get("carry_promotions", []):
         if p["leaf"] not in gprom:

@@ -198,6 +198,41 @@ def build_parser() -> argparse.ArgumentParser:
         help="keep in-memory scheduling decision records and serve them on "
              "GET /explain/<pod> (+ the unscheduled summary on /debug/vars)")
 
+    p_serve = sub.add_parser(
+        "serve", help="Start the resident what-if server (simonserve): a "
+                      "persistent device-resident cluster image with delta "
+                      "ingest and micro-batched /v1/whatif serving")
+    p_serve.add_argument("--kubeconfig", default="", help="path of the kubeconfig file")
+    p_serve.add_argument("--master", default="", help="URL of the kube-apiserver")
+    p_serve.add_argument("--port", type=int, default=8080, help="listen port")
+    p_serve.add_argument(
+        "--grpc-port", type=int, default=0, metavar="PORT",
+        help="also serve the gRPC bridge (incl. the WhatIf RPC) on PORT "
+             "(0 = disabled)")
+    p_serve.add_argument(
+        "--window-ms", type=float, default=2.0, metavar="MS",
+        help="micro-batching window: concurrent what-if requests arriving "
+             "within MS coalesce onto one fan-out dispatch (default 2)")
+    p_serve.add_argument(
+        "--fanout", type=int, default=8,
+        help="max requests per micro-batched dispatch (scenario-axis lanes; "
+             "default 8)")
+    p_serve.add_argument(
+        "--synthetic-nodes", type=int, default=0, metavar="N",
+        help="serve a synthetic N-node cluster instead of a live snapshot "
+             "(demos / load generation; no kubeconfig needed)")
+    p_serve.add_argument(
+        "--drain-deadline", type=float, default=None, metavar="SECONDS",
+        help="graceful-drain budget on SIGTERM (default 25)")
+    p_serve.add_argument(
+        "--debug-faults", action="store_true",
+        help="enable the POST /debug/fault-plan injection endpoint "
+             "(testing/CI only)")
+    p_serve.add_argument(
+        "--xray", action="store_true",
+        help="record per-request decision records; /v1/whatif responses "
+             "then ride the flight recorder (GET /explain, /debug/vars)")
+
     sub.add_parser("version", help="Print the version of simon")
 
     p_doc = sub.add_parser("gen-doc", help="Generate markdown document for your project")
@@ -349,6 +384,48 @@ def cmd_server(args) -> int:
         return 0
     except Exception as e:
         print(f"failed to start server: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_serve(args) -> int:
+    """`simon serve`: the `simon server` stack with resident what-if serving
+    enabled — the image stages on the first /v1/whatif and stays current via
+    /v1/ingest deltas. --synthetic-nodes N serves a generated cluster so the
+    closed-loop load generator (tools/loadgen.py) and demos need no live
+    kube-apiserver."""
+    from ..server.http import ClusterSnapshot, Server
+    from ..utils.devices import ensure_responsive_backend
+
+    ensure_responsive_backend()
+    snapshot_fn = None
+    if args.synthetic_nodes:
+        from ..core.types import ResourceTypes
+        from ..utils.synth import synth_node
+
+        n = int(args.synthetic_nodes)
+        rt = ResourceTypes(nodes=[synth_node(i) for i in range(n)])
+        snapshot_fn = lambda: ClusterSnapshot(rt, [], [], [])  # noqa: E731
+    try:
+        server = Server(kubeconfig=args.kubeconfig, master=args.master,
+                        snapshot_fn=snapshot_fn,
+                        debug_faults=True if args.debug_faults else None,
+                        xray=True if getattr(args, "xray", False) else None,
+                        whatif=True, whatif_window_ms=args.window_ms,
+                        whatif_fanout=args.fanout)
+        if args.grpc_port:
+            from ..server.grpcbridge import GrpcBridge
+
+            bridge = GrpcBridge(server=server)
+            grpc_server, bound = bridge.build_grpc_server(args.grpc_port)
+            grpc_server.start()
+            print(f"simon grpc bridge listening on :{bound}")
+        server.start(port=args.port,
+                     drain_deadline=getattr(args, "drain_deadline", None))
+    except KeyboardInterrupt:
+        return 0
+    except Exception as e:
+        print(f"failed to start serve: {e}", file=sys.stderr)
         return 1
     return 0
 
@@ -541,6 +618,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "explain": cmd_explain,
         "lint": cmd_lint,
         "metrics": cmd_metrics,
+        "serve": cmd_serve,
         "server": cmd_server,
         "version": cmd_version,
         "gen-doc": cmd_gen_doc,

@@ -129,6 +129,46 @@ PROBE_FANOUT = histogram(
     "Candidate lanes per fan-out dispatch (post power-of-two quantization).",
     buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0))
 
+# ------------------------------------------------------------------- serve ----
+# simonserve (serve/): resident what-if serving — one persistent
+# device-resident cluster image, delta ingest, micro-batched request fan-out.
+
+SERVE_REQUESTS = counter(
+    "simon_serve_whatif_requests_total",
+    "What-if requests served, by route: 'batched' rode a micro-batched "
+    "serve_whatif_fanout lane on the resident image, 'fresh' re-simulated "
+    "from a fresh encode (ineligible request or contained device failure).",
+    ("path",))
+SERVE_BATCHES = counter(
+    "simon_serve_batches_total",
+    "Micro-batched serve dispatches (one device round-trip each; lane "
+    "width in simon_serve_batch_lanes).")
+SERVE_LANES = histogram(
+    "simon_serve_batch_lanes",
+    "Requests coalesced per serve dispatch (pre lane-padding).",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0))
+SERVE_INGEST_EVENTS = counter(
+    "simon_serve_ingest_events_total",
+    "Live watch-event deltas applied to the resident cluster image, by "
+    "kind (node_add / node_drain / pod_add / pod_delete).",
+    ("kind",))
+SERVE_RESTAGES = counter(
+    "simon_serve_restages_total",
+    "Resident-image device re-stages (full table re-upload), by cause: "
+    "'groups' (a request interned a new pod group -> new [G, N] rows), "
+    "'nodes' (delta node-add extended the node axis), 'rebuild' (an event "
+    "the delta path cannot express forced a from-scratch re-encode). Pod "
+    "churn never lands here — it refreshes the host-side carry seeds only.",
+    ("cause",))
+SERVE_SEED_REFRESHES = counter(
+    "simon_serve_seed_refreshes_total",
+    "Pod-churn seed rebuilds: the host-side carry seeds were re-aggregated "
+    "from the placed registry with ZERO device table bytes moved.")
+SERVE_STALE_SESSIONS = counter(
+    "simon_serve_stale_sessions_total",
+    "What-if sessions detected stale (the image generation moved under "
+    "them) and transparently re-encoded before dispatch.")
+
 # -------------------------------------------------------------- preemption ----
 
 PREEMPT_ATTEMPTS = counter(

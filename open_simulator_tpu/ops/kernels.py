@@ -2009,6 +2009,60 @@ def probe_serial_fanout(tb: Tables, cry_s: Carry, active_s, pod_group,
     return jax.vmap(one)(cry_s, active_s)
 
 
+@partial(jax.jit, static_argnames=("n_zones", "enable_gpu", "enable_storage", "w", "filters"))
+@shaped(active_s="[S, N] bool", pod_group="[P] i32", forced_node="[P] i32",
+        valid_s="[S, P] bool")
+def serve_whatif_fanout(tb: Tables, cry_s: Carry, active_s, pod_group,
+                        forced_node, valid_s, n_zones: int,
+                        enable_gpu: bool = True, enable_storage: bool = True,
+                        w: ScoreWeights = DEFAULT_WEIGHTS,
+                        filters: FilterFlags = DEFAULT_FILTERS):
+    """schedule_batch over S independent what-if REQUESTS in one dispatch —
+    simonserve's micro-batching kernel (serve/batch.py). Unlike the capacity
+    probe fan-outs, the lanes are heterogeneous: they share one union-encoded
+    pod batch but differ in BOTH the node-active mask (the shared image's
+    live-node mask minus request-local drains) and a per-lane `valid` mask
+    selecting only that request's rows out of the union. An invalid scan step
+    is a provable no-op (choices -1, zero carry commit), so lane i is exactly
+    the serial schedule_batch of request i's own pods, in order, against the
+    shared cluster image — union padding can never change a placement.
+    Returns (carry_s, placed_s [S] i32); per-pod choices stay on device."""
+
+    def one(cry: Carry, active, valid):
+        c2, choices = schedule_batch(
+            _mask_active(tb, active), cry, pod_group, forced_node, valid,
+            n_zones=n_zones, enable_gpu=enable_gpu,
+            enable_storage=enable_storage, w=w, filters=filters)
+        return c2, jnp.sum((choices >= 0).astype(jnp.int32))
+
+    return jax.vmap(one)(cry_s, active_s, valid_s)
+
+
+@partial(jax.jit, static_argnames=("w", "filters", "block", "kmax"))
+@shaped(active_s="[S, N] bool", g_s="[S] i32", m_s="[S] i32",
+        cap1_s="[S] bool")
+def serve_wave_fanout(tb: Tables, cry_s: Carry, active_s, g_s, m_s, cap1_s,
+                      w: ScoreWeights = DEFAULT_WEIGHTS,
+                      filters: FilterFlags = DEFAULT_FILTERS,
+                      block: int = WAVE_BLOCK, kmax: int = 0):
+    """schedule_wave over S uniform-replica what-if REQUESTS in one dispatch
+    — simonserve's fast lane. The dominant what-if shape ("deploy/scale m
+    more replicas of template T") is one wave-eligible group per request, so
+    each lane runs ONE fused feasibility/score pass + top-k commit instead
+    of m padded serial scan steps: the lane is provably identical to m
+    serial placements (the schedule_wave contract), with its own (group,
+    replica count, cap1, node-active overlay). Returns (carry_s,
+    placed_s [S] i32)."""
+
+    def one(cry: Carry, active, g, m, cap1):
+        c2, _, placed = schedule_wave(
+            _mask_active(tb, active), cry, g, m, cap1,
+            gpu_live=False, w=w, filters=filters, block=block, kmax=kmax)
+        return c2, placed
+
+    return jax.vmap(one)(cry_s, active_s, g_s, m_s, cap1_s)
+
+
 # ---------------------------------------------------------------------------
 # Auditable hot-kernel registry (simonaudit, analysis/hlo.py).
 #
@@ -2079,5 +2133,13 @@ HOT_KERNELS = {
     "probe_serial_fanout": HotKernelSpec(
         ("pod_group", "forced_node", "valid_p"), ("carry_s", "lane"),
         lambda nz: (nz, False, False, DEFAULT_WEIGHTS, DEFAULT_FILTERS),
+        fanout=True),
+    "serve_whatif_fanout": HotKernelSpec(
+        ("pod_group", "forced_node", "valid_sp"), ("carry_s", "lane"),
+        lambda nz: (nz, False, False, DEFAULT_WEIGHTS, DEFAULT_FILTERS),
+        fanout=True),
+    "serve_wave_fanout": HotKernelSpec(
+        ("g_s", "m_s", "cap1_s"), ("carry_s", "lane"),
+        lambda nz: (DEFAULT_WEIGHTS, DEFAULT_FILTERS, WAVE_BLOCK, 0),
         fanout=True),
 }

@@ -562,6 +562,22 @@ class ShardedKernels:
         return fn(tb, cry_s, active_s, pod_group, forced_node, valid,
                   n_zones, enable_gpu, enable_storage, w, filters)
 
+    def serve_whatif_fanout(self, tb, cry_s, active_s, pod_group, forced_node,
+                            valid_s, *, n_zones, enable_gpu=True,
+                            enable_storage=True, w=kernels.DEFAULT_WEIGHTS,
+                            filters=kernels.DEFAULT_FILTERS):
+        fn = self._kernel_jit("serve_whatif_fanout")
+        return fn(tb, cry_s, active_s, pod_group, forced_node, valid_s,
+                  n_zones, enable_gpu, enable_storage, w, filters)
+
+    def serve_wave_fanout(self, tb, cry_s, active_s, g_s, m_s, cap1_s, *,
+                          w=kernels.DEFAULT_WEIGHTS,
+                          filters=kernels.DEFAULT_FILTERS,
+                          block=kernels.WAVE_BLOCK, kmax=0):
+        fn = self._kernel_jit("serve_wave_fanout")
+        return fn(tb, cry_s, active_s, g_s, m_s, cap1_s, w, filters, block,
+                  kmax)
+
 
 def carry_reshard_bytes(carry, shardings) -> int:
     """Bytes a chained dispatch would move to reconcile `carry`'s actual

@@ -1,0 +1,18 @@
+"""simonserve: resident what-if serving.
+
+The production serving subsystem (ROADMAP item 3): a persistent
+device-resident cluster image kept current by live watch-event deltas
+(serve/image.py), copy-on-write what-if probe sessions per request, and a
+cross-request micro-batching dispatcher that coalesces concurrent requests
+onto the scenario axis of one serve_whatif_fanout dispatch (serve/batch.py).
+Served over HTTP/gRPC as /v1/whatif (server/http.py, server/grpcbridge.py)
+and from the `simon serve` CLI; benchmarked by tools/loadgen.py.
+"""
+
+from .image import (  # noqa: F401
+    ImageDonatedError,
+    ResidentImage,
+    StaleImageError,
+    WhatIfSession,
+)
+from .batch import MAX_BATCHED_PODS, WhatIfService  # noqa: F401

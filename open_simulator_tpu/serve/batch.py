@@ -1,0 +1,189 @@
+"""simonserve: the cross-request micro-batching dispatcher.
+
+Concurrent what-if requests arriving within a short window coalesce onto the
+scenario axis of ONE serve_whatif_fanout dispatch (ops/kernels.py): the
+requests' pods are union-encoded into a single padded batch, each request
+becomes one lane with its own node-active overlay and valid mask, and the
+results demux back to the waiting callers. Lane padding repeats lane 0 and is
+sliced off, the per-lane valid masks make union rows outside a request
+provable no-ops, and the shared image's device tables are read-only inputs —
+so a micro-batched response is bit-identical to the same request probed
+serially from a fresh encode (the determinism contract PARITY.md documents
+and tests/test_serve.py asserts).
+
+Failure semantics: a contained device failure (watchdog wedge, OOM — see
+resilience/guard.py) fails the whole batch over to the fresh-simulation path
+per request, which the engine routes to the CPU fallback; nothing is silent
+(simon_guard_failovers_total moves, responses carry path="fresh"). Ineligible
+requests (census-dependent predicates, pre-bound pods, gpu/storage) never
+enter a batch — they run the fresh path directly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..obs import instruments as obs
+from ..resilience import guard
+from .image import ResidentImage, WhatIfSession
+
+# requests larger than this ride the fresh path: big batches want the
+# engine's wave segmentation, not S copies of a long serial scan
+MAX_BATCHED_PODS = 512
+
+
+class _Pending:
+    """One enqueued request and its rendezvous."""
+
+    __slots__ = ("session", "done", "response", "error")
+
+    def __init__(self, session: WhatIfSession) -> None:
+        self.session = session
+        self.done = threading.Event()
+        self.response: Optional[dict] = None
+        self.error: Optional[BaseException] = None
+
+
+class WhatIfService:
+    """The serving facade: submit() blocks until the request's micro-batch
+    (or fresh fallback) resolves. One daemon dispatcher thread owns batch
+    formation; handler threads only enqueue and wait."""
+
+    def __init__(self, image: ResidentImage, window_ms: float = 2.0,
+                 fanout: int = 8) -> None:
+        self.image = image
+        self.window_s = max(0.0, float(window_ms)) / 1000.0
+        self.fanout = max(1, int(fanout))
+        self._queue: List[_Pending] = []
+        self._cv = threading.Condition()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._loop, name="simon-serve-dispatch", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- client -----
+
+    def submit(self, pods: List[dict], drains: Sequence[str] = ()) -> dict:
+        """Serve one what-if request: {"scheduled", "total", "unscheduled",
+        "utilization", "epoch", "lanes", "path"}."""
+        if not pods:
+            raise ValueError("what-if request has no pods")
+        if self._stopped:
+            raise RuntimeError("serve dispatcher is stopped")
+        if len(pods) > MAX_BATCHED_PODS or guard.default_quarantined():
+            return self._fresh(pods, drains)
+        session = self.image.session(pods, drains)
+        gate = self.image.eligible(session.batch, pods)
+        if gate is not None:
+            return self._fresh(pods, drains)
+        item = _Pending(session)
+        with self._cv:
+            # re-check UNDER the lock: a stop() racing the encode above must
+            # not let this item enqueue after the dispatcher exited — nothing
+            # would ever set its event and the caller would hang forever
+            if self._stopped:
+                raise RuntimeError("serve dispatcher is stopped")
+            self._queue.append(item)
+            self._cv.notify_all()
+        item.done.wait()
+        if item.error is not None:
+            raise item.error
+        obs.SERVE_REQUESTS.labels(path=item.response["path"]).inc()
+        return item.response
+
+    def _fresh(self, pods: List[dict], drains: Sequence[str]) -> dict:
+        obs.SERVE_REQUESTS.labels(path="fresh").inc()
+        return self.image.fresh_probe(pods, drains)
+
+    def stop(self) -> None:
+        """Drain: wake the dispatcher and fail still-queued requests fast
+        (an in-flight batch completes normally)."""
+        with self._cv:
+            self._stopped = True
+            for item in self._queue:
+                item.error = RuntimeError("serve dispatcher is stopped")
+                item.done.set()
+            self._queue.clear()
+            self._cv.notify_all()
+
+    # --------------------------------------------------------- dispatcher -----
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            try:
+                self._dispatch(batch)
+            except BaseException as e:  # a dispatcher crash must never hang
+                for item in batch:      # callers on .wait() forever
+                    if not item.done.is_set():
+                        item.error = e
+                        item.done.set()
+
+    def _take_batch(self) -> Optional[List[_Pending]]:
+        """Block for the first request, then hold the window open (or until
+        `fanout` lanes fill); None once stopped and drained."""
+        with self._cv:
+            while not self._queue:
+                if self._stopped:
+                    return None
+                self._cv.wait()
+            deadline = time.monotonic() + self.window_s
+            while (len(self._queue) < self.fanout and not self._stopped):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cv.wait(timeout=left)
+            batch = self._queue[:self.fanout]
+            del self._queue[:self.fanout]
+            return batch
+
+    def _dispatch(self, batch: List[_Pending]) -> None:
+        # staleness is revalidated by dispatch_sessions UNDER the image lock
+        # (a racing rebuild between here and there would invalidate any
+        # check made outside it)
+        try:
+            responses = self.image.dispatch_sessions(
+                [item.session for item in batch])
+        except BaseException as e:
+            if guard.containment_cause(e) is None:
+                raise
+            # contained device failure: the batch fails over to per-request
+            # fresh probes (the engine routes those to the CPU fallback)
+            guard.count_failover(guard.containment_cause(e), "serve")
+            for item in batch:
+                try:
+                    item.response = self.image.fresh_probe(
+                        item.session.pods, item.session.drains)
+                except BaseException as fe:
+                    import logging
+
+                    # surfaced to the caller via item.error AND logged: a
+                    # request failing on the fallback path too is never silent
+                    logging.getLogger("open_simulator_tpu").warning(
+                        "serve: fresh-path fallback failed after a contained "
+                        "device failure: %r", fe)
+                    item.error = fe
+                item.done.set()
+            return
+        for item, resp in zip(batch, responses):
+            item.response = resp
+            item.done.set()
+
+    # -------------------------------------------------------------- stats -----
+
+    def stats(self) -> Dict[str, object]:
+        img = self.image
+        return {
+            "epoch": img.epoch,
+            "generation": img.generation,
+            "nodes": img.n_nodes,
+            "drained": sorted(img.drained),
+            "window_ms": self.window_s * 1000.0,
+            "fanout": self.fanout,
+            "mesh": img._mesh is not None,
+            "queued": len(self._queue),
+        }

@@ -190,6 +190,11 @@ class GrpcBridge:
     def _scale(self, request: bytes, context) -> bytes:
         return self._simulate(self.server.handle_scale_apps, request, context)
 
+    def _whatif(self, request: bytes, context) -> bytes:
+        # simonserve: same JSON-in-bytes contract as Deploy/Scale — the
+        # resident micro-batched path behind both surfaces is identical
+        return self._simulate(self.server.handle_whatif, request, context)
+
     def _health(self, request: bytes, context) -> bytes:
         return encode_health_response("ok")
 
@@ -206,6 +211,8 @@ class GrpcBridge:
                 self._deploy, request_deserializer=ident, response_serializer=ident),
             "ScaleApps": grpc.unary_unary_rpc_method_handler(
                 self._scale, request_deserializer=ident, response_serializer=ident),
+            "WhatIf": grpc.unary_unary_rpc_method_handler(
+                self._whatif, request_deserializer=ident, response_serializer=ident),
             "Health": grpc.unary_unary_rpc_method_handler(
                 self._health, request_deserializer=ident, response_serializer=ident),
         }

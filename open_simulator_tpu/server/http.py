@@ -159,6 +159,9 @@ class Server:
         snapshot_fn: Optional[Callable[[], ClusterSnapshot]] = None,
         debug_faults: Optional[bool] = None,
         xray: Optional[bool] = None,
+        whatif: Optional[bool] = None,
+        whatif_window_ms: Optional[float] = None,
+        whatif_fanout: Optional[int] = None,
     ) -> None:
         # /debug/fault-plan is a process-global WRITE endpoint (testing/CI):
         # never enabled by default on a production server. Opt in explicitly
@@ -185,6 +188,24 @@ class Server:
             client = create_kube_client(kubeconfig, master)
             snapshot_fn = lambda: snapshot_from_client(client)  # noqa: E731
         self.snapshot_fn = snapshot_fn
+        # simonserve (serve/): resident what-if serving — /v1/whatif rides a
+        # persistent device-resident cluster image with micro-batched
+        # dispatch instead of re-simulating the snapshot per request. Opt in
+        # via constructor, `simon serve`, or OPEN_SIMULATOR_WHATIF=1; the
+        # image builds lazily from the first snapshot (one-time stage cost).
+        if whatif is None:
+            whatif = os.environ.get(
+                "OPEN_SIMULATOR_WHATIF", "") not in ("", "0", "false", "no")
+        self.whatif = whatif
+        self.whatif_window_ms = (
+            whatif_window_ms if whatif_window_ms is not None
+            else float(os.environ.get("OPEN_SIMULATOR_WHATIF_WINDOW_MS", "2")))
+        self.whatif_fanout = (
+            whatif_fanout if whatif_fanout is not None
+            else int(os.environ.get("OPEN_SIMULATOR_WHATIF_FANOUT", "8")))
+        self._whatif_svc = None
+        self._whatif_declined = False
+        self._whatif_lock = threading.Lock()
         self.deploy_lock = threading.Lock()
         self.scale_lock = threading.Lock()
         # drain/in-flight accounting (graceful SIGTERM semantics)
@@ -278,6 +299,101 @@ class Server:
                 out.append(pod)
         return out
 
+    # ------------------------------------------------------ resident what-if ------
+
+    def whatif_service(self):
+        """The lazily-built WhatIfService (serve/batch.py), or None when the
+        resident image's equivalence gates decline this cluster (gpu-share /
+        open-local / node-advertised images) — /v1/whatif then reports 501
+        rather than serving silently-different answers."""
+        if not self.whatif:
+            return None
+        with self._whatif_lock:
+            if self._whatif_svc is None and not self._whatif_declined:
+                from ..serve import ResidentImage, WhatIfService
+
+                snap = self.snapshot_fn()
+                image = ResidentImage.try_build(
+                    snap.resource.nodes,
+                    cluster_objects=snap.resource,
+                    pods=list(snap.resource.pods) + list(snap.pending_pods))
+                if image is None:
+                    # cache the decline: try_build walks the whole cluster,
+                    # and repeating that per request would turn the cheap
+                    # 501 path into a serialized full re-encode per request
+                    self._whatif_declined = True
+                    return None
+                self._whatif_svc = WhatIfService(
+                    image, window_ms=self.whatif_window_ms,
+                    fanout=self.whatif_fanout)
+            return self._whatif_svc
+
+    def handle_whatif(self, req: dict) -> Tuple[int, object]:
+        """POST /v1/whatif: probe one what-if against the resident cluster
+        image. Request: {"pods": [...], "deployments": [...],
+        "statefulsets": [...], "jobs": [...], "drains": ["node", ...]}.
+        Workloads expand to pods exactly like deploy-apps; `drains` overlays
+        request-local node removals (the node and its pods leave) without
+        mutating the shared image. Response: scheduled/total/unscheduled
+        counts, cluster utilization, the image epoch the answer is consistent
+        at, the micro-batch lane width, and the route taken
+        (batched | fresh)."""
+        if not self.whatif:
+            count_http_error("whatif", 404)
+            return 404, error_body(
+                404, "resident what-if serving is off (start with "
+                "`simon serve` / OPEN_SIMULATOR_WHATIF=1)")
+        try:
+            svc = self.whatif_service()
+            if svc is None:
+                count_http_error("whatif", 501)
+                return 501, error_body(
+                    501, "resident what-if unavailable for this cluster "
+                    "(gpu-share/open-local/node-images decline the image); "
+                    "use /api/deploy-apps")
+            from ..core.types import ResourceTypes
+            from ..models.workloads import expand_workloads_excluding_daemonsets
+
+            rt = ResourceTypes(
+                pods=list(req.get("pods") or []),
+                deployments=list(req.get("deployments") or []),
+                stateful_sets=list(req.get("statefulsets") or []),
+                jobs=list(req.get("Jobs") or req.get("jobs") or []),
+            )
+            pods = expand_workloads_excluding_daemonsets(rt)
+            if not pods:
+                count_http_error("whatif", 400)
+                return 400, error_body(400, "what-if request has no pods")
+            drains = [str(d) for d in (req.get("drains") or [])]
+            return 200, svc.submit(pods, drains)
+        except Exception as e:
+            count_http_error("whatif", 500)
+            return 500, error_body(500, str(e))
+
+    def handle_ingest(self, req: dict) -> Tuple[int, object]:
+        """POST /v1/ingest: apply a batch of live watch-event deltas
+        ({"events": [{"type": "pod_add"|"pod_delete"|"node_add"|
+        "node_drain", ...}]}) to the resident image. The production server
+        would feed this from a watch stream; the endpoint is the same code
+        path, driveable by tests and the load generator."""
+        if not self.whatif:
+            count_http_error("ingest", 404)
+            return 404, error_body(404, "resident what-if serving is off")
+        try:
+            svc = self.whatif_service()
+            if svc is None:
+                count_http_error("ingest", 501)
+                return 501, error_body(
+                    501, "resident what-if unavailable for this cluster")
+            events = req.get("events") or []
+            if not isinstance(events, list):
+                count_http_error("ingest", 400)
+                return 400, error_body(400, "'events' must be a list")
+            return 200, svc.image.apply_events(events)
+        except Exception as e:
+            count_http_error("ingest", 500)
+            return 500, error_body(500, str(e))
+
     # --------------------------------------------------------------- serving ------
 
     # Default bounded drain: long enough for a worst-case cold-compile
@@ -342,6 +458,9 @@ class Server:
                     break
                 self._state_cv.wait(timeout=min(left, 0.1))
             stranded = self._inflight
+        svc = self._whatif_svc
+        if svc is not None:
+            svc.stop()  # wake the micro-batch dispatcher; queued requests fail fast
         httpd = self._httpd
         if httpd is not None:
             httpd.shutdown()
@@ -487,6 +606,15 @@ class Server:
 
                     plan = active_plan()
                     self._send(200, plan.to_json() if plan is not None else {})
+                elif self.path == "/v1/serve/stats":
+                    # simonserve: the resident image / dispatcher state
+                    svc = server._whatif_svc
+                    if not server.whatif or svc is None:
+                        self._send_err(
+                            404, "resident what-if serving is off or not "
+                            "yet built (POST /v1/whatif first)", "serve-stats")
+                        return
+                    self._send(200, svc.stats())
                 elif self.path == "/test":
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
@@ -509,6 +637,10 @@ class Server:
                     code, body = server.handle_deploy_apps(req)
                 elif self.path == "/api/scale-apps":
                     code, body = server.handle_scale_apps(req)
+                elif self.path == "/v1/whatif":
+                    code, body = server.handle_whatif(req)
+                elif self.path == "/v1/ingest":
+                    code, body = server.handle_ingest(req)
                 elif self.path == "/debug/fault-plan":
                     if not server.debug_faults:
                         self._send_err(403, "fault-plan endpoint disabled "
@@ -521,7 +653,13 @@ class Server:
                     return
                 self._send(code, body)
 
-        httpd = ThreadingHTTPServer((host, port), Handler)
+        class Httpd(ThreadingHTTPServer):
+            # the socketserver default backlog of 5 resets connections under
+            # concurrent what-if traffic (observed at 16 simultaneous
+            # clients); a serving process must absorb bursts, not RST them
+            request_queue_size = 128
+
+        httpd = Httpd((host, port), Handler)
         self._httpd = httpd
         return httpd
 

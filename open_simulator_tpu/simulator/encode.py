@@ -106,6 +106,65 @@ class NodeArrays:
         self.domains = StringTable()
         self._dom_cache: Dict[str, np.ndarray] = {}
 
+    def extend(self, nodes: List[dict]) -> None:
+        """Append nodes IN PLACE — the serving image's delta-ingest path
+        (serve/image.py): a live node-add event extends the columnar node
+        store by parsing ONE node dict instead of rebuilding NodeArrays over
+        the whole (10k+) cluster. Interners (values/zones/domains) are
+        append-only, so every existing label/zone/domain id keeps its value;
+        only the per-topology domain cache resets (new nodes append fresh
+        hostname domains at the END of the table, never renumbering old
+        ones). Callers re-derive anything shaped [*, N] afterwards
+        (Encoder group statics via rebuild_group_axes, node-side batch
+        tables via build_node_axis_tables)."""
+        if not nodes:
+            return
+        base = self.N
+        k = len(nodes)
+        self.nodes.extend(nodes)
+        self.N = len(self.nodes)
+        new_names = [name_of(n) for n in nodes]
+        self.names.extend(new_names)
+        for j, nm in enumerate(new_names):
+            self.index[nm] = base + j
+        # pad existing label columns first, THEN intern the new nodes' labels
+        # (a label key first seen on a new node allocates a full-length col)
+        for key in list(self.label_vals):
+            self.label_vals[key] = np.concatenate(
+                [self.label_vals[key], np.zeros(k, np.int32)])
+        for j, node in enumerate(nodes):
+            for key, v in labels_of(node).items():
+                col = self.label_vals.get(key)
+                if col is None:
+                    col = self.label_vals[key] = np.zeros(self.N, np.int32)
+                col[base + j] = self.values.intern(str(v))
+        self.name_ids = np.concatenate(
+            [self.name_ids,
+             np.array([self.values.intern(nm) for nm in new_names], np.int32)])
+        self.taints.extend(
+            tuple((t.get("key", ""), t.get("value", "") or "",
+                   t.get("effect", ""))
+                  for t in (n.get("spec") or {}).get("taints") or [])
+            for n in nodes)
+        self.unschedulable = np.concatenate(
+            [self.unschedulable,
+             np.array([bool((n.get("spec") or {}).get("unschedulable"))
+                       for n in nodes], bool)])
+        self.alloc = np.concatenate(
+            [self.alloc, np.stack([self.axis.node_vector(n) for n in nodes])])
+        zid = np.zeros(k, np.int32)
+        for j, node in enumerate(nodes):
+            lbl = labels_of(node)
+            region = (lbl.get(C.LabelTopologyRegion)
+                      or lbl.get("failure-domain.beta.kubernetes.io/region")
+                      or "")
+            zone = (lbl.get(C.LabelTopologyZone)
+                    or lbl.get(C.LabelTopologyZoneBeta) or "")
+            if region or zone:
+                zid[j] = self.zones.intern((region, zone))
+        self.zone_id = np.concatenate([self.zone_id, zid])
+        self._dom_cache.clear()
+
     def label_numeric(self, key: str) -> np.ndarray:
         out = np.full(self.N, np.nan)
         col = self.label_vals.get(key)
@@ -565,6 +624,18 @@ class Encoder:
             self.groups[sig] = gi
             self.group_list.append(self._build_group(pod))
         return gi
+
+    def rebuild_group_axes(self) -> None:
+        """Recompute every interned group's node-axis statics against the
+        CURRENT NodeArrays — the second half of a delta node-add
+        (NodeArrays.extend): group [N] vectors (masks, raw scores, dns
+        eligibility) are re-derived from each group's immutable template.
+        Group/counter/carrier IDS are stable: _build_group re-interns the
+        same CounterSpec/CarrierSpec keys, which the interners resolve to
+        their existing slots, so every previously encoded pod_group array
+        and every match_cache entry stays valid."""
+        self.group_list = [self._build_group(g.template)
+                           for g in self.group_list]
 
     def _build_group(self, pod: dict) -> GroupInfo:
         na, axis = self.na, self.axis
@@ -1303,18 +1374,44 @@ def build_node_axis_tables(
             dns_edom[gi, si, dom[elig & (dom >= 0)]] = True
 
     # ---- seeds from placed pods -----------------------------------------------
+    # The resource/nonzero sums vectorize across ALL placed groups in two
+    # np.add.at passes: bound pods carry per-pod signatures (spec.nodeName
+    # joins the signature), so `placed` scales with the bound-pod count and
+    # a per-group fancy-index add was the dominant encode cost at 10k+ nodes
+    # (~9us x 5000 groups per rebuild — the serving image's churn-refresh
+    # p99 spike). Entry order is placed-iteration order, and np.add.at
+    # applies repeated-index adds in order of appearance, so the f32
+    # accumulation sequence per node is bit-identical to the per-group loop
+    # it replaces; count-scaled vectors match the wave kernel's aggregate
+    # commit math.
     seed_requested = np.zeros((N, R), np.float32)
     seed_nonzero = np.zeros((N, 2), np.float32)
     seed_port_used = np.zeros((N, PORT + 1), bool)
     seed_counter = np.zeros((T, D + 1), np.float32)
     seed_carrier = np.zeros((Tc, D + 1), np.float32)
+    if placed:
+        g_idx: List[int] = []
+        n_idx: List[int] = []
+        c_val: List[float] = []
+        for gi, pg in enumerate(placed.values()):
+            for ni, c in pg.node_counts.items():
+                g_idx.append(gi)
+                n_idx.append(ni)
+                c_val.append(c)
+        if n_idx:
+            groups_seq = list(placed.values())
+            req_all = np.stack([pg.req_vec for pg in groups_seq])
+            nz_all = np.stack([pg.nonzero for pg in groups_seq])
+            gi_a = np.asarray(g_idx, np.int64)  # simonlint: ignore[dtype-drift] -- host-side fancy index, never shipped to device
+            ni_a = np.asarray(n_idx, np.int64)  # simonlint: ignore[dtype-drift] -- host-side fancy index, never shipped to device
+            c_a = np.asarray(c_val, np.float32)[:, None]
+            np.add.at(seed_requested, ni_a, req_all[gi_a] * c_a)
+            np.add.at(seed_nonzero, ni_a, nz_all[gi_a] * c_a)
     for pg in placed.values():
+        if not (pg.port_ids or pg.carrier_ids or enc.counter_list):
+            continue
         nis = np.fromiter(pg.node_counts.keys(), np.int64, len(pg.node_counts))  # simonlint: ignore[dtype-drift] -- host-side fancy index, never shipped to device
         cnts = np.fromiter(pg.node_counts.values(), np.float32, len(pg.node_counts))
-        # node keys are unique per group, so fancy-index += never drops adds;
-        # count-scaled vectors match the wave kernel's aggregate commit math
-        seed_requested[nis] += pg.req_vec[None, :] * cnts[:, None]
-        seed_nonzero[nis] += pg.nonzero[None, :] * cnts[:, None]
         for pid in pg.port_ids:
             if pid <= PORT:
                 seed_port_used[nis, pid] = True

@@ -679,6 +679,19 @@ class Simulator:
         its node-axis extension path can append template columns before the
         bucketed pads are applied."""
         faults.maybe_fail("encode")
+        batch = self.encode_batch_ids(to_schedule)
+        # Pad the scan length to bound compile-cache churn: powers of two up to 2048,
+        # then multiples of 2048 (a 10k batch scans 10240 steps, not 16384).
+        pad = bucket_capped(len(batch), 2048)
+        return build_batch_tables(self.encoder, batch, self.placed, self.match_cache, pad_to=pad)
+
+    def encode_batch_ids(self, to_schedule: List[dict]) -> List[Tuple[int, int]]:
+        """The pod-axis half of an encode: (group_id, forced_node) per pod, in
+        order, interning new groups into the shared encoder. The serving
+        image's micro-batcher (serve/batch.py) calls this alone on its warm
+        path — when every group is already interned, a request encode is a
+        dict lookup per pod and the resident node-side tables are reused
+        untouched."""
         batch: List[Tuple[int, int]] = []
         for pod in to_schedule:
             # strip_daemon_pin can only fire on pods with node affinity; the
@@ -703,10 +716,7 @@ class Simulator:
                 forced, enc_pod = -1, pod
                 pod.pop(SIG_MEMO_KEY, None)
             batch.append((self.encoder.group_of(enc_pod), forced))
-        # Pad the scan length to bound compile-cache churn: powers of two up to 2048,
-        # then multiples of 2048 (a 10k batch scans 10240 steps, not 16384).
-        pad = bucket_capped(len(batch), 2048)
-        return build_batch_tables(self.encoder, batch, self.placed, self.match_cache, pad_to=pad)
+        return batch
 
     def _kernel_ns(self, donate: bool = True):
         """The dispatch namespace for this simulator: the plain `kernels`

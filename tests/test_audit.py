@@ -79,7 +79,8 @@ def test_escape_census_splits_host_callbacks():
 def test_schedule_wave_certificate_matches_golden():
     cert = hlo.audit_kernel("schedule_wave", "s16x32", 2)
     assert cert["collective_count"] > 0  # the wave genuinely reduces
-    assert cert["donation"] == {"declared": 8, "aliased": 8, "held": True}
+    assert cert["donation"] == {"declared": 8, "aliased": 8, "held": True,
+                                "image_leaf_aliased": 0}
     assert cert["host_callbacks"] == []
     assert cert["carry_promotions"] == []
     golden = hlo.load_golden(str(GOLDEN), "schedule_wave")

@@ -1,0 +1,295 @@
+"""Closed-loop load generator for simonserve (the /v1/whatif serving bench).
+
+Builds a warm resident image over a synthetic N-node cluster (default 10k
+nodes with a committed base load), then drives C closed-loop clients — each
+issues a what-if request drawn from a small template pool (the warm-serving
+shape: repeated what-if templates mean every group is already interned and a
+request encode is a dict hit per pod), waits for the response, and
+immediately issues the next. Concurrency is what the micro-batching
+dispatcher coalesces; the loop measures sustained requests/s and latency
+percentiles, verifies a sample of responses against the serial fresh-encode
+oracle (ResidentImage.fresh_probe), and optionally sprinkles live ingest
+deltas mid-run to prove serving survives churn.
+
+Default drive is in-process through WhatIfService.submit — the serving
+engine (image + batcher + device dispatch) is the system under test;
+--http routes every request through the real HTTP stack instead (stdlib
+http.server framing then dominates the measurement).
+
+Emits one JSON row on stdout and merges a `serve_whatif_rps` row into
+BENCH_DETAIL.json (replacing any previous serve row) with --out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# requests/s the ROADMAP serving target names (item 3: >=1k req/s sustained,
+# p99 < 50ms on a warm 10k-node image)
+BASELINE_RPS = 1000.0
+
+
+def build_image(n_nodes: int, base_load_frac: float):
+    from open_simulator_tpu.serve import ResidentImage
+    from open_simulator_tpu.utils.synth import synth_node, synth_pod
+
+    nodes = [synth_node(i) for i in range(n_nodes)]
+    bound = []
+    n_bound = int(n_nodes * base_load_frac)
+    for i in range(n_bound):
+        pod = synth_pod(i, cpu_milli=4000, mem_bytes=8 << 30,
+                        labels={"app": f"base-{i % 16}"})
+        pod["spec"]["nodeName"] = f"node-{i % n_nodes:05d}"
+        bound.append(pod)
+    image = ResidentImage.try_build(nodes, pods=bound)
+    if image is None:
+        raise SystemExit("resident image declined the synthetic cluster")
+    return image
+
+
+def request_pool(n_templates: int):
+    """A pool of small what-if shapes cycling pod counts/sizes — the repeated
+    templates real what-if traffic asks (deploy X more replicas of app Y)."""
+    from open_simulator_tpu.utils.synth import synth_pod
+
+    pool = []
+    for t in range(n_templates):
+        n = 1 + t % 4
+        pods = [synth_pod(100000 + t * 10 + j,
+                          cpu_milli=100 * (1 + t % 3),
+                          mem_bytes=(256 << 20) * (1 + t % 2),
+                          labels={"app": f"whatif-{t}"})
+                for j in range(n)]
+        pool.append(pods)
+    return pool
+
+
+def run_loadgen(args) -> dict:
+    import numpy as np
+
+    from open_simulator_tpu.serve import WhatIfService
+
+    t0 = time.perf_counter()
+    image = build_image(args.nodes, args.base_load)
+    build_s = time.perf_counter() - t0
+    svc = WhatIfService(image, window_ms=args.window_ms, fanout=args.fanout)
+    pool = request_pool(args.templates)
+
+    submit = svc.submit
+    if args.http:
+        submit = _http_submit(svc, args)
+
+    # warmup: compile every lane-count bucket (1, 2, 4, ..., fanout) for the
+    # wave fast lane directly, then touch every template through the service
+    S = 1
+    while S <= args.fanout:
+        image.dispatch_sessions(
+            [image.session(pool[i % len(pool)]) for i in range(S)])
+        S *= 2
+    for pods in pool:
+        submit(pods)
+    warm = [None] * args.concurrency
+
+    def warm_lane(i):
+        warm[i] = submit(pool[i % len(pool)])
+
+    ts = [threading.Thread(target=warm_lane, args=(i,))
+          for i in range(args.concurrency)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+    stop_at = time.monotonic() + args.duration
+    lat: list = []
+    counts = [0] * args.concurrency
+    errors: list = []
+    lock = threading.Lock()
+
+    def client(ci: int) -> None:
+        rng = np.random.default_rng(1000 + ci)
+        local_lat = []
+        done = 0
+        while time.monotonic() < stop_at:
+            pods = pool[int(rng.integers(0, len(pool)))]
+            t1 = time.perf_counter()
+            try:
+                submit(pods)
+            except Exception as e:  # counted, never silent
+                with lock:
+                    errors.append(repr(e))
+                break
+            local_lat.append(time.perf_counter() - t1)
+            done += 1
+        with lock:
+            lat.extend(local_lat)
+            counts[ci] = done
+
+    churn_stop = threading.Event()
+
+    def churner() -> None:
+        """Mid-run ingest deltas: pod churn while serving (the live-cluster
+        shape). Low rate — the point is correctness under churn, measured
+        throughput stays a serving number."""
+        from open_simulator_tpu.utils.synth import synth_pod
+
+        i = 0
+        while not churn_stop.wait(0.25):
+            i += 1
+            pod = synth_pod(900000 + i, labels={"app": "churn"})
+            pod["spec"]["nodeName"] = f"node-{i % args.nodes:05d}"
+            image.apply_events([
+                {"type": "pod_add", "pod": pod}] + ([
+                    {"type": "pod_delete", "namespace": "default",
+                     "name": f"pod-{900000 + i - 4:06d}"}] if i > 4 else []))
+
+    t_run = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(args.concurrency)]
+    ch = threading.Thread(target=churner, daemon=True)
+    if args.churn:
+        ch.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    churn_stop.set()
+    wall = time.perf_counter() - t_run
+
+    # parity sample: resident answers vs the serial fresh-encode oracle
+    parity_ok = True
+    for pods in pool[:args.parity_sample]:
+        got = svc.submit(pods)
+        want = image.fresh_probe(pods)
+        if (got["scheduled"] != want["scheduled"]
+                or got["total"] != want["total"]
+                or got["utilization"] != want["utilization"]):
+            parity_ok = False
+            errors.append(f"parity mismatch: {got} != {want}")
+    svc.stop()
+
+    n = sum(counts)
+    lat_ms = sorted(x * 1000.0 for x in lat)
+
+    def pct(p: float) -> float:
+        if not lat_ms:
+            return 0.0
+        return round(lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))], 3)
+
+    from open_simulator_tpu.obs import REGISTRY
+
+    vals = REGISTRY.values()
+    rps = n / wall if wall > 0 else 0.0
+    return {
+        "metric": "serve_whatif_rps",
+        "value": round(rps, 1),
+        "unit": "req/s",
+        "vs_baseline": round(rps / BASELINE_RPS, 4),
+        "requests": n,
+        "errors": len(errors),
+        "error_sample": errors[:3],
+        "duration_s": round(wall, 3),
+        "p50_ms": pct(0.50),
+        "p99_ms": pct(0.99),
+        "nodes": args.nodes,
+        "concurrency": args.concurrency,
+        "window_ms": args.window_ms,
+        "fanout": args.fanout,
+        "drive": "http" if args.http else "inproc",
+        "churn": bool(args.churn),
+        "image_build_s": round(build_s, 3),
+        "epoch": image.epoch,
+        "batches": int(vals.get("simon_serve_batches_total", 0)),
+        "lanes_mean": round(
+            n / max(1.0, vals.get("simon_serve_batches_total", 1)), 2),
+        "seed_refreshes": int(
+            vals.get("simon_serve_seed_refreshes_total", 0)),
+        "parity_ok": parity_ok,
+        "backend": "default",
+    }
+
+
+def _http_submit(svc, args):
+    """Route requests through the real HTTP stack (one server, per-thread
+    connections)."""
+    import http.client
+
+    from open_simulator_tpu.server.http import Server
+
+    server = Server(snapshot_fn=lambda: (_ for _ in ()).throw(
+        RuntimeError("loadgen injects the image directly")), whatif=True)
+    server._whatif_svc = svc
+    httpd = server.build_httpd(port=0)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    local = threading.local()
+
+    def submit(pods):
+        conn = getattr(local, "conn", None)
+        if conn is None:
+            conn = local.conn = http.client.HTTPConnection(
+                "127.0.0.1", port, timeout=120)
+        conn.request("POST", "/v1/whatif", json.dumps({"pods": pods}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        if resp.status != 200:
+            raise RuntimeError(f"http {resp.status}: {body}")
+        return body
+
+    return submit
+
+
+def merge_row(row: dict, path: str) -> None:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {"results": []}
+    results = [r for r in doc.get("results", [])
+               if r.get("metric") != row["metric"]]
+    results.append(row)
+    doc["results"] = results
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="closed-loop what-if serving load generator (simonserve)")
+    parser.add_argument("--nodes", type=int, default=10_000)
+    parser.add_argument("--base-load", type=float, default=0.5, metavar="FRAC",
+                        help="bound base-load pods as a fraction of nodes")
+    parser.add_argument("--duration", type=float, default=5.0)
+    parser.add_argument("--concurrency", type=int, default=16)
+    parser.add_argument("--window-ms", type=float, default=2.0)
+    parser.add_argument("--fanout", type=int, default=8)
+    parser.add_argument("--templates", type=int, default=12)
+    parser.add_argument("--parity-sample", type=int, default=4)
+    parser.add_argument("--churn", action="store_true",
+                        help="apply live pod-churn ingest deltas mid-run")
+    parser.add_argument("--http", action="store_true",
+                        help="drive through the real HTTP stack instead of "
+                             "in-process submit")
+    parser.add_argument("--out", default="",
+                        help="merge the row into this BENCH_DETAIL.json")
+    args = parser.parse_args(argv)
+
+    row = run_loadgen(args)
+    print(json.dumps(row))
+    if args.out:
+        merge_row(row, args.out)
+    return 0 if (row["parity_ok"] and not row["errors"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
