@@ -14,6 +14,9 @@ BENCH_DETAIL.json:
   - mesh8_cpu:           the mesh-sharded product path on an 8-device virtual
     CPU mesh, with a placements-match check against single-device
   - capacity_plan_100k:  config 5, add-node auto-search until 100k pods fit
+  - sweep_scenarios_256x10k: simonsweep — 256 what-if scenarios x 10k pods
+    batched on the scenario axis vs a serial per-scenario Simulator loop,
+    every lane's placement census parity-asserted inside the row
 
 Wedge resilience: the accelerator tunnel can hang backend init forever (an
 uninterruptible block inside jax.devices()), so this process NEVER initializes
@@ -468,6 +471,114 @@ def _row_mesh8_1m():
     return row
 
 
+def bench_sweep(n_scenarios=256, n_nodes=960):
+    """simonsweep: 256 scenarios x 10k pods — the batched scenario sweep
+    (one shared device-resident image, copy-on-write per-lane overlays, a
+    few sweep_wave_fanout dispatches) vs the reference-style serial loop
+    (one fresh Simulator + full engine run per scenario). Parity is asserted
+    inside the row: every lane's placement census must equal its serial
+    run's bit-for-bit. On the 1-core bench host both paths run the same
+    math serially, so the ratio measures DISPATCH AMORTIZATION (one encode
+    + a few compiled fan-outs vs 256 rebuild/encode/dispatch cycles), not
+    parallel speedup; a real scenario mesh shards the lanes one-per-device
+    on top of this."""
+    from open_simulator_tpu.sweep import SweepRunner, parse_spec
+
+    templates = [
+        {"name": f"app-{i}", "replicas": 1250,
+         "cpu": f"{400 + 70 * i}m", "memory": f"{256 + 64 * i}Mi"}
+        for i in range(8)
+    ]  # 8 x 1250 = 10k pods, ~6.1k cpu on an 8k-cpu cluster (tight)
+    spec_doc = {
+        "kind": "SweepSpec",
+        "metadata": {"name": "bench-256x10k"},
+        "spec": {
+            "seed": 20260804,
+            "base": {"synthetic": {"nodes": n_nodes, "zones": 8,
+                                   "cpu": "8", "memory": "16Gi"}},
+            "workload": templates,
+            "families": [
+                {"kind": "zone_outage", "zones": "all", "width": 1},   # 8
+                {"kind": "zone_outage", "zones": "all", "width": 2},   # 28
+                {"kind": "node_drain", "counts": [4, 8, 16, 32, 64],
+                 "draws": 36},                                         # 180
+                {"kind": "preemption_storm",
+                 "storms": [250, 500, 1000, 2000],
+                 "cpu": "2", "memory": "2Gi"},                         # 4
+                {"kind": "rollout_wave", "workload": "app-0",
+                 "steps": [20, 40, 60, 80, 100],
+                 "cpu": "600m", "memory": "640Mi"},                    # 5
+                {"kind": "nodepool_mix", "counts": [8, 16, 32, 64],
+                 "cpu": "16", "memory": "32Gi"},                       # 4
+                {"kind": "monte_carlo", "draws": 26, "templates": [
+                    {"name": f"mc-{i}", "replicas": [900, 1600],
+                     "cpu": f"{450 + 60 * i}m",
+                     "memory": f"{256 + 48 * i}Mi"}
+                    for i in range(8)]},                               # 26
+            ],
+        },
+    }
+    # fanout 32: the cache sweet spot on the 1-core host (a [S, N, B]
+    # score table for 32 lanes stays resident; 256 lanes thrash), and the
+    # shape-bucketed chunking keeps storm-sized lanes out of the common
+    # chunks' static shapes. 960 base + 64 pool nodes = exactly the 1024
+    # node bucket (1000 would pad every table to 2048 columns).
+    runner = SweepRunner(parse_spec(spec_doc), parity="off", fanout=32)
+    t0 = time.perf_counter()
+    results = runner.run()
+    batched_s = time.perf_counter() - t0
+    assert len(results) == n_scenarios, len(results)
+    routes = {}
+    for res in results.values():
+        routes[res.route] = routes.get(res.route, 0) + 1
+    pods_total = sum(res.total for res in results.values())
+    sched_total = sum(res.scheduled for res in results.values())
+
+    # the serial comparison loop doubles as the parity oracle: every lane's
+    # placement census must match its fresh serial run exactly
+    mismatches = 0
+    t0 = time.perf_counter()
+    for sid in sorted(results):
+        res = results[sid]
+        oracle = runner.serial_result(res.scenario)
+        if (res.census != oracle.census
+                or res.scheduled != oracle.scheduled):
+            mismatches += 1
+        # free the big per-lane census as we go (256 lanes x ~6k entries)
+        results[sid] = res._replace(census={})
+    serial_s = time.perf_counter() - t0
+    return (batched_s, serial_s, routes, pods_total, sched_total,
+            mismatches, dict(runner.dispatches))
+
+
+def _row_sweep():
+    (batched_s, serial_s, routes, pods_total, sched_total, mismatches,
+     dispatches) = bench_sweep()
+    n = sum(routes.values())
+    ratio = serial_s / batched_s if batched_s else 0.0
+    return {
+        "metric": "sweep_scenarios_256x10k",
+        "value": round(n / batched_s, 2), "unit": "scenarios/s",
+        # vs_baseline is the work-reduction ratio: batched sweep vs the
+        # reference-style serial per-scenario loop on the same host
+        "vs_baseline": round(ratio, 4),
+        "wall_s": round(batched_s, 3),
+        "serial_wall_s": round(serial_s, 3),
+        "work_reduction": round(ratio, 2),
+        "scenarios": n, "pods_total": pods_total,
+        "scheduled_total": sched_total,
+        "routes": routes, "dispatches": dispatches,
+        "parity_mismatches": mismatches,
+        "parity_ok": mismatches == 0,
+        "note": "1-core bench host: both paths run the same scheduling "
+                "math serially, so work_reduction measures dispatch "
+                "amortization (1 encode + a few compiled fan-outs vs 256 "
+                "rebuild/encode/dispatch cycles), not parallel speedup; "
+                "the scenario axis shards one-lane-per-device on a real "
+                "mesh",
+    }
+
+
 def _row_capacity():
     rate, added, dt, stats = bench_capacity_plan()
     return {
@@ -502,6 +613,7 @@ METRICS = [
     ("mesh8_hard", _row_mesh8_hard, 1800, False),
     ("mesh8_1m", _row_mesh8_1m, 3000, False),
     ("capacity", _row_capacity, 1800, True),
+    ("sweep", _row_sweep, 3000, True),
 ]
 
 

@@ -46,6 +46,7 @@ from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 SCHEMA = 1
 S_LANES = 8          # candidate lanes in every probe fan-out audit
+K_SEGS = 4           # wave-segment chain depth in the sweep fan-out audit
 DEFAULT_SHARDS = (1, 2, 8)
 CHAIN_TARGET = "schedule_wave_chain2"
 FIXTURE_TARGET = "fixture-extra-collective"  # CI negative control, opt-in
@@ -174,6 +175,12 @@ def _dyn_abs(token: str, P: int):
         "g_s": ((S_LANES,), np.int32), "m_s": ((S_LANES,), np.int32),
         "cap1_s": ((S_LANES,), np.bool_),      # serve wave per-lane (g, m)
         "pod_group": ((P,), np.int32), "forced_node": ((P,), np.int32),
+        # sweep fan-out: per-lane wave-segment chains and per-lane pod rows
+        "g_sk": ((S_LANES, K_SEGS), np.int32),
+        "m_sk": ((S_LANES, K_SEGS), np.int32),
+        "cap1_sk": ((S_LANES, K_SEGS), np.bool_),
+        "pod_group_s": ((S_LANES, P), np.int32),
+        "forced_node_s": ((S_LANES, P), np.int32),
     }
     shape, dtype = kinds[token]
     return _sds(shape, dtype)

@@ -509,7 +509,8 @@ _DISPATCH_KERNELS = {
     "schedule_group_serial", "probe_serial_fanout",
     "probe_group_serial_fanout", "probe_wave_fanout",
     "probe_affinity_wave_fanout", "serve_whatif_fanout",
-    "serve_wave_fanout", "feasibility_jit", "explain_jit",
+    "serve_wave_fanout", "sweep_wave_fanout", "sweep_whatif_fanout",
+    "feasibility_jit", "explain_jit",
 }
 
 

@@ -233,6 +233,39 @@ def build_parser() -> argparse.ArgumentParser:
         help="record per-request decision records; /v1/whatif responses "
              "then ride the flight recorder (GET /explain, /debug/vars)")
 
+    p_sweep = sub.add_parser(
+        "sweep", help="Run a batched scenario sweep (simonsweep): N "
+                      "independent what-if futures — drains, zone outages, "
+                      "preemption storms, rollout waves, nodepool mixes, "
+                      "Monte-Carlo workload draws — evaluated on the "
+                      "scenario axis of a few fan-out dispatches, every "
+                      "lane parity-checked against a fresh serial run")
+    p_sweep.add_argument("spec", help="sweep spec file (YAML/JSON, kind: "
+                                      "SweepSpec; see examples/sweeps/)")
+    p_sweep.add_argument(
+        "--seed", type=int, default=None, metavar="K",
+        help="override the spec's seed: every random draw (Monte-Carlo "
+             "replicas, drain picks, the parity sample) derives from it "
+             "through explicit PRNG keys, so the same seed is byte-identical "
+             "report JSON")
+    p_sweep.add_argument(
+        "--out", default="", metavar="FILE.json",
+        help="write the full report as deterministic JSON")
+    p_sweep.add_argument(
+        "--json", action="store_true",
+        help="print the report JSON on stdout instead of the summary table")
+    p_sweep.add_argument(
+        "--parity", choices=("full", "sample", "off"), default="full",
+        help="batched==serial placement-census fuzzing: re-run every "
+             "batched lane ('full', default), a seeded sample, or skip "
+             "('off', bench timing only); any mismatch exits nonzero")
+    p_sweep.add_argument(
+        "--parity-sample", type=int, default=8, metavar="N",
+        help="lanes re-run serially under --parity sample (default 8)")
+    p_sweep.add_argument(
+        "--fanout", type=int, default=64, metavar="S",
+        help="max scenario lanes per batched dispatch (default 64)")
+
     sub.add_parser("version", help="Print the version of simon")
 
     p_doc = sub.add_parser("gen-doc", help="Generate markdown document for your project")
@@ -430,6 +463,57 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_sweep(args) -> int:
+    """`simon sweep`: batched scenario sweeps over one resident cluster
+    image, with the batched==serial parity fuzzer on by default."""
+    import time
+
+    from ..sweep import (
+        SweepParityError,
+        SweepRunner,
+        SweepSpecError,
+        build_report,
+        load_spec,
+        render_report,
+        report_json,
+    )
+    from ..utils.devices import ensure_responsive_backend
+
+    ensure_responsive_backend()
+    try:
+        spec = load_spec(args.spec)
+    except SweepSpecError as e:
+        print(f"sweep error: {e}", file=sys.stderr)
+        return 1
+    runner = SweepRunner(spec, seed=args.seed, parity=args.parity,
+                         parity_sample=args.parity_sample,
+                         fanout=args.fanout)
+    t0 = time.perf_counter()
+    try:
+        runner.run()
+    except SweepParityError as e:
+        print(f"sweep PARITY FAILURE: {e}", file=sys.stderr)
+        return 1
+    except SweepSpecError as e:
+        print(f"sweep error: {e}", file=sys.stderr)
+        return 1
+    wall = time.perf_counter() - t0
+    report = build_report(runner)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(report_json(report))
+    if args.json:
+        sys.stdout.write(report_json(report))
+    else:
+        print(render_report(report))
+    # wall time on stderr ONLY: the report (and --out bytes) must be
+    # deterministic across runs of the same seed
+    print(f"sweep: {len(report['scenarios'])} scenarios in {wall:.2f}s "
+          f"({len(report['scenarios']) / wall:.1f} scenarios/s)"
+          + (f" -> {args.out}" if args.out else ""), file=sys.stderr)
+    return 0
+
+
 def _load_metrics_snapshot(path: str) -> dict:
     """A registry snapshot from a --metrics-out dump or the metadata of a
     --trace-out Chrome trace. Raises ValueError on anything else."""
@@ -620,6 +704,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "metrics": cmd_metrics,
         "serve": cmd_serve,
         "server": cmd_server,
+        "sweep": cmd_sweep,
         "version": cmd_version,
         "gen-doc": cmd_gen_doc,
         "parity": cmd_parity,

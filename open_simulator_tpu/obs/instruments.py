@@ -169,6 +169,36 @@ SERVE_STALE_SESSIONS = counter(
     "What-if sessions detected stale (the image generation moved under "
     "them) and transparently re-encoded before dispatch.")
 
+# ------------------------------------------------------------------- sweep ----
+# simonsweep (sweep/): batched scenario sweeps — Monte-Carlo what-if fleets
+# coalesced onto the scenario axis of the sweep_*_fanout kernels.
+
+SWEEP_SCENARIOS = counter(
+    "simon_sweep_scenarios_total",
+    "Sweep scenarios evaluated, by family and route: 'wave' rode the "
+    "per-lane wave-chain fast lane (sweep_wave_fanout), 'scan' the "
+    "per-lane serial-scan lane (sweep_whatif_fanout), 'fresh' a "
+    "single-scenario fresh Simulator run (census-dependent gate or "
+    "contained device failure).",
+    ("family", "route"))
+SWEEP_DISPATCHES = counter(
+    "simon_sweep_dispatches_total",
+    "Batched sweep dispatches (one device round-trip per scenario chunk), "
+    "by kernel.",
+    ("kernel",))
+SWEEP_LANES = histogram(
+    "simon_sweep_batch_lanes",
+    "Scenario lanes coalesced per sweep dispatch (pre lane-padding).",
+    buckets=(1.0, 4.0, 16.0, 64.0, 256.0, 1024.0))
+SWEEP_PARITY_CHECKS = counter(
+    "simon_sweep_parity_checks_total",
+    "Sweep lanes re-run on a fresh serial Simulator and census-compared "
+    "against the batched placements (the standing parity fuzzer).")
+SWEEP_PARITY_MISMATCHES = counter(
+    "simon_sweep_parity_mismatches_total",
+    "Sweep lanes whose batched placement census diverged from the fresh "
+    "serial run. Never nonzero: a mismatch fails the sweep loudly.")
+
 # -------------------------------------------------------------- preemption ----
 
 PREEMPT_ATTEMPTS = counter(

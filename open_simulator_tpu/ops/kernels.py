@@ -2063,6 +2063,78 @@ def serve_wave_fanout(tb: Tables, cry_s: Carry, active_s, g_s, m_s, cap1_s,
     return jax.vmap(one)(cry_s, active_s, g_s, m_s, cap1_s)
 
 
+def _sweep_wave_step(tb: Tables, cry: Carry, xs, w: ScoreWeights,
+                     filters: FilterFlags, block: int, kmax: int):
+    """One wave segment of a sweep lane's chain: (carry, j[N] counts)."""
+    g, m, cap1 = xs
+    c2, j, _ = schedule_wave(
+        tb, cry, g, m, cap1,
+        gpu_live=False, w=w, filters=filters, block=block, kmax=kmax)
+    return c2, j
+
+
+@partial(jax.jit, static_argnames=("w", "filters", "block", "kmax"))
+@shaped(active_s="[S, N] bool", g_sk="[S, K] i32", m_sk="[S, K] i32",
+        cap1_sk="[S, K] bool")
+def sweep_wave_fanout(tb: Tables, cry_s: Carry, active_s, g_sk, m_sk, cap1_sk,
+                      w: ScoreWeights = DEFAULT_WEIGHTS,
+                      filters: FilterFlags = DEFAULT_FILTERS,
+                      block: int = WAVE_BLOCK, kmax: int = 0):
+    """K chained schedule_wave segments per lane over S scenario overlays —
+    simonsweep's fast lane (sweep/runner.py). Each scenario lane carries its
+    OWN chain of (group, replica-count, cap1) wave segments [S, K] plus its
+    own node-active overlay and seed copy, so one dispatch evaluates S
+    independent cluster futures whose workloads are per-lane template x
+    replica mixes. Within a lane, segment k's output carry feeds segment
+    k+1 (lax.scan), exactly the engine's chained per-segment dispatch; a
+    padding segment (m == 0) provably commits nothing (the wave loop never
+    runs and _aggregate_commit scales every update by the zero counts).
+    Returns (carry_s, counts_skn [S, K, N] i32): per-segment per-node
+    placement counts — the placement census parity is asserted against a
+    fresh serial run per lane (pods of one group are interchangeable, the
+    engine's own stitching rule)."""
+
+    def lane(cry: Carry, active, g_k, m_k, cap1_k):
+        tbm = _mask_active(tb, active)
+
+        def step(c: Carry, xs):
+            return _sweep_wave_step(tbm, c, xs, w, filters, block, kmax)
+
+        c2, j_k = jax.lax.scan(step, cry, (g_k, m_k, cap1_k))
+        return c2, j_k
+
+    return jax.vmap(lane)(cry_s, active_s, g_sk, m_sk, cap1_sk)
+
+
+@partial(jax.jit, static_argnames=("n_zones", "enable_gpu", "enable_storage", "w", "filters"))
+@shaped(active_s="[S, N] bool", pod_group_s="[S, P] i32",
+        forced_node_s="[S, P] i32", valid_s="[S, P] bool")
+def sweep_whatif_fanout(tb: Tables, cry_s: Carry, active_s, pod_group_s,
+                        forced_node_s, valid_s, n_zones: int,
+                        enable_gpu: bool = True, enable_storage: bool = True,
+                        w: ScoreWeights = DEFAULT_WEIGHTS,
+                        filters: FilterFlags = DEFAULT_FILTERS):
+    """schedule_batch over S scenario lanes with PER-LANE pod batches —
+    simonsweep's exact lane for scenarios whose groups are not all
+    wave-eligible (required affinity gates, forced nodes, short mixed runs).
+    Unlike serve_whatif_fanout's union batch (every lane scans the union
+    length), each lane scans only the max per-lane batch length: lane i's
+    rows are its own scenario's pods, invalid tail rows are provable no-ops.
+    Returns (carry_s, choices_s [S, P] i32, -1 = unschedulable) — per-pod
+    choices, so every lane's placements diff bit-for-bit against a fresh
+    serial run."""
+
+    def lane(cry: Carry, active, pg, fn, vd):
+        c2, choices = schedule_batch(
+            _mask_active(tb, active), cry, pg, fn, vd,
+            n_zones=n_zones, enable_gpu=enable_gpu,
+            enable_storage=enable_storage, w=w, filters=filters)
+        return c2, choices
+
+    return jax.vmap(lane)(cry_s, active_s, pod_group_s, forced_node_s,
+                          valid_s)
+
+
 # ---------------------------------------------------------------------------
 # Auditable hot-kernel registry (simonaudit, analysis/hlo.py).
 #
@@ -2141,5 +2213,13 @@ HOT_KERNELS = {
     "serve_wave_fanout": HotKernelSpec(
         ("g_s", "m_s", "cap1_s"), ("carry_s", "lane"),
         lambda nz: (DEFAULT_WEIGHTS, DEFAULT_FILTERS, WAVE_BLOCK, 0),
+        fanout=True),
+    "sweep_wave_fanout": HotKernelSpec(
+        ("g_sk", "m_sk", "cap1_sk"), ("carry_s", "lane_sn"),
+        lambda nz: (DEFAULT_WEIGHTS, DEFAULT_FILTERS, WAVE_BLOCK, 0),
+        fanout=True),
+    "sweep_whatif_fanout": HotKernelSpec(
+        ("pod_group_s", "forced_node_s", "valid_sp"), ("carry_s", "lane_p"),
+        lambda nz: (nz, False, False, DEFAULT_WEIGHTS, DEFAULT_FILTERS),
         fanout=True),
 }

@@ -376,8 +376,15 @@ class ShardedKernels:
         if SCENARIO_AXIS in mesh.shape:
             _, self.carry_s_sh, self.active_sh = fanout_shardings(mesh)
             self.lane_sh = NamedSharding(mesh, P(SCENARIO_AXIS))
+            # sweep fan-out outputs: [S, K, N] per-segment placement counts
+            # and [S, P] per-lane pod choices, both lane-sharded so every
+            # scenario's results stay on its own device until the fetch
+            self.lane_sn_sh = NamedSharding(mesh, P(SCENARIO_AXIS, None,
+                                                    NODE_AXIS))
+            self.lane_p_sh = NamedSharding(mesh, P(SCENARIO_AXIS, None))
         else:
             self.carry_s_sh = self.active_sh = self.lane_sh = None
+            self.lane_sn_sh = self.lane_p_sh = None
 
     def undonated(self) -> "ShardedKernels":
         """A view over the same jit cache whose carry inputs survive the
@@ -420,7 +427,8 @@ class ShardedKernels:
     def _tail_shardings(self, symbols):
         """Resolve a HOT_KERNELS out-tail symbol tuple to shardings."""
         table = {"carry": self.carry_sh, "carry_s": self.carry_s_sh,
-                 "node": self.node_sh, "lane": self.lane_sh, "rep": self.rep}
+                 "node": self.node_sh, "lane": self.lane_sh, "rep": self.rep,
+                 "lane_sn": self.lane_sn_sh, "lane_p": self.lane_p_sh}
         return tuple(table[s] for s in symbols)
 
     def _kernel_jit(self, name, stats=False):
@@ -577,6 +585,23 @@ class ShardedKernels:
         fn = self._kernel_jit("serve_wave_fanout")
         return fn(tb, cry_s, active_s, g_s, m_s, cap1_s, w, filters, block,
                   kmax)
+
+    def sweep_wave_fanout(self, tb, cry_s, active_s, g_sk, m_sk, cap1_sk, *,
+                          w=kernels.DEFAULT_WEIGHTS,
+                          filters=kernels.DEFAULT_FILTERS,
+                          block=kernels.WAVE_BLOCK, kmax=0):
+        fn = self._kernel_jit("sweep_wave_fanout")
+        return fn(tb, cry_s, active_s, g_sk, m_sk, cap1_sk, w, filters,
+                  block, kmax)
+
+    def sweep_whatif_fanout(self, tb, cry_s, active_s, pod_group_s,
+                            forced_node_s, valid_s, *, n_zones,
+                            enable_gpu=True, enable_storage=True,
+                            w=kernels.DEFAULT_WEIGHTS,
+                            filters=kernels.DEFAULT_FILTERS):
+        fn = self._kernel_jit("sweep_whatif_fanout")
+        return fn(tb, cry_s, active_s, pod_group_s, forced_node_s, valid_s,
+                  n_zones, enable_gpu, enable_storage, w, filters)
 
 
 def carry_reshard_bytes(carry, shardings) -> int:

@@ -517,6 +517,22 @@ class ResidentImage:
                     return "gpu/local-storage request"
         return None
 
+    def lane_overlay(self, session: WhatIfSession,
+                     activate: Sequence[str] = ()):
+        """One sweep lane's copy-on-write overlay: lane_inputs' (active row,
+        seed copy) plus ACTIVATION of currently-drained nodes by name — the
+        nodepool-mix family pre-encodes its pool nodes into the image (built
+        drained) and each scenario lane flips k of them live. Activation
+        never touches the seeds: a pool node has no pods, so its seed rows
+        are zero by construction and a masked-live node is exactly a fresh
+        encode's extra node."""
+        active, seeds = self.lane_inputs(session)
+        for name in activate:
+            ni = self._sim.na.index.get(name)
+            if ni is not None:
+                active[ni] = True
+        return active, seeds
+
     def lane_inputs(self, session: WhatIfSession):
         """(active_row [n_pad] bool, seeds tuple) for one session's overlay:
         the image's live mask minus the request's drains, and — when drains
@@ -639,13 +655,18 @@ class ResidentImage:
             return None
         return (g0, len(batch), route.cap1)
 
-    def _lane_arrays(self, sessions: List[WhatIfSession]):
+    def _lane_arrays(self, sessions: List[WhatIfSession],
+                     activates: Optional[Sequence[Sequence[str]]] = None):
         """(S, active_s [S, n_pad], carry_np) — lane quantization (pow2,
         then the mesh shard multiple; surplus lanes repeat lane 0 and are
         sliced off) plus each lane's active overlay and seed copy. carry_np
         is None when every lane uses the UNMODIFIED base seeds (no drains) —
         the staging path then reuses the per-(epoch, S) device-resident
-        carry instead of re-stacking and re-transferring it per dispatch."""
+        carry instead of re-stacking and re-transferring it per dispatch.
+        `activates` (aligned with sessions) routes through lane_overlay —
+        the sweep runner's nodepool-activation lanes share this exact
+        assembly (ONE home for the quantization + base-carry-cache logic,
+        the area the PR 9 donation fix patched)."""
         S = 1
         while S < len(sessions):
             S *= 2
@@ -657,7 +678,10 @@ class ResidentImage:
         lane_seeds = []
         all_base = True
         for li, s in enumerate(sessions):
-            active, seeds = self.lane_inputs(s)
+            if activates is None:
+                active, seeds = self.lane_inputs(s)
+            else:
+                active, seeds = self.lane_overlay(s, activates[li])
             active_s[li] = active
             lane_seeds.append(seeds)
             all_base &= seeds is self._seeds
@@ -894,11 +918,16 @@ class ResidentImage:
 
     # ---------------------------------------------------------- slow path -----
 
-    def current_nodes(self, extra_drains: Sequence[str] = ()) -> List[dict]:
-        """Deep copies of the live (non-drained) nodes, order preserved."""
+    def current_nodes(self, extra_drains: Sequence[str] = (),
+                      include: Sequence[str] = ()) -> List[dict]:
+        """Deep copies of the live (non-drained) nodes, order preserved.
+        `include` names currently-drained nodes to treat as live (the sweep
+        nodepool activation overlay)."""
         skip = set(extra_drains)
+        add = set(include)
         return [copy.deepcopy(n) for i, n in enumerate(self._sim.na.nodes)
-                if self.active[i] and name_of(n) not in skip]
+                if (self.active[i] or name_of(n) in add)
+                and name_of(n) not in skip]
 
     def cluster_pods(self, extra_drains: Sequence[str] = ()) -> List[dict]:
         """Deep copies of the committed (bound) pods on live nodes, in commit
@@ -910,18 +939,20 @@ class ResidentImage:
                 out.append(copy.deepcopy(pod))
         return out
 
-    def fresh_probe(self, pods: List[dict],
-                    drains: Sequence[str] = ()) -> dict:
-        """The from-scratch oracle AND the fresh-path route: build a fresh
-        Simulator over the current cluster state (minus request drains and
-        those nodes' pods), replay the bound pods, probe the request. This
-        is byte-for-byte what the resident path must reproduce — the parity
-        suite compares the two on every seeded trace."""
+    def fresh_simulator(self, drains: Sequence[str] = (),
+                        include: Sequence[str] = ()):
+        """(sim, bound_pods, epoch): a fresh Simulator over the current live
+        cluster state minus `drains` (and those nodes' pods) plus the named
+        currently-drained nodes in `include` (sweep nodepool activation),
+        with the image's cluster objects registered. `bound_pods` are deep
+        copies of the committed pods in commit order — the prebound prefix
+        the from-scratch oracle replays before the request. Shared by
+        fresh_probe and the sweep runner's serial oracle."""
         from ..core.types import ResourceTypes
         from ..simulator.engine import Simulator
 
         with self._lock:
-            nodes = self.current_nodes(drains)
+            nodes = self.current_nodes(drains, include)
             bound = self.cluster_pods(drains)
             model = self._sim.model
             rt = ResourceTypes(
@@ -938,6 +969,16 @@ class ResidentImage:
             epoch = self.epoch
         sim = Simulator(nodes, sched_config=sched_config)
         sim.register_cluster_objects(rt)
+        return sim, bound, epoch
+
+    def fresh_probe(self, pods: List[dict],
+                    drains: Sequence[str] = ()) -> dict:
+        """The from-scratch oracle AND the fresh-path route: build a fresh
+        Simulator over the current cluster state (minus request drains and
+        those nodes' pods), replay the bound pods, probe the request. This
+        is byte-for-byte what the resident path must reproduce — the parity
+        suite compares the two on every seeded trace."""
+        sim, bound, epoch = self.fresh_simulator(drains)
         request = [copy.deepcopy(p) for p in pods]
         scheduled, total = sim.probe_pods(bound + request)
         return {

@@ -1,0 +1,453 @@
+"""simonsweep: batched scenario sweeps (sweep/).
+
+The contract under test (README "Scenario sweeps", PARITY.md "Sweep
+fuzzing"):
+
+- **Batched == serial, every lane.** Each scenario evaluated on the
+  scenario axis of a sweep_*_fanout dispatch — through copy-on-write
+  drain/activation overlays on one shared resident image — produces a
+  per-(node, scheduling-signature) placement census EXACTLY equal to a
+  fresh serial Simulator run of that scenario alone. Pods of one group are
+  interchangeable, so census equality is placement bit-identity (the
+  engine's own stitching rule).
+- **Seeded determinism.** Everything random derives from explicit
+  SeedSequence keys (seed, family, scenario): same seed = byte-identical
+  report JSON; different seed = different Monte-Carlo draws.
+- **Routing honesty.** Wave-eligible scenarios ride the wave-chain lane,
+  affinity-gated ones the exact scan lane, census-dependent workloads and
+  image-declined clusters the fresh path — and every route's result is
+  parity-checked the same way.
+"""
+
+import copy
+import json
+
+import pytest
+
+from open_simulator_tpu.sweep import (
+    SweepParityError,
+    SweepRunner,
+    SweepSpecError,
+    build_report,
+    compile_families,
+    load_spec,
+    parse_spec,
+    render_report,
+    report_json,
+)
+from open_simulator_tpu.sweep.families import build_base
+
+BASE = {"synthetic": {"nodes": 12, "zones": 3, "cpu": "8", "memory": "16Gi",
+                      "bound": 8, "boundCpu": "1", "boundMemory": "1Gi"}}
+
+
+def make_doc(families, workload=None, base=None, seed=7):
+    return {
+        "kind": "SweepSpec",
+        "metadata": {"name": "test"},
+        "spec": {
+            "seed": seed,
+            "base": base or BASE,
+            "workload": workload or [
+                {"name": "web", "replicas": 24, "cpu": "1", "memory": "1Gi"},
+                {"name": "cache", "replicas": 8, "cpu": "500m",
+                 "memory": "512Mi"},
+            ],
+            "families": families,
+        },
+    }
+
+
+def run_doc(doc, **kw):
+    kw.setdefault("parity", "full")
+    kw.setdefault("fanout", 4)
+    runner = SweepRunner(parse_spec(doc), **kw)
+    runner.run()
+    return runner
+
+
+# ------------------------------------------------------------- spec layer ----
+
+
+def test_spec_parse_and_digest_stability():
+    doc = make_doc([{"kind": "node_drain", "counts": [1], "draws": 2}])
+    spec = parse_spec(doc)
+    assert spec.name == "test" and spec.seed == 7
+    assert spec.digest() == parse_spec(copy.deepcopy(doc)).digest()
+    doc2 = copy.deepcopy(doc)
+    doc2["spec"]["workload"][0]["replicas"] = 25
+    assert parse_spec(doc2).digest() != spec.digest()
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    (lambda d: d["spec"].pop("families"), "families"),
+    (lambda d: d["spec"]["workload"][0].update(priority=10), "priority"),
+    (lambda d: d["spec"]["families"].append({"kind": "bogus"}), "unknown family"),
+    (lambda d: d["spec"]["families"].append(
+        {"kind": "rollout_wave", "workload": "nope", "steps": [50]}),
+     "unknown workload"),
+    (lambda d: d["spec"].update(base={}), "base"),
+    (lambda d: d["spec"]["families"].append(
+        {"kind": "node_drain", "counts": [0], "draws": 1}), "counts"),
+    (lambda d: d["spec"]["families"].append(
+        {"kind": "monte_carlo", "draws": 1, "templates": ["oops"]}),
+     "must be mappings"),
+])
+def test_spec_validation_errors(mutate, needle):
+    doc = make_doc([{"kind": "node_drain", "counts": [1], "draws": 1}])
+    mutate(doc)
+    with pytest.raises(SweepSpecError, match=needle):
+        parse_spec(doc)
+
+
+def test_zone_outage_pairs_need_two_zones():
+    """width=2 on a single-zone cluster must refuse loudly, never compile
+    an empty grid that reports as if it ran."""
+    doc = make_doc([{"kind": "zone_outage", "zones": "all", "width": 2}],
+                   base={"synthetic": {"nodes": 6, "zones": 1, "cpu": "8",
+                                       "memory": "16Gi"}})
+    spec = parse_spec(doc)
+    nodes, _ = build_base(spec)
+    with pytest.raises(SweepSpecError, match="at least 2 zones"):
+        compile_families(spec, 7, nodes)
+
+
+def test_load_spec_wraps_parse_errors(tmp_path):
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("kind: SweepSpec\nspec: [unbalanced\n")
+    with pytest.raises(SweepSpecError, match="unparseable"):
+        load_spec(str(bad))
+
+
+def test_family_compilation_is_seed_deterministic():
+    doc = make_doc([
+        {"kind": "node_drain", "counts": [2], "draws": 3},
+        {"kind": "monte_carlo", "draws": 3, "templates": [
+            {"name": "mc", "replicas": [1, 40], "cpu": "250m",
+             "memory": "256Mi"}]},
+    ])
+    spec = parse_spec(doc)
+    nodes, _ = build_base(spec)
+    a = compile_families(spec, 7, nodes)
+    b = compile_families(spec, 7, nodes)
+    assert [s.drains for s in a.scenarios] == [s.drains for s in b.scenarios]
+    assert [len(s.pods) for s in a.scenarios] == [
+        len(s.pods) for s in b.scenarios]
+    c = compile_families(spec, 8, nodes)
+    assert ([s.drains for s in a.scenarios] != [s.drains for s in c.scenarios]
+            or [len(s.pods) for s in a.scenarios]
+            != [len(s.pods) for s in c.scenarios])
+    # explicit PRNG keys recorded per scenario
+    assert all(s.key[0] == 7 for s in a.scenarios)
+
+
+# ------------------------------------------------- batched==serial parity ----
+
+
+def test_wave_route_parity_all_families():
+    """The core fuzz invariant on the wave lane: drains, outages, storms,
+    rollouts, pool activations — every lane census equals a fresh serial
+    run (SweepRunner raises on any divergence; parity=full checks all)."""
+    runner = run_doc(make_doc([
+        {"kind": "zone_outage", "zones": "all"},
+        {"kind": "node_drain", "counts": [1, 3], "draws": 2},
+        {"kind": "preemption_storm", "storms": [6, 16], "cpu": "2",
+         "memory": "2Gi"},
+        {"kind": "rollout_wave", "workload": "web", "steps": [50, 100],
+         "cpu": "1500m", "memory": "1536Mi"},
+        {"kind": "nodepool_mix", "counts": [1, 2], "cpu": "16",
+         "memory": "32Gi"},
+    ]))
+    assert runner.parity_checked == len(runner.results)
+    assert all(r.route == "wave" for r in runner.results.values())
+    # drains/outages really reduce the live node count
+    outage = next(r for r in runner.results.values()
+                  if r.scenario.family == "zone_outage")
+    assert outage.nodes_live < 12
+    pool = next(r for r in runner.results.values()
+                if r.scenario.family == "nodepool_mix")
+    assert pool.nodes_live > 12
+
+
+def test_scan_route_parity_with_affinity_groups():
+    """Self-matching required affinity routes off the wave (the engine's
+    own eligibility) onto the per-lane serial-scan kernel; the census
+    invariant holds identically there."""
+    runner = run_doc(make_doc(
+        [{"kind": "node_drain", "counts": [2], "draws": 2},
+         {"kind": "monte_carlo", "draws": 2, "templates": [
+             {"name": "mc", "replicas": [4, 16], "cpu": "500m",
+              "memory": "512Mi"},
+             {"name": "pair", "replicas": [2, 6], "cpu": "250m",
+              "memory": "256Mi", "affinityOn": "pair"}]}],
+        workload=[
+            {"name": "web", "replicas": 12, "cpu": "1", "memory": "1Gi"},
+            {"name": "pair", "replicas": 6, "cpu": "250m",
+             "memory": "256Mi", "affinityOn": "pair"}]))
+    routes = {r.route for r in runner.results.values()}
+    assert routes == {"scan"}
+    assert runner.parity_checked == len(runner.results)
+
+
+def test_mixed_wave_and_scan_routing():
+    """Monte-Carlo draws with affinity templates ride scan while the plain
+    drain lanes ride wave — both batched, both parity-checked."""
+    runner = run_doc(make_doc([
+        {"kind": "node_drain", "counts": [1], "draws": 2},
+        {"kind": "monte_carlo", "draws": 2, "templates": [
+            {"name": "solo", "replicas": [3, 10], "cpu": "500m",
+             "memory": "512Mi", "affinityOn": "solo"}]},
+    ]))
+    routes = [r.route for _, r in sorted(runner.results.items())]
+    assert "wave" in routes and "scan" in routes
+
+
+def test_census_dependent_workload_routes_fresh():
+    """A spread-constrained workload is census-dependent (eligible-domain
+    sets read the node census): the image gate routes it to the fresh
+    serial path, recorded with its gate reason."""
+    from open_simulator_tpu.sweep.families import build_pod
+    from open_simulator_tpu.sweep.spec import PodTemplate
+
+    doc = make_doc([{"kind": "node_drain", "counts": [1], "draws": 1}])
+    runner = SweepRunner(parse_spec(doc))
+    runner.run()
+    pods = [build_pod(f"spready-{i}", PodTemplate(name="spready",
+                                                 replicas=0))
+            for i in range(4)]
+    for p in pods:
+        p["spec"]["topologySpreadConstraints"] = [{
+            "maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "spready"}}}]
+    session = runner.image.session(pods)
+    gate = runner.image.eligible(session.batch, pods)
+    assert gate is not None and "spread" in gate
+
+
+def test_image_declined_cluster_runs_fresh_end_to_end():
+    """A base cluster the resident image declines (node-advertised images:
+    ImageLocality divides by the total node count) runs every scenario on
+    the fresh serial path — same report schema, no batched dispatches."""
+    doc = make_doc([{"kind": "node_drain", "counts": [1], "draws": 1}])
+    spec = parse_spec(doc)
+    runner = SweepRunner(spec, parity="full")
+    base_nodes, bound = build_base(spec)
+    base_nodes[0].setdefault("status", {})["images"] = [
+        {"names": ["busybox"], "sizeBytes": 1 << 20}]
+    import open_simulator_tpu.sweep.runner as runner_mod
+
+    orig = runner_mod.build_base
+    runner_mod.build_base = lambda s: (base_nodes, bound)
+    try:
+        runner.run()
+    finally:
+        runner_mod.build_base = orig
+    assert runner.image is None
+    assert all(r.route == "fresh" for r in runner.results.values())
+    report = build_report(runner)
+    assert report["lanes"] == {"fresh": 2}
+    assert report["parity"]["checked"] == 0  # nothing batched to fuzz
+
+
+def test_parity_mismatch_raises_loudly():
+    """A doctored batched census must fail the sweep (negative control for
+    the fuzzer's teeth) and move the mismatch counter."""
+    from open_simulator_tpu.obs import REGISTRY
+
+    doc = make_doc([{"kind": "node_drain", "counts": [1], "draws": 1}])
+    runner = SweepRunner(parse_spec(doc), parity="off")
+    runner.run()
+    sid = max(runner.results)
+    res = runner.results[sid]
+    doctored = dict(res.census)
+    key = next(iter(doctored))
+    doctored[key] += 1
+    runner.results[sid] = res._replace(census=doctored)
+    runner.parity = "full"
+    before = REGISTRY.values().get(
+        "simon_sweep_parity_mismatches_total", 0) or 0
+    with pytest.raises(SweepParityError, match="diverged"):
+        runner._check_parity()
+    after = REGISTRY.values().get("simon_sweep_parity_mismatches_total")
+    assert after == before + 1
+
+
+# ------------------------------------------------------------ determinism ----
+
+
+def test_report_bytes_identical_across_runs():
+    doc = make_doc([
+        {"kind": "node_drain", "counts": [2], "draws": 2},
+        {"kind": "monte_carlo", "draws": 2, "templates": [
+            {"name": "mc", "replicas": [2, 30], "cpu": "500m",
+             "memory": "512Mi"}]},
+    ])
+    j1 = report_json(build_report(run_doc(doc)))
+    j2 = report_json(build_report(run_doc(copy.deepcopy(doc))))
+    assert j1 == j2
+    report = json.loads(j1)
+    assert report["seed"] == 7
+    # the per-scenario PRNG keys are explicit in the report
+    for row in report["scenarios"]:
+        assert row["key"][0] == 7
+
+
+def test_seed_override_changes_draws_and_report():
+    doc = make_doc([
+        {"kind": "node_drain", "counts": [2], "draws": 2},
+        {"kind": "monte_carlo", "draws": 3, "templates": [
+            {"name": "mc", "replicas": [1, 60], "cpu": "250m",
+             "memory": "256Mi"}]},
+    ])
+    r1 = run_doc(doc, parity="off")
+    r2 = run_doc(copy.deepcopy(doc), parity="off", seed=12345)
+    rep1, rep2 = build_report(r1), build_report(r2)
+    assert rep1["spec_digest"] == rep2["spec_digest"]  # same spec...
+    assert rep1["seed"] != rep2["seed"]                # ...different seed
+    mc1 = [r["pods"] for r in rep1["scenarios"]
+           if r["family"] == "monte_carlo"]
+    mc2 = [r["pods"] for r in rep2["scenarios"]
+           if r["family"] == "monte_carlo"]
+    assert mc1 != mc2
+
+
+# ----------------------------------------------------------- report layer ----
+
+
+def test_report_schema_and_family_metrics():
+    runner = run_doc(make_doc([
+        {"kind": "preemption_storm", "storms": [10, 20], "cpu": "2",
+         "memory": "2Gi"},
+        {"kind": "nodepool_mix", "counts": [1, 2], "cpu": "16",
+         "memory": "32Gi"},
+        {"kind": "zone_outage", "zones": "all"},
+    ], workload=[{"name": "web", "replicas": 40, "cpu": "1500m",
+                  "memory": "1536Mi"}]))
+    report = build_report(runner)
+    assert sum(report["lanes"].values()) == len(report["scenarios"])
+    storms = report["families"]["preemption_storm"]
+    assert [v["storm"] for v in storms["victims"]["per_scenario"]] == [10, 20]
+    assert storms["victims"]["max"] >= 0
+    env = report["families"]["nodepool_mix"]["capacity_envelope"]
+    assert [e["pool"] for e in env] == [1, 2]
+    assert env[0]["nodes"] == 13 and env[1]["nodes"] == 14
+    # bigger pools never schedule fewer pods (the envelope is monotone)
+    assert env[1]["scheduled"] >= env[0]["scheduled"]
+    per_zone = report["families"]["zone_outage"]["per_zone"]
+    assert len(per_zone) == 3
+    text = render_report(report)
+    assert "capacity envelope" in text and "victims" in text
+
+
+def test_storm_victims_count_displaced_baseline_pods():
+    """Victims = baseline pods the storm displaces under priority-ordered
+    admission, vs the baseline anchor lane."""
+    runner = run_doc(make_doc(
+        [{"kind": "preemption_storm", "storms": [30], "cpu": "4",
+          "memory": "4Gi"}],
+        workload=[{"name": "web", "replicas": 40, "cpu": "2",
+                   "memory": "2Gi"}]))
+    report = build_report(runner)
+    baseline = report["scenarios"][0]
+    storm_row = next(r for r in report["scenarios"]
+                     if r["family"] == "preemption_storm")
+    victims = report["families"]["preemption_storm"]["victims"]
+    assert victims["per_scenario"][0]["victims"] == (
+        baseline["tiers"]["baseline"] - storm_row["tiers"]["baseline"])
+    assert victims["per_scenario"][0]["victims"] > 0  # 4-cpu storm displaces
+
+
+# ---------------------------------------------------------------- kernels ----
+
+
+def test_wave_chain_padding_segments_are_noops():
+    """A lane padded with m=0 segments must equal the same lane without
+    padding: the sweep_wave_fanout K axis is pure shape quantization."""
+    doc = make_doc([{"kind": "node_drain", "counts": [1], "draws": 1}],
+                   workload=[{"name": "web", "replicas": 10, "cpu": "1",
+                              "memory": "1Gi"}])
+    r1 = run_doc(doc)  # K quantizes to 1 segment
+    doc2 = copy.deepcopy(doc)
+    doc2["spec"]["workload"] = [
+        {"name": "web", "replicas": 10, "cpu": "1", "memory": "1Gi"},
+        {"name": "w2", "replicas": 1, "cpu": "250m", "memory": "256Mi"},
+        {"name": "w3", "replicas": 1, "cpu": "250m", "memory": "256Mi"},
+    ]  # 3 segments -> K=4, one padding segment per lane
+    r2 = run_doc(doc2)
+    # the shared 'web' placements agree bit-for-bit between the two shapes
+    c1 = {k: v for k, v in r1.results[0].census.items()}
+    web_sig = {k[1] for k in c1}
+    c2 = {k: v for k, v in r2.results[0].census.items() if k[1] in web_sig}
+    assert c1 == c2
+
+
+def test_sweep_counters_move():
+    from open_simulator_tpu.obs import REGISTRY
+
+    before = REGISTRY.values()
+    runner = run_doc(make_doc([
+        {"kind": "node_drain", "counts": [1], "draws": 1}]))
+    after = REGISTRY.values()
+
+    def delta(key):
+        return (after.get(key) or 0) - (before.get(key) or 0)
+
+    assert delta('simon_sweep_scenarios_total{family="baseline",route="wave"}') == 1
+    assert delta('simon_sweep_scenarios_total{family="node_drain",route="wave"}') == 1
+    assert delta('simon_sweep_dispatches_total{kernel="sweep_wave_fanout"}') == 1
+    assert delta("simon_sweep_parity_checks_total") == 2
+    assert delta("simon_sweep_parity_mismatches_total") == 0
+    assert sum(runner.dispatches.values()) == 1
+
+
+# ------------------------------------------------------------- CLI + files ----
+
+
+def test_example_specs_parse_and_compile():
+    import os
+
+    base = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "sweeps")
+    names = [f for f in os.listdir(base) if f.endswith(".yaml")]
+    assert len(names) >= 3
+    for fname in names:
+        spec = load_spec(os.path.join(base, fname))
+        nodes, _ = build_base(spec)
+        compiled = compile_families(spec, spec.seed, nodes)
+        assert len(compiled.scenarios) >= 2
+        expected = os.path.join(base, fname[:-5] + ".expected.json")
+        assert os.path.exists(expected), f"missing snippet for {fname}"
+        with open(expected) as fh:
+            snip = json.load(fh)
+        assert snip["spec_digest"] == spec.digest(), (
+            f"{fname}: spec edited without regenerating its expected "
+            f"snippet (tools/sweep_smoke.py re-runs zone-outage end-to-end)")
+        assert len(snip["scenarios"]) == len(compiled.scenarios)
+
+
+def test_cli_sweep_writes_deterministic_report(tmp_path):
+    from open_simulator_tpu.cli.main import main
+
+    spec_path = tmp_path / "spec.yaml"
+    import yaml
+
+    spec_path.write_text(yaml.safe_dump(make_doc(
+        [{"kind": "node_drain", "counts": [1], "draws": 1}])))
+    out1, out2 = tmp_path / "r1.json", tmp_path / "r2.json"
+    assert main(["sweep", str(spec_path), "--out", str(out1),
+                 "--seed", "3"]) == 0
+    assert main(["sweep", str(spec_path), "--out", str(out2),
+                 "--seed", "3"]) == 0
+    assert out1.read_bytes() == out2.read_bytes()
+    report = json.loads(out1.read_text())
+    assert report["kind"] == "SweepReport" and report["seed"] == 3
+
+
+def test_cli_sweep_rejects_bad_spec(tmp_path, capsys):
+    from open_simulator_tpu.cli.main import main
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("kind: SweepSpec\nspec: {seed: 1}\n")
+    assert main(["sweep", str(bad)]) == 1
+    assert "sweep error" in capsys.readouterr().err

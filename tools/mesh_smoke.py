@@ -125,7 +125,48 @@ def main() -> int:
     assert reshard == 0, f"chained dispatches resharded {reshard} bytes"
     assert carry_layout_ok, "final carry left the declared sharding"
     assert failover_ok, "sharded wedge failover did not resume from prefix"
+    sweep_ok = sweep_on_mesh()
+    assert sweep_ok, "sharded sweep lanes diverged from the serial oracle"
     return 0
+
+
+def sweep_on_mesh() -> bool:
+    """simonsweep over a 2-shard scenario mesh: both sweep fan-out kernels
+    dispatch with the [S] lane axis sharded one-lane-per-device, and every
+    lane's placement census must still equal a fresh serial run (the
+    runner's full-parity mode raises on any divergence)."""
+    from open_simulator_tpu.parallel.mesh import make_scenario_mesh
+    from open_simulator_tpu.sweep import SweepRunner, build_report, parse_spec
+
+    doc = {"kind": "SweepSpec", "spec": {
+        "seed": 4,
+        "base": {"synthetic": {"nodes": 10, "zones": 2, "cpu": "8",
+                               "memory": "16Gi", "bound": 6}},
+        "workload": [
+            {"name": "web", "replicas": 20, "cpu": "1", "memory": "1Gi"},
+            {"name": "cache", "replicas": 9, "cpu": "500m",
+             "memory": "512Mi"},
+        ],
+        "families": [
+            {"kind": "node_drain", "counts": [1, 2], "draws": 2},
+            {"kind": "preemption_storm", "storms": [8], "cpu": "2",
+             "memory": "2Gi"},
+            {"kind": "monte_carlo", "draws": 2, "templates": [
+                {"name": "pair", "replicas": [2, 6], "cpu": "250m",
+                 "memory": "256Mi", "affinityOn": "pair"}]},
+        ],
+    }}
+    runner = SweepRunner(parse_spec(doc), parity="full", fanout=4,
+                         mesh=make_scenario_mesh(2))
+    runner.run()  # raises SweepParityError on any census mismatch
+    report = build_report(runner)
+    print(json.dumps({"sweep_on_mesh": report["lanes"],
+                      "sweep_dispatches": report["dispatches"],
+                      "sweep_parity": report["parity"]}), flush=True)
+    return (report["lanes"].get("wave", 0) > 0
+            and report["lanes"].get("scan", 0) > 0
+            and report["parity"]["checked"] == sum(report["lanes"].values())
+            and report["parity"]["mismatches"] == 0)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,173 @@
+"""CI smoke for simonsweep (the batched scenario-sweep engine).
+
+Asserts, on a small all-family sweep:
+  1. batched==serial parity on EVERY lane (the runner's full-parity mode —
+     a census mismatch raises and fails the smoke);
+  2. seeded determinism: two runs of the same spec+seed produce
+     byte-identical report JSON, and a different seed changes the
+     Monte-Carlo draws;
+  3. report schema: required keys, fraction bounds, lane accounting;
+  4. counters: simon_sweep_* reconcile exactly with the report;
+  5. the CLI end to end: `simon sweep examples/sweeps/zone-outage.yaml`
+     exits 0 and reproduces the committed expected-report snippet.
+
+Run: JAX_PLATFORMS=cpu python tools/sweep_smoke.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SPEC = {
+    "kind": "SweepSpec",
+    "metadata": {"name": "smoke"},
+    "spec": {
+        "seed": 9,
+        "base": {"synthetic": {"nodes": 15, "zones": 3, "cpu": "8",
+                               "memory": "16Gi", "bound": 10,
+                               "boundCpu": "1", "boundMemory": "1Gi"}},
+        "workload": [
+            {"name": "web", "replicas": 48, "cpu": "1250m",
+             "memory": "1Gi"},
+            {"name": "pair", "replicas": 6, "cpu": "250m",
+             "memory": "256Mi", "affinityOn": "pair"},
+        ],
+        "families": [
+            {"kind": "zone_outage", "zones": "all"},
+            {"kind": "node_drain", "counts": [2], "draws": 2},
+            {"kind": "preemption_storm", "storms": [12, 30], "cpu": "2",
+             "memory": "2Gi"},
+            {"kind": "rollout_wave", "workload": "web", "steps": [50, 100],
+             "cpu": "1500m", "memory": "1536Mi"},
+            {"kind": "nodepool_mix", "counts": [2, 4], "cpu": "16",
+             "memory": "32Gi"},
+            {"kind": "monte_carlo", "draws": 3, "templates": [
+                {"name": "mc-a", "replicas": [10, 50], "cpu": "750m",
+                 "memory": "768Mi"}]},
+        ],
+    },
+}
+
+
+def fail(msg: str) -> None:
+    print(f"SWEEP SMOKE FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def run_once(seed=None):
+    from open_simulator_tpu.sweep import (
+        SweepRunner, build_report, parse_spec, report_json)
+
+    runner = SweepRunner(parse_spec(SPEC), seed=seed, parity="full",
+                         fanout=8)
+    runner.run()
+    report = build_report(runner)
+    return report, report_json(report)
+
+
+def check_schema(report: dict) -> None:
+    for key in ("kind", "schema", "name", "seed", "spec_digest", "base",
+                "lanes", "dispatches", "parity", "scenarios", "families"):
+        if key not in report:
+            fail(f"report missing key {key!r}")
+    n = len(report["scenarios"])
+    if sum(report["lanes"].values()) != n:
+        fail(f"lane counts {report['lanes']} do not sum to {n} scenarios")
+    for row in report["scenarios"]:
+        if not (0.0 <= row["fraction"] <= 1.0):
+            fail(f"scenario {row['id']} fraction out of bounds: {row}")
+        if row["scheduled"] + row["unscheduled"] != row["pods"]:
+            fail(f"scenario {row['id']} pod accounting broken: {row}")
+    fams = {f["kind"] for f in SPEC["spec"]["families"]} | {"baseline"}
+    if set(report["families"]) != fams:
+        fail(f"family summaries {set(report['families'])} != {fams}")
+    storms = report["families"]["preemption_storm"]
+    if "victims" not in storms or storms["victims"]["max"] < 1:
+        fail(f"storm victims missing/empty on a capacity-bound cluster: "
+             f"{storms}")
+    env = report["families"]["nodepool_mix"].get("capacity_envelope", [])
+    if [e["pool"] for e in env] != [2, 4]:
+        fail(f"capacity envelope malformed: {env}")
+
+
+def check_counters(report: dict) -> None:
+    from open_simulator_tpu.obs import REGISTRY
+
+    vals = REGISTRY.values()
+
+    def total(prefix: str) -> float:
+        return sum(v for k, v in vals.items() if k.startswith(prefix))
+
+    n = len(report["scenarios"]) * 2  # two full runs before this check
+    if total("simon_sweep_scenarios_total") != n:
+        fail(f"simon_sweep_scenarios_total {total('simon_sweep_scenarios_total')} != {n}")
+    want_dispatch = sum(report["dispatches"].values()) * 2
+    if total("simon_sweep_dispatches_total") != want_dispatch:
+        fail(f"simon_sweep_dispatches_total != {want_dispatch}")
+    checked = report["parity"]["checked"] * 2
+    if vals.get("simon_sweep_parity_checks_total") != checked:
+        fail(f"simon_sweep_parity_checks_total != {checked}")
+    if vals.get("simon_sweep_parity_mismatches_total"):
+        fail("parity mismatch counter moved")
+
+
+def check_cli() -> None:
+    spec = os.path.join(REPO, "examples", "sweeps", "zone-outage.yaml")
+    expected_path = os.path.join(REPO, "examples", "sweeps",
+                                 "zone-outage.expected.json")
+    out = os.path.join(tempfile.mkdtemp(prefix="sweep-smoke-"),
+                       "report.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "open_simulator_tpu.cli", "sweep", spec,
+         "--out", out], env=env, capture_output=True, text=True,
+        timeout=240, cwd=REPO)
+    if proc.returncode != 0:
+        fail(f"CLI sweep exited {proc.returncode}: {proc.stderr[-500:]}")
+    with open(out) as fh:
+        report = json.load(fh)
+    with open(expected_path) as fh:
+        expected = json.load(fh)
+    for key in ("name", "seed", "spec_digest", "lanes", "families"):
+        if report[key] != expected[key]:
+            fail(f"CLI report {key} diverged from the committed snippet:\n"
+                 f"  got  {report[key]}\n  want {expected[key]}")
+    got_rows = [{k: r[k] for k in ("id", "label", "route", "pods",
+                                   "scheduled", "fraction", "nodes")}
+                for r in report["scenarios"]]
+    if got_rows != expected["scenarios"]:
+        fail("CLI per-scenario rows diverged from the committed snippet")
+
+
+def main() -> None:
+    report1, json1 = run_once()
+    check_schema(report1)
+    if report1["parity"]["checked"] != sum(
+            report1["lanes"].get(r, 0) for r in ("wave", "scan")):
+        fail(f"full parity did not cover every batched lane: "
+             f"{report1['parity']} vs {report1['lanes']}")
+    _, json2 = run_once()
+    if json1 != json2:
+        fail("same seed produced different report JSON (determinism broken)")
+    check_counters(report1)
+    report3, _ = run_once(seed=1234)
+    mc1 = [r["pods"] for r in report1["scenarios"]
+           if r["family"] == "monte_carlo"]
+    mc3 = [r["pods"] for r in report3["scenarios"]
+           if r["family"] == "monte_carlo"]
+    if mc1 == mc3:
+        fail(f"--seed did not change the Monte-Carlo draws: {mc1}")
+    check_cli()
+    print(f"sweep smoke ok: {len(report1['scenarios'])} scenarios, "
+          f"lanes {report1['lanes']}, dispatches {report1['dispatches']}, "
+          f"{report1['parity']['checked']} parity lanes, byte-identical "
+          f"re-run, seeded MC divergence, CLI snippet match")
+
+
+if __name__ == "__main__":
+    main()
