@@ -882,6 +882,83 @@ def rule_config_scope_across_thread(ctx: ModuleContext) -> List[Finding]:
     return out
 
 
+# ------------------------------------------------------- span-outside-guard --
+
+# Span-like context managers whose wall-time measurement is the concern:
+# utils/trace.Span and the simonscope live-span context managers.
+_SPAN_ATTRS = {"span", "request_span"}
+
+
+def _is_span_ctx(ctx: ModuleContext, expr: ast.expr) -> Optional[str]:
+    """The span-context name when `expr` (a with-item context expression)
+    opens a tracing span: utils/trace Span(...) via any import form, or a
+    scope span method (`sc.span(...)` / `sc.request_span(...)`)."""
+    if not isinstance(expr, ast.Call):
+        return None
+    r = ctx.resolve(expr.func)
+    if r is not None and (r == "Span" or r.endswith(".Span")):
+        return r
+    f = expr.func
+    if isinstance(f, ast.Attribute) and f.attr in _SPAN_ATTRS:
+        return f".{f.attr}(...)"
+    return None
+
+
+@register(
+    "span-outside-guard", Severity.WARNING,
+    "A tracing Span (utils/trace.Span or a simonscope span) is opened around "
+    "a kernel dispatch site that is not inside guard.supervised. The span "
+    "then measures wall time the watchdog can abandon: on a wedged backend "
+    "the unsupervised dispatch blocks forever INSIDE the span, so the trace "
+    "never records the phase at all (and the process hangs with it). Wrap "
+    "the dispatch in guard.supervised — the span may stay around the "
+    "supervised call — or whitelist deliberate offline/harness timing with "
+    "`# simonlint: ignore[span-outside-guard] -- <why>`.",
+)
+def rule_span_outside_guard(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    guarded = _supervised_functions(ctx)
+
+    def covered(call: ast.Call) -> bool:
+        cur: Optional[ast.AST] = call
+        while cur is not None:
+            if cur in guarded:
+                return True
+            if isinstance(cur, ast.Call):
+                r = ctx.resolve(cur.func) or ""
+                if r == "supervised" or r.endswith(".supervised"):
+                    return True
+            cur = ctx.parents.get(cur)
+        return False
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        span_name = None
+        for item in node.items:
+            span_name = _is_span_ctx(ctx, item.context_expr)
+            if span_name is not None:
+                break
+        if span_name is None:
+            continue
+        for sub in _walk_no_defs(node.body):
+            if not isinstance(sub, ast.Call):
+                continue
+            kernel = _is_kernel_dispatch(ctx, sub)
+            if kernel is None or covered(sub):
+                continue
+            out.append(Finding(
+                "span-outside-guard", Severity.WARNING, ctx.path,
+                sub.lineno, sub.col_offset,
+                f"kernels.{kernel}(...) dispatched inside `with "
+                f"{span_name}` but outside guard.supervised — the span "
+                f"records wall time the watchdog can abandon (a wedge "
+                f"hangs inside the span and the phase is never traced); "
+                f"supervise the dispatch",
+            ))
+    return out
+
+
 # ---------------------------------------------------------- suppression-reason --
 
 

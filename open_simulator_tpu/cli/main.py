@@ -232,6 +232,36 @@ def build_parser() -> argparse.ArgumentParser:
         "--xray", action="store_true",
         help="record per-request decision records; /v1/whatif responses "
              "then ride the flight recorder (GET /explain, /debug/vars)")
+    p_serve.add_argument(
+        "--no-scope", action="store_true",
+        help="disable simonscope (request tracing + SLO engine + runtime "
+             "telemetry sampler) — it is ON by default in serve mode; "
+             "tracing-off serving reproduces bit-identical placements and "
+             "byte-identical metrics")
+
+    p_slo = sub.add_parser(
+        "slo", help="Render a running serve instance's SLO snapshot "
+                    "(simonscope): per-endpoint rps, queue/dispatch/fetch/"
+                    "total latency quantiles over the rolling window, SLO "
+                    "targets and error-budget burn")
+    p_slo.add_argument("--url", default="http://127.0.0.1:8080",
+                       help="server base URL (default http://127.0.0.1:8080)")
+    p_slo.add_argument("--json", action="store_true",
+                       help="emit the raw /v1/serve/stats payload as JSON")
+
+    p_top = sub.add_parser(
+        "top", help="Refreshing terminal view of a running serve instance "
+                    "(simonscope): rps, latency decomposition, lane "
+                    "coalescing, route mix, device pool footprint")
+    p_top.add_argument("--url", default="http://127.0.0.1:8080",
+                       help="server base URL (default http://127.0.0.1:8080)")
+    p_top.add_argument("--interval", type=float, default=2.0, metavar="S",
+                       help="refresh period in seconds (default 2)")
+    p_top.add_argument("--count", type=int, default=0, metavar="N",
+                       help="exit after N refreshes (0 = until interrupted)")
+    p_top.add_argument("--no-clear", action="store_true",
+                       help="append frames instead of clearing the screen "
+                            "(logs / CI)")
 
     p_sweep = sub.add_parser(
         "sweep", help="Run a batched scenario sweep (simonsweep): N "
@@ -312,14 +342,21 @@ def cmd_apply(args) -> int:
             from ..utils.trace import start_collection
 
             start_collection()
-        try:
-            if args.profile:
-                import jax
+        # simonscope CLI edge (OPEN_SIMULATOR_SCOPE=1): the apply run gets
+        # one trace id, so engine schedule/probe spans group per run;
+        # OPEN_SIMULATOR_SCOPE_OUT dumps the perfetto file afterwards
+        # (failed runs included — scope.cli_edge owns the lifecycle)
+        from ..obs import scope as scope_mod
 
-                with jax.profiler.trace(args.profile):
+        try:
+            with scope_mod.cli_edge("cli:apply", config=args.simon_config):
+                if args.profile:
+                    import jax
+
+                    with jax.profiler.trace(args.profile):
+                        result = applier.run()
+                else:
                     result = applier.run()
-            else:
-                result = applier.run()
         finally:
             # dumps are written on FAILED runs too — a raising run records
             # failed=True spans, which is exactly when the trace matters —
@@ -440,12 +477,19 @@ def cmd_serve(args) -> int:
         rt = ResourceTypes(nodes=[synth_node(i) for i in range(n)])
         snapshot_fn = lambda: ClusterSnapshot(rt, [], [], [])  # noqa: E731
     try:
+        # simonscope is serve mode's default observability posture
+        # (request tracing + SLO engine + runtime sampler); --no-scope /
+        # OPEN_SIMULATOR_SCOPE=0 opts out
+        from ..obs import scope as scope_mod
+
+        scope_on = (False if getattr(args, "no_scope", False)
+                    else scope_mod.env_enabled(default=True))
         server = Server(kubeconfig=args.kubeconfig, master=args.master,
                         snapshot_fn=snapshot_fn,
                         debug_faults=True if args.debug_faults else None,
                         xray=True if getattr(args, "xray", False) else None,
                         whatif=True, whatif_window_ms=args.window_ms,
-                        whatif_fanout=args.fanout)
+                        whatif_fanout=args.fanout, scope=scope_on)
         if args.grpc_port:
             from ..server.grpcbridge import GrpcBridge
 
@@ -488,9 +532,16 @@ def cmd_sweep(args) -> int:
     runner = SweepRunner(spec, seed=args.seed, parity=args.parity,
                          parity_sample=args.parity_sample,
                          fanout=args.fanout)
+    # simonscope CLI edge (OPEN_SIMULATOR_SCOPE=1): the whole sweep becomes
+    # one trace — chunk dispatch spans (sweep/runner.py) and engine probe
+    # spans share the run's trace id; OPEN_SIMULATOR_SCOPE_OUT dumps the
+    # perfetto file on exit, parity failures included (scope.cli_edge)
+    from ..obs import scope as scope_mod
+
     t0 = time.perf_counter()
     try:
-        runner.run()
+        with scope_mod.cli_edge("cli:sweep", spec=args.spec):
+            runner.run()
     except SweepParityError as e:
         print(f"sweep PARITY FAILURE: {e}", file=sys.stderr)
         return 1
@@ -544,6 +595,13 @@ _BAD_WHEN_UP = (
     "simon_guard_failovers_total",
     "simon_preemption_replay_pods_total",
     "simon_xray_dropped_total",
+    # serving/scope rework-and-loss families (PR 14): stale sessions are
+    # transparent re-encodes (rework), parity mismatches are correctness
+    # failures, dropped trace events / sampler errors are observability loss
+    "simon_serve_stale_sessions_total",
+    "simon_sweep_parity_mismatches_total",
+    "simon_scope_trace_dropped_total",
+    "simon_scope_sampler_errors_total",
 )
 
 
@@ -651,6 +709,115 @@ def cmd_explain(args) -> int:
     return 0
 
 
+def _fetch_serve_stats(url: str) -> dict:
+    """GET {url}/v1/serve/stats (the one snapshot `simon slo` and
+    `simon top` are both built on)."""
+    import urllib.error
+    import urllib.request
+
+    target = url.rstrip("/") + "/v1/serve/stats"
+    try:
+        with urllib.request.urlopen(target, timeout=10) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        body = e.read().decode(errors="replace")
+        raise RuntimeError(f"{target} -> HTTP {e.code}: {body}") from e
+    except (urllib.error.URLError, OSError) as e:
+        raise RuntimeError(f"{target}: {e}") from e
+
+
+def _render_slo(stats: dict) -> str:
+    """The `simon slo` table: per endpoint, windowed rps + phase quantiles +
+    SLO budget accounting, from one /v1/serve/stats snapshot."""
+    slo = stats.get("slo")
+    if not slo:
+        return ("no SLO data: simonscope is off on this server "
+                "(start with `simon serve`, without --no-scope)")
+    lines = [f"window: {slo.get('window_s', 0):g}s   epoch: "
+             f"{stats.get('epoch', '?')}   nodes: {stats.get('nodes', '?')}"
+             f"   queued: {stats.get('queued', 0)}"]
+    for ep, d in sorted(slo.get("endpoints", {}).items()):
+        routes = ", ".join(f"{r}={n}" for r, n in sorted(
+            d.get("routes", {}).items()))
+        lines.append(f"\n{ep}  ({d.get('rps', 0):g} rps; {routes})")
+        lines.append(f"  {'phase':<10}{'count':>7}{'mean':>9}{'p50':>9}"
+                     f"{'p95':>9}{'p99':>9}  (ms)")
+        for phase in ("queue", "dispatch", "fetch", "total"):
+            q = d.get("phases", {}).get(phase)
+            if q is None:
+                continue
+            lines.append(
+                f"  {phase:<10}{q['count']:>7}{q['mean_ms']:>9.2f}"
+                f"{q['p50_ms']:>9.2f}{q['p95_ms']:>9.2f}{q['p99_ms']:>9.2f}")
+        s = d.get("slo")
+        if s:
+            # availability-only targets leave target_p99_ms None (the
+            # latency check then defaults to +inf in the engine)
+            p99t = s.get("target_p99_ms")
+            lines.append(
+                f"  SLO: p99 target "
+                f"{'—' if p99t is None else f'{p99t:g}ms'}, availability "
+                f"{s['availability_target']:g} — {s['violations']}/"
+                f"{s['requests']} violations, budget burn "
+                f"{s['budget_burn']:g}x"
+                + (" [BURNING]" if s["budget_burn"] > 1.0 else ""))
+    sc = stats.get("scope") or {}
+    pools = sc.get("pools") or {}
+    if pools:
+        lines.append("\ndevice pools: " + "  ".join(
+            f"{k}={v / 1e6:.2f}MB" for k, v in sorted(pools.items())))
+    if sc:
+        lines.append(f"trace: {sc.get('trace_events', 0)} events buffered"
+                     f" (cap {sc.get('trace_cap', 0)}); sampler "
+                     f"{'on' if sc.get('sampler') else 'off'}")
+    return "\n".join(lines)
+
+
+def cmd_slo(args) -> int:
+    """`simon slo`: one SLO snapshot from a running serve instance."""
+    try:
+        stats = _fetch_serve_stats(args.url)
+    except RuntimeError as e:
+        print(f"slo error: {e}", file=sys.stderr)
+        return 1
+    try:
+        if args.json:
+            print(json.dumps(stats, indent=1, sort_keys=True))
+        else:
+            print(_render_slo(stats))
+    except BrokenPipeError:
+        return 0  # `simon slo | head` closing the pipe early is fine
+    return 0
+
+
+def cmd_top(args) -> int:
+    """`simon top`: the refreshing terminal view over the same snapshots
+    `simon slo` renders once."""
+    import time as _time
+
+    n = 0
+    try:
+        while True:
+            try:
+                stats = _fetch_serve_stats(args.url)
+                frame = _render_slo(stats)
+            except RuntimeError as e:
+                frame = f"top: {e}"
+            if not args.no_clear and sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            print(f"simon top — {args.url}  "
+                  f"(refresh {args.interval:g}s; ctrl-c to exit)")
+            print(frame, flush=True)
+            n += 1
+            if args.count and n >= args.count:
+                return 0
+            _time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        return 0
+    except BrokenPipeError:
+        return 0  # `simon top | head` closing the pipe early is fine
+
+
 def cmd_version(_args) -> int:
     print(f"Version: {__version__}")
     print(f"Commit: {COMMIT_ID}")
@@ -704,7 +871,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         "metrics": cmd_metrics,
         "serve": cmd_serve,
         "server": cmd_server,
+        "slo": cmd_slo,
         "sweep": cmd_sweep,
+        "top": cmd_top,
         "version": cmd_version,
         "gen-doc": cmd_gen_doc,
         "parity": cmd_parity,

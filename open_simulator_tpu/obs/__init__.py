@@ -15,6 +15,14 @@
   explanations, queryable via `simon explain`, `GET /explain/<pod>`, and
   the Chrome trace. Imported lazily by consumers (not re-exported here) so
   the metrics registry stays import-light.
+- `obs.scope` — simonscope, serving-grade observability (on by default
+  under `simon serve`, off elsewhere): end-to-end request tracing with
+  cross-thread flow stitching, the rolling-window SLO engine
+  (queue/dispatch/fetch/total decomposition, error-budget burn), and the
+  device-runtime telemetry sampler (pool-attributed buffer bytes,
+  compile-cache deltas, transfer rate). Surfaced on `simon slo`,
+  `simon top`, `GET /v1/serve/stats`, and `GET /v1/serve/trace`. Imported
+  lazily by consumers for the same reason as xray.
 
 Instrumentation lives on the HOST side of the device boundary by contract:
 the `metric-in-jit` simonlint rule rejects registry mutations or wall-clock

@@ -295,6 +295,80 @@ XRAY_DROPPED = counter(
     "drop logs a warning.",
     ("kind",))
 
+# ------------------------------------------------------------------- scope ----
+# simonscope (obs/scope.py): request tracing + SLO engine + device-runtime
+# telemetry. Every family here is LABELED on purpose (the xray contract): an
+# untouched labeled family renders no samples, so a scope-off run's /metrics
+# and --metrics-out output stays byte-identical to pre-scope builds.
+
+SCOPE_REQUESTS = counter(
+    "simon_scope_requests_total",
+    "Requests finished under simonscope SLO accounting, by endpoint and "
+    "route (batched / fresh / error). Zero unless scope is on "
+    "(`simon serve`'s default; OPEN_SIMULATOR_SCOPE=1 elsewhere).",
+    ("endpoint", "route"))
+SCOPE_PHASE_SECONDS = histogram(
+    "simon_scope_request_phase_seconds",
+    "Cumulative per-request latency decomposition (queue-wait in the "
+    "micro-batch dispatcher / kernel dispatch / device fetch / total), by "
+    "endpoint and phase — the Clipper-style breakdown that makes the "
+    "batching window tunable. The rolling-window quantiles live in "
+    "simon_scope_latency_ms.",
+    ("endpoint", "phase"), buckets=SECONDS_BUCKETS)
+SCOPE_SLO_VIOLATIONS = counter(
+    "simon_scope_slo_violations_total",
+    "Requests that violated their endpoint's SLO target (latency over the "
+    "p99 target, or an error response), by endpoint.",
+    ("endpoint",))
+SCOPE_QUANTILE_MS = gauge(
+    "simon_scope_latency_ms",
+    "Rolling-window latency quantiles per endpoint and phase "
+    "(refreshed on each scoped /metrics or /v1/serve/stats read).",
+    ("endpoint", "phase", "quantile"))
+SCOPE_BUDGET_BURN = gauge(
+    "simon_scope_error_budget_burn",
+    "Error-budget burn rate per endpoint: (bad-request fraction) / "
+    "(allowed fraction from the availability target); >1 means the budget "
+    "is burning faster than the SLO allows.",
+    ("endpoint",))
+SCOPE_TRACE_EVENTS = counter(
+    "simon_scope_trace_events_total",
+    "Trace events recorded into the in-memory buffer, by kind "
+    "(span / flow / counter).",
+    ("kind",))
+SCOPE_TRACE_DROPPED = counter(
+    "simon_scope_trace_dropped_total",
+    "Trace events dropped because the bounded buffer was full, by kind. "
+    "Never silent: a full buffer drops NEW events and counts every one.",
+    ("kind",))
+SCOPE_POOL_BYTES = gauge(
+    "simon_scope_device_pool_bytes",
+    "Live device-buffer bytes attributed to a pool by the runtime sampler "
+    "(image_tables / carry_cache / scratch) — the Orca-style resident-state "
+    "footprint track that makes image leaks under churn visible.",
+    ("pool",))
+SCOPE_COMPILE_DELTA = gauge(
+    "simon_scope_compile_cache_delta",
+    "Compile-cache hit/miss deltas over the sampler's last interval, by "
+    "kind; a nonzero 'misses' track during steady serving means requests "
+    "are minting fresh shape buckets.",
+    ("kind",))
+SCOPE_TRANSFER_RATE = gauge(
+    "simon_scope_transfer_bytes_per_s",
+    "Host->device transfer rate over the sampler's last interval, by "
+    "direction (steady serving on a warm image should hold this at ~0).",
+    ("direction",))
+SCOPE_SAMPLES = counter(
+    "simon_scope_runtime_samples_total",
+    "Telemetry ticks completed by the device-runtime sampler thread, by "
+    "kind.",
+    ("kind",))
+SCOPE_SAMPLER_ERRORS = counter(
+    "simon_scope_sampler_errors_total",
+    "Telemetry tick failures (a pool provider raised, live-array walk "
+    "failed). The sampler keeps running; failures are counted, not silent.",
+    ("kind",))
+
 # ---------------------------------------------------------- capacity search ---
 
 CAPACITY_SEARCHES = counter(

@@ -156,47 +156,52 @@ class MetricFamily:
     # ------------------------------------------------------------ rendering --
 
     def samples(self) -> List[dict]:
-        """JSON-able per-child samples (snapshot form)."""
-        out: List[dict] = []
+        """JSON-able per-child samples (snapshot form). Child state is COPIED
+        under the family lock, so every row is internally consistent even
+        while 16 serve threads update it — a torn histogram (counts bumped,
+        sum not yet) can never escape into a scrape (the /metrics +
+        /debug/pprof concurrent-scrape fix; tests/test_scope.py hammers it)."""
         with self._lock:
             items = sorted(self._children.items())
-        for key, child in items:
-            labels = dict(zip(self.label_names, key))
             if self.type == "histogram":
-                out.append({
-                    "labels": labels,
-                    "buckets": [[b, c] for b, c in
-                                zip(list(self.buckets) + ["+Inf"],
-                                    child._counts)],
-                    "sum": child._sum,
-                    "count": child._count,
-                })
+                rows = [(key, list(child._counts), child._sum, child._count)
+                        for key, child in items]
             else:
-                out.append({"labels": labels, "value": child._value})
+                rows = [(key, child._value) for key, child in items]
+        out: List[dict] = []
+        if self.type == "histogram":
+            for key, counts, hsum, count in rows:
+                out.append({
+                    "labels": dict(zip(self.label_names, key)),
+                    "buckets": [[b, c] for b, c in
+                                zip(list(self.buckets) + ["+Inf"], counts)],
+                    "sum": hsum,
+                    "count": count,
+                })
+        else:
+            for key, value in rows:
+                out.append({"labels": dict(zip(self.label_names, key)),
+                            "value": value})
         return out
 
     def render(self) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.type}"]
-        with self._lock:
-            items = sorted(self._children.items())
-        for key, child in items:
+        for s in self.samples():  # one locked copy; render from the snapshot
+            key = tuple(str(s["labels"][n]) for n in self.label_names)
             if self.type == "histogram":
                 cum = 0
-                for b, c in zip(self.buckets, child._counts):
+                for b, c in s["buckets"]:
                     cum += c
-                    ls = _label_str(self.label_names + ("le",),
-                                    key + (_fmt(b),))
+                    le = "+Inf" if b == "+Inf" else _fmt(float(b))
+                    ls = _label_str(self.label_names + ("le",), key + (le,))
                     lines.append(f"{self.name}_bucket{ls} {cum}")
-                cum += child._counts[-1]
-                ls = _label_str(self.label_names + ("le",), key + ("+Inf",))
-                lines.append(f"{self.name}_bucket{ls} {cum}")
                 base = _label_str(self.label_names, key)
-                lines.append(f"{self.name}_sum{base} {_fmt(child._sum)}")
-                lines.append(f"{self.name}_count{base} {child._count}")
+                lines.append(f"{self.name}_sum{base} {_fmt(s['sum'])}")
+                lines.append(f"{self.name}_count{base} {s['count']}")
             else:
                 ls = _label_str(self.label_names, key)
-                lines.append(f"{self.name}{ls} {_fmt(child._value)}")
+                lines.append(f"{self.name}{ls} {_fmt(s['value'])}")
         return lines
 
 
@@ -240,13 +245,12 @@ class Registry:
     # ------------------------------------------------------------- exports ---
 
     def render_text(self) -> str:
-        """Prometheus exposition format (text/plain; version=0.0.4)."""
-        with self._lock:
-            fams = [self._families[n] for n in sorted(self._families)]
-        lines: List[str] = []
-        for fam in fams:
-            lines.extend(fam.render())
-        return "\n".join(lines) + ("\n" if lines else "")
+        """Prometheus exposition format (text/plain; version=0.0.4). Built
+        from ONE snapshot() pass so a scrape racing concurrent updates sees
+        every family at a single locked copy (no partially-applied rows) —
+        and /metrics, /debug/vars, and --metrics-out all flatten the same
+        snapshot shape."""
+        return render_text_from_snapshot(self.snapshot())
 
     def snapshot(self) -> dict:
         """JSON-able full dump: {name: {type, help, labels, samples}}."""

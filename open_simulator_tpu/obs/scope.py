@@ -1,0 +1,745 @@
+"""simonscope: serving-grade request tracing, SLO engine, and device telemetry.
+
+PRs 10-11 turned the simulator into a serving system; this module gives that
+system the three observability layers a serving stack needs (Clipper's
+queue/batch/execute latency decomposition, Orca's resident-state footprint
+tracking — PAPERS.md):
+
+- **End-to-end request tracing.** A trace ID is minted at the edge (HTTP
+  handler, gRPC bridge, CLI) and carried by contextvar through the
+  micro-batch dispatcher's worker threads into kernel dispatch, fetch, and
+  reply. Spans record into a bounded in-memory buffer in Chrome trace-event
+  form; cross-thread hops (request -> coalesced micro-batch) are stitched
+  with flow events, so one perfetto-loadable trace shows request ->
+  queue-wait -> micro-batch -> serve_wave_fanout dispatch -> fetch -> demux
+  -> reply, including failover replays and fresh-path detours under the SAME
+  trace ID as the batched attempt they replaced.
+- **Rolling-window SLO engine.** Sliding-window latency histograms per
+  endpoint with the queue/dispatch/fetch/total phase decomposition,
+  p50/p95/p99 gauges, configurable SLO targets, and error-budget burn
+  tracking — surfaced on GET /v1/serve/stats, /metrics, `simon slo`, and
+  `simon top`.
+- **Device-runtime telemetry sampler.** A low-overhead background thread
+  sampling live device-buffer bytes attributed to pools (image tables /
+  carry cache / scratch), compile-cache hit/miss deltas, and host->device
+  transfer bytes/s — emitted as gauges and as trace counter tracks, so a
+  resident-image footprint leak under churn is a visible ramp instead of a
+  latent OOM.
+
+Zero-cost contract (the same one simonxray proved): recording is OPT-IN
+(`simon serve` on by default, `--no-scope` / OPEN_SIMULATOR_SCOPE=0 off;
+everything else off by default) and every instrumentation site is one
+`scope.active()` None-check (or one contextvar read) when off. All scope
+metric families are LABELED, so an untouched family renders no samples and
+scope-off /metrics output stays byte-identical to pre-scope builds;
+placements are untouched either way — tracing is passive.
+
+Everything here is host-side and jax-free at import; the single JAX
+touchpoint (live-buffer accounting in the sampler) only runs when jax is
+ALREADY imported by the engine.
+"""
+
+from __future__ import annotations
+
+import bisect
+import contextlib
+import contextvars
+import itertools
+import json
+import math
+import os
+import sys
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+from . import instruments as obs
+
+# Phase names of the request decomposition, in pipeline order. `total` is
+# always recorded; the serve path adds the queue/dispatch/fetch breakdown.
+PHASES = ("queue", "dispatch", "fetch", "total")
+
+# Rolling-window histogram bucket bounds in SECONDS: geometric 0.25ms..16s,
+# fine enough for p99 interpolation at serving latencies (tens of ms).
+_WINDOW_BOUNDS = tuple(0.00025 * (2.0 ** i) for i in range(17))
+
+DEFAULT_WINDOW_S = 60.0
+DEFAULT_SLICES = 12
+DEFAULT_TRACE_CAP = 200_000
+
+# Default SLO targets per endpoint (ROADMAP item 3: p99 < 50ms at >= 1k
+# req/s; availability leaves a 0.1% error budget). Override per process via
+# OPEN_SIMULATOR_SLO_JSON='{"whatif": {"p99_ms": 25, "availability": 0.99}}'
+# or programmatically through enable(slo_targets=...).
+DEFAULT_SLO_TARGETS: Dict[str, Dict[str, float]] = {
+    "whatif": {"p99_ms": 50.0, "availability": 0.999},
+}
+
+
+# ------------------------------------------------------------ trace context ---
+
+class TraceCtx:
+    """One request's identity as it crosses threads: the trace id plus the
+    endpoint the edge minted it for. Immutable — hand the object itself to
+    another thread (the dispatcher does) and bind it there with use_ctx."""
+
+    __slots__ = ("trace_id", "endpoint")
+
+    def __init__(self, trace_id: int, endpoint: str) -> None:
+        self.trace_id = trace_id
+        self.endpoint = endpoint
+
+
+_CTX: contextvars.ContextVar[Optional[TraceCtx]] = contextvars.ContextVar(
+    "simon_scope_ctx", default=None)
+
+# Phase-mark sink: a plain dict shared with whatever worker thread the guard
+# watchdog runs the dispatch on (contextvars.copy_context() carries the
+# REFERENCE, so marks made in the worker land in the caller's dict).
+_PHASES_SINK: contextvars.ContextVar[Optional[dict]] = contextvars.ContextVar(
+    "simon_scope_phases", default=None)
+
+
+def mark(name: str) -> None:
+    """Record one phase boundary (perf_counter seconds) into the collecting
+    caller's sink, if any. One contextvar read when no collection is active —
+    cheap enough for kernel dispatch sites. `*_begin` marks keep their FIRST
+    value, everything else its last: a micro-batch that dispatches both a
+    wave lane and a serial lane spans from the first kernel_begin to the
+    last fetch_end."""
+    sink = _PHASES_SINK.get()
+    if sink is not None:
+        if name.endswith("_begin"):
+            sink.setdefault(name, time.perf_counter())
+        else:
+            sink[name] = time.perf_counter()
+
+
+@contextlib.contextmanager
+def collect_phases(sink: dict):
+    """Collect mark() calls from this context (and any guard.supervised
+    worker it spawns) into `sink`."""
+    token = _PHASES_SINK.set(sink)
+    try:
+        yield sink
+    finally:
+        _PHASES_SINK.reset(token)
+
+
+def current_ctx() -> Optional[TraceCtx]:
+    return _CTX.get()
+
+
+# --------------------------------------------------------------- SLO engine ---
+
+class _WindowHist:
+    """One (endpoint, phase) sliding-window histogram: a ring of time slices,
+    each a fixed-bound bucket-count array + sum + count. Old slices expire as
+    the window slides; quantiles interpolate over the merged live slices."""
+
+    __slots__ = ("slices", "slice_s", "n_slices")
+
+    def __init__(self, window_s: float, n_slices: int) -> None:
+        self.n_slices = max(2, int(n_slices))
+        self.slice_s = float(window_s) / self.n_slices
+        # [(slice_index, counts, sum, count)]
+        self.slices: List[list] = []
+
+    def _slice_for(self, now: float) -> list:
+        si = int(now / self.slice_s)
+        if self.slices and self.slices[-1][0] == si:
+            return self.slices[-1]
+        sl = [si, [0] * (len(_WINDOW_BOUNDS) + 1), 0.0, 0]
+        self.slices.append(sl)
+        live = si - self.n_slices
+        while self.slices and self.slices[0][0] <= live:
+            self.slices.pop(0)
+        return sl
+
+    def record(self, v_s: float, now: float) -> None:
+        sl = self._slice_for(now)
+        sl[1][bisect.bisect_left(_WINDOW_BOUNDS, v_s)] += 1
+        sl[2] += v_s
+        sl[3] += 1
+
+    def merged(self, now: float) -> Tuple[List[int], float, int]:
+        live = int(now / self.slice_s) - self.n_slices
+        counts = [0] * (len(_WINDOW_BOUNDS) + 1)
+        total = 0.0
+        n = 0
+        for si, c, s, k in self.slices:
+            if si <= live:
+                continue
+            for i, v in enumerate(c):
+                counts[i] += v
+            total += s
+            n += k
+        return counts, total, n
+
+    @staticmethod
+    def quantile(counts: List[int], n: int, q: float) -> float:
+        """Seconds at quantile q, linearly interpolated within the bucket
+        (kube-scheduler histogram_quantile practice)."""
+        if n <= 0:
+            return 0.0
+        rank = q * n
+        cum = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                lo = _WINDOW_BOUNDS[i - 1] if i > 0 else 0.0
+                hi = (_WINDOW_BOUNDS[i] if i < len(_WINDOW_BOUNDS)
+                      else _WINDOW_BOUNDS[-1] * 2)
+                frac = (rank - cum) / c
+                return lo + (hi - lo) * frac
+            cum += c
+        return _WINDOW_BOUNDS[-1] * 2
+
+
+class SLOEngine:
+    """Rolling-window per-endpoint latency/SLO accounting.
+
+    record() is the single write point: it feeds (a) the sliding-window
+    histograms behind the p50/p95/p99 snapshot, (b) the CUMULATIVE labeled
+    Prometheus families (simon_scope_requests_total / _request_phase_seconds
+    / _slo_violations_total), and (c) the error-budget ledger. snapshot()
+    (and refresh_gauges(), which mirrors it into gauges for /metrics) is the
+    single read point."""
+
+    def __init__(self, targets: Optional[Dict[str, Dict[str, float]]] = None,
+                 window_s: float = DEFAULT_WINDOW_S,
+                 n_slices: int = DEFAULT_SLICES) -> None:
+        self.window_s = float(window_s)
+        self.n_slices = int(n_slices)
+        self.targets = dict(DEFAULT_SLO_TARGETS)
+        env = os.environ.get("OPEN_SIMULATOR_SLO_JSON", "")
+        if env:
+            try:
+                for ep, t in (json.loads(env) or {}).items():
+                    self.targets[str(ep)] = {k: float(v) for k, v in t.items()}
+            except (ValueError, TypeError, AttributeError):
+                import logging
+
+                logging.getLogger("open_simulator_tpu").warning(
+                    "OPEN_SIMULATOR_SLO_JSON is not a {endpoint: {p99_ms, "
+                    "availability}} object; using defaults")
+        if targets:
+            self.targets.update(targets)
+        self._lock = threading.Lock()
+        self._hists: Dict[Tuple[str, str], _WindowHist] = {}
+        self._routes: Dict[Tuple[str, str], int] = {}
+        # cumulative error-budget ledger per endpoint: [requests, bad]
+        self._budget: Dict[str, List[int]] = {}
+        # pre-resolved metric children (the instruments contract: resolve
+        # labels once, hold the child — record() sits on the per-request
+        # hot path and a .labels() call re-validates the label set)
+        self._req_children: Dict[Tuple[str, str], object] = {}
+        self._phase_children: Dict[Tuple[str, str], object] = {}
+        self._viol_children: Dict[str, object] = {}
+
+    def record(self, endpoint: str, route: str, phases: Dict[str, float],
+               error: bool = False) -> None:
+        """One finished request: `phases` maps phase name -> seconds and must
+        include 'total'. The exact float recorded here is the one the span
+        exporter carries, so trace and histogram sums reconcile."""
+        now = time.monotonic()
+        total = float(phases.get("total", 0.0))
+        target = self.targets.get(endpoint)
+        bad = bool(error) or (
+            target is not None and total * 1000.0 > target.get(
+                "p99_ms", math.inf))
+        with self._lock:
+            for phase, v in phases.items():
+                key = (endpoint, phase)
+                h = self._hists.get(key)
+                if h is None:
+                    h = self._hists[key] = _WindowHist(
+                        self.window_s, self.n_slices)
+                h.record(float(v), now)
+            rkey = (endpoint, route)
+            self._routes[rkey] = self._routes.get(rkey, 0) + 1
+            ledger = self._budget.setdefault(endpoint, [0, 0])
+            ledger[0] += 1
+            ledger[1] += 1 if bad else 0
+        child = self._req_children.get((endpoint, route))
+        if child is None:
+            child = self._req_children[(endpoint, route)] = (
+                obs.SCOPE_REQUESTS.labels(endpoint=endpoint, route=route))
+        child.inc()
+        for phase, v in phases.items():
+            h = self._phase_children.get((endpoint, phase))
+            if h is None:
+                h = self._phase_children[(endpoint, phase)] = (
+                    obs.SCOPE_PHASE_SECONDS.labels(
+                        endpoint=endpoint, phase=phase))
+            h.observe(float(v))
+        if bad:
+            vc = self._viol_children.get(endpoint)
+            if vc is None:
+                vc = self._viol_children[endpoint] = (
+                    obs.SCOPE_SLO_VIOLATIONS.labels(endpoint=endpoint))
+            vc.inc()
+
+    def snapshot(self) -> dict:
+        """The /v1/serve/stats "slo" section: per endpoint, windowed rps +
+        per-phase quantiles + route mix + SLO target/burn accounting.
+        Window merges run UNDER the engine lock — record() mutates the
+        slice lists in place, and a merge racing it would be exactly the
+        torn-scrape class metrics.py's samples() fix removes."""
+        now = time.monotonic()
+        with self._lock:
+            merged = {key: h.merged(now)
+                      for key, h in sorted(self._hists.items())}
+            routes = dict(self._routes)
+            budget = {k: list(v) for k, v in self._budget.items()}
+        endpoints: Dict[str, dict] = {}
+        for (ep, phase), (counts, total, n) in merged.items():
+            q = _WindowHist.quantile
+            d = endpoints.setdefault(ep, {"phases": {}, "routes": {}})
+            d["phases"][phase] = {
+                "count": n,
+                "sum_s": total,
+                "mean_ms": round(total / n * 1000.0, 3) if n else 0.0,
+                "p50_ms": round(q(counts, n, 0.50) * 1000.0, 3),
+                "p95_ms": round(q(counts, n, 0.95) * 1000.0, 3),
+                "p99_ms": round(q(counts, n, 0.99) * 1000.0, 3),
+            }
+        for (ep, route), n in sorted(routes.items()):
+            endpoints.setdefault(ep, {"phases": {}, "routes": {}})[
+                "routes"][route] = n
+        for ep, d in endpoints.items():
+            tot = d["phases"].get("total", {})
+            d["window_s"] = self.window_s
+            d["rps"] = round(tot.get("count", 0) / self.window_s, 2)
+            target = self.targets.get(ep)
+            ledger = budget.get(ep, [0, 0])
+            if target is not None:
+                allowed = max(1e-9, 1.0 - target.get("availability", 0.999))
+                served, bad = ledger
+                d["slo"] = {
+                    "target_p99_ms": target.get("p99_ms"),
+                    "availability_target": target.get("availability", 0.999),
+                    "requests": served,
+                    "violations": bad,
+                    # >1.0 = burning budget faster than the target allows
+                    "budget_burn": round((bad / served) / allowed, 4)
+                    if served else 0.0,
+                    "budget_remaining_frac": round(
+                        1.0 - (bad / (served * allowed)) if served else 1.0, 4),
+                }
+        return {"window_s": self.window_s, "endpoints": endpoints}
+
+    def refresh_gauges(self) -> None:
+        """Mirror the windowed quantiles/burn into labeled gauges so a
+        /metrics scrape carries them (called from the scrape handler when
+        scope is active — scope-off scrapes never touch these families)."""
+        snap = self.snapshot()
+        for ep, d in snap["endpoints"].items():
+            for phase, q in d["phases"].items():
+                for quant in ("p50", "p95", "p99"):
+                    obs.SCOPE_QUANTILE_MS.labels(
+                        endpoint=ep, phase=phase,
+                        quantile=quant).set(q[f"{quant}_ms"])
+            if "slo" in d:
+                obs.SCOPE_BUDGET_BURN.labels(endpoint=ep).set(
+                    d["slo"]["budget_burn"])
+
+
+# ------------------------------------------------------------ pool registry ---
+
+# Device-buffer pool providers (objects exposing device_pool_bytes() ->
+# {pool: bytes}), registered unconditionally (WeakSet: registration is cheap
+# and leak-free whether or not a scope/sampler ever starts).
+_POOL_PROVIDERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_pools(provider) -> None:
+    """Register a device-buffer owner (e.g. serve.ResidentImage) for the
+    runtime sampler's pool attribution. `provider.device_pool_bytes()` must
+    return {pool_name: bytes} without blocking on device work."""
+    _POOL_PROVIDERS.add(provider)
+
+
+class RuntimeSampler:
+    """The device-runtime telemetry thread: every `interval_s`, sample pool
+    bytes, compile-cache deltas, and transfer rate; emit gauges + trace
+    counter tracks. stop() joins the thread — shutdown leaves no thread
+    behind (tools/scope_smoke.py asserts it)."""
+
+    def __init__(self, scope: "Scope", interval_s: float = 1.0) -> None:
+        self.scope = scope
+        self.interval_s = max(0.05, float(interval_s))
+        self._stop = threading.Event()
+        self._last: Dict[str, float] = {}
+        self._last_t = 0.0
+        self._thread = threading.Thread(
+            target=self._loop, name="simon-scope-sampler", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def _loop(self) -> None:
+        # one immediate sample (tests and short smokes need >=1 tick), then
+        # the steady interval
+        while True:
+            try:
+                self.sample_once()
+            except Exception:
+                obs.SCOPE_SAMPLER_ERRORS.labels(kind="tick").inc()
+            if self._stop.wait(self.interval_s):
+                return
+
+    def _counter_total(self, family) -> float:
+        return sum(s.get("value", 0.0) for s in family.samples())
+
+    def sample_once(self) -> None:
+        """One telemetry tick (public: tests and the smoke drive it
+        synchronously)."""
+        now = time.perf_counter()
+        pools: Dict[str, int] = {}
+        for provider in list(_POOL_PROVIDERS):
+            try:
+                for pool, nbytes in provider.device_pool_bytes().items():
+                    pools[pool] = pools.get(pool, 0) + int(nbytes)
+            except Exception:
+                obs.SCOPE_SAMPLER_ERRORS.labels(kind="tick").inc()
+        # scratch: live device bytes not attributed to a named pool. Only
+        # when the engine already imported jax — the sampler must never be
+        # the thing that initializes a backend.
+        jax = sys.modules.get("jax")
+        if jax is not None and hasattr(jax, "live_arrays"):
+            try:
+                total = sum(int(getattr(a, "nbytes", 0) or 0)
+                            for a in jax.live_arrays())
+                pools["scratch"] = max(0, total - sum(pools.values()))
+            except Exception:
+                obs.SCOPE_SAMPLER_ERRORS.labels(kind="tick").inc()
+        for pool, nbytes in pools.items():
+            obs.SCOPE_POOL_BYTES.labels(pool=pool).set(nbytes)
+
+        hits = self._counter_total(obs.COMPILE_HITS)
+        misses = self._counter_total(obs.COMPILE_MISSES)
+        xfer = obs.TRANSFER_BYTES.samples()
+        xfer_total = xfer[0]["value"] if xfer else 0.0
+        dt = now - self._last_t if self._last_t else 0.0
+        d_hits = hits - self._last.get("hits", hits)
+        d_misses = misses - self._last.get("misses", misses)
+        d_xfer = xfer_total - self._last.get("xfer", xfer_total)
+        rate = d_xfer / dt if dt > 0 else 0.0
+        self._last = {"hits": hits, "misses": misses, "xfer": xfer_total}
+        self._last_t = now
+        obs.SCOPE_COMPILE_DELTA.labels(kind="hits").set(d_hits)
+        obs.SCOPE_COMPILE_DELTA.labels(kind="misses").set(d_misses)
+        obs.SCOPE_TRANSFER_RATE.labels(direction="h2d").set(rate)
+        obs.SCOPE_SAMPLES.labels(kind="tick").inc()
+        sc = self.scope
+        sc.emit_counter("device_pool_bytes", now, pools or {"scratch": 0})
+        sc.emit_counter("compile_cache_delta", now,
+                        {"hits": d_hits, "misses": d_misses})
+        sc.emit_counter("transfer_bytes_per_s", now, {"h2d": round(rate, 1)})
+
+
+# -------------------------------------------------------------------- scope ---
+
+class Scope:
+    """The enabled simonscope instance: trace buffer + SLO engine + optional
+    runtime sampler. One per process (module global, like the xray
+    recorder); hot paths reach it through active()."""
+
+    def __init__(self, slo_targets: Optional[Dict[str, Dict[str, float]]] = None,
+                 trace_cap: int = DEFAULT_TRACE_CAP,
+                 sampler: bool = False,
+                 sampler_interval_s: float = 1.0) -> None:
+        self.slo = SLOEngine(slo_targets)
+        self.trace_cap = int(trace_cap)
+        self._events: List[dict] = []
+        # raw per-request records (endpoint, tm, t_end, total, route):
+        # the request hot path appends ONE tuple; the span tree + flow
+        # events expand lazily in events() — render cost moves off the
+        # serving path (the <=10% overhead gate is won here)
+        self._requests: List[tuple] = []
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        # pre-resolved trace-event counter children (hot path)
+        self._ev_children = {
+            kind: obs.SCOPE_TRACE_EVENTS.labels(kind=kind)
+            for kind in ("span", "flow", "counter", "request")}
+        self.pid = os.getpid()
+        self.t_enabled = time.perf_counter()
+        self.sampler: Optional[RuntimeSampler] = None
+        if sampler:
+            self.sampler = RuntimeSampler(self, sampler_interval_s)
+            self.sampler.start()
+
+    # ------------------------------------------------------------- identity --
+
+    def mint_trace(self, endpoint: str) -> TraceCtx:
+        return TraceCtx(next(self._ids), endpoint)
+
+    def mint_flow(self) -> int:
+        return next(self._ids)
+
+    @contextlib.contextmanager
+    def use_ctx(self, ctx: Optional[TraceCtx]):
+        """Bind a TraceCtx in this thread (the dispatcher replaying a
+        request's failover under the request's own trace id)."""
+        token = _CTX.set(ctx)
+        try:
+            yield ctx
+        finally:
+            _CTX.reset(token)
+
+    # ------------------------------------------------------------- emission --
+
+    def _push(self, ev: dict, kind: str) -> None:
+        with self._lock:
+            if len(self._events) >= self.trace_cap:
+                obs.SCOPE_TRACE_DROPPED.labels(kind=kind).inc()
+                return
+            self._events.append(ev)
+        self._ev_children[kind].inc()
+
+    def emit_span(self, name: str, t0_s: float, dur_s: float,
+                  tid: Optional[int] = None,
+                  ctx: Optional[TraceCtx] = None, cat: str = "scope",
+                  **args) -> None:
+        """One complete ('X') event with explicit timing — the exporter for
+        post-hoc per-request span trees assembled from recorded phase
+        timestamps."""
+        ctx = ctx if ctx is not None else _CTX.get()
+        if ctx is not None:
+            args.setdefault("trace_id", ctx.trace_id)
+        self._push({
+            "name": name, "ph": "X", "cat": cat,
+            "ts": round(t0_s * 1e6, 3), "dur": round(dur_s * 1e6, 3),
+            "pid": self.pid,
+            "tid": tid if tid is not None else threading.get_ident(),
+            "args": args,
+        }, "span")
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "scope", **args):
+        """Live span around a code block on the current thread; inherits the
+        active trace ctx (which guard.supervised's copied contextvars carry
+        into its worker thread)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.emit_span(name, t0, time.perf_counter() - t0,
+                           cat=cat, **args)
+
+    @contextlib.contextmanager
+    def request_span(self, endpoint: str, **args):
+        """Edge span: mint a trace id (unless one is already bound — a CLI
+        harness may pre-bind) and record the root request span."""
+        ctx = _CTX.get()
+        token = None
+        if ctx is None or ctx.endpoint != endpoint:
+            ctx = self.mint_trace(endpoint)
+            token = _CTX.set(ctx)
+        t0 = time.perf_counter()
+        try:
+            yield ctx
+        finally:
+            self.emit_span(f"request:{endpoint}", t0,
+                           time.perf_counter() - t0, ctx=ctx,
+                           cat="request", **args)
+            if token is not None:
+                _CTX.reset(token)
+
+    def emit_flow(self, flow_id: int, phase: str, t_s: float,
+                  tid: Optional[int] = None) -> None:
+        """Flow event ('s' start on the request thread, 'f' finish on the
+        dispatcher) binding a request span to the micro-batch that served
+        it. Perfetto draws the arrow."""
+        ev = {
+            "name": "req-flow", "ph": phase, "cat": "flow",
+            "id": flow_id, "ts": round(t_s * 1e6, 3), "pid": self.pid,
+            "tid": tid if tid is not None else threading.get_ident(),
+        }
+        if phase == "f":
+            ev["bp"] = "e"  # bind to the enclosing slice
+        self._push(ev, "flow")
+
+    def emit_counter(self, name: str, t_s: float,
+                     values: Dict[str, float]) -> None:
+        """Counter-track sample ('C'): the sampler's pool-bytes /
+        compile-delta / transfer tracks."""
+        self._push({
+            "name": name, "ph": "C", "cat": "telemetry",
+            "ts": round(t_s * 1e6, 3), "pid": self.pid, "tid": 0,
+            "args": dict(values),
+        }, "counter")
+
+    def record_request(self, endpoint: str, tm: dict, t_end: float,
+                       total: float, route: str) -> None:
+        """One finished request's raw trace record (hot path: one lock, one
+        append). The per-request span tree — root, queue_wait,
+        batched_dispatch, fetch, reply, and the flow stitch — expands from
+        `tm` lazily when the trace is read."""
+        with self._lock:
+            if len(self._requests) + len(self._events) >= self.trace_cap:
+                obs.SCOPE_TRACE_DROPPED.labels(kind="request").inc()
+                return
+            self._requests.append((endpoint, tm, t_end, total, route))
+        self._ev_children["request"].inc()
+
+    def _expand_request(self, endpoint: str, tm: dict, t_end: float,
+                        total: float, route: str, out: List[dict]) -> None:
+        ctx: TraceCtx = tm["ctx"]
+        tid = tm.get("tid", 0)
+        btid = tm.get("batch_tid", tid)
+
+        def span(name, t0, dur, stid, cat="serve", **args):
+            args["trace_id"] = ctx.trace_id
+            out.append({"name": name, "ph": "X", "cat": cat,
+                        "ts": round(t0 * 1e6, 3),
+                        "dur": round(dur * 1e6, 3),
+                        "pid": self.pid, "tid": stid, "args": args})
+
+        t_enq, t_batch = tm.get("t_enq"), tm.get("t_batch")
+        ke, fe = tm.get("kernel_end"), tm.get("fetch_end")
+        if t_enq is not None and t_batch is not None:
+            span("queue_wait", t_enq, t_batch - t_enq, tid)
+            fid = tm.get("flow")
+            if fid is not None:
+                out.append({"name": "req-flow", "ph": "s", "cat": "flow",
+                            "id": fid, "ts": round(t_enq * 1e6, 3),
+                            "pid": self.pid, "tid": tid})
+                out.append({"name": "req-flow", "ph": "f", "bp": "e",
+                            "cat": "flow", "id": fid,
+                            "ts": round(t_batch * 1e6, 3),
+                            "pid": self.pid, "tid": btid})
+        if t_batch is not None and ke is not None:
+            span("batched_dispatch", t_batch, ke - t_batch, btid,
+                 lanes=tm.get("lanes"))
+        if ke is not None and fe is not None:
+            span("fetch", ke, fe - ke, btid)
+        if tm.get("t_fresh0") is not None and tm.get("t_fresh1") is not None:
+            span("fresh_detour", tm["t_fresh0"],
+                 tm["t_fresh1"] - tm["t_fresh0"], tid,
+                 gate=tm.get("gate", ""))
+        last = fe if fe is not None else tm.get("t_fresh1", tm["t_sub"])
+        span("reply", last, t_end - last, tid)
+        span(f"request:{endpoint}", tm["t_sub"], total, tid, cat="request",
+             route=route, total_s=total, lanes=tm.get("lanes", 1),
+             attempts=list(tm["attempts"]))
+
+    # -------------------------------------------------------------- exports --
+
+    def events(self) -> List[dict]:
+        """The full trace-event list: live-emitted events plus the lazily
+        expanded per-request span trees."""
+        with self._lock:
+            evs = list(self._events)
+            reqs = list(self._requests)
+        for rec in reqs:
+            self._expand_request(*rec, out=evs)
+        return evs
+
+    def chrome_trace(self, metrics: Optional[dict] = None) -> dict:
+        doc = {
+            "traceEvents": self.events(),
+            "displayTimeUnit": "ms",
+            "metadata": {"tool": "open-simulator-tpu/simonscope",
+                         "slo": self.slo.snapshot()},
+        }
+        if metrics is not None:
+            doc["metadata"]["metrics"] = metrics
+        return doc
+
+    def write_trace(self, path: str, metrics: Optional[dict] = None) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.chrome_trace(metrics), f, indent=1)
+            f.write("\n")
+
+    def stats(self) -> dict:
+        with self._lock:
+            n = len(self._events)
+            r = len(self._requests)
+        return {
+            "trace_events": n,
+            "trace_requests": r,
+            "trace_cap": self.trace_cap,
+            "sampler": bool(self.sampler and self.sampler.alive),
+            "uptime_s": round(time.perf_counter() - self.t_enabled, 3),
+        }
+
+    def close(self) -> None:
+        if self.sampler is not None:
+            self.sampler.stop()
+            self.sampler = None
+
+
+_SCOPE: Optional[Scope] = None
+
+
+def active() -> Optional[Scope]:
+    """The enabled Scope, or None. THE zero-cost check: every
+    instrumentation site starts here."""
+    return _SCOPE
+
+
+def enable(slo_targets: Optional[Dict[str, Dict[str, float]]] = None,
+           sampler: bool = False, sampler_interval_s: float = 1.0,
+           trace_cap: int = DEFAULT_TRACE_CAP) -> Scope:
+    """Enable simonscope process-wide (idempotent: an existing scope is
+    returned untouched so a server restartless re-enable cannot orphan a
+    sampler thread)."""
+    global _SCOPE
+    if _SCOPE is None:
+        _SCOPE = Scope(slo_targets=slo_targets, sampler=sampler,
+                       sampler_interval_s=sampler_interval_s,
+                       trace_cap=trace_cap)
+    return _SCOPE
+
+
+def disable() -> None:
+    """Disable and tear down (sampler joined; trace buffer dropped)."""
+    global _SCOPE
+    sc = _SCOPE
+    _SCOPE = None
+    if sc is not None:
+        sc.close()
+
+
+def env_enabled(default: bool = False) -> bool:
+    """The OPEN_SIMULATOR_SCOPE switch ('' keeps the caller's default)."""
+    raw = os.environ.get("OPEN_SIMULATOR_SCOPE", "")
+    if raw == "":
+        return default
+    return raw not in ("0", "false", "no", "off")
+
+
+@contextlib.contextmanager
+def cli_edge(name: str, **args):
+    """The ONE CLI edge (cmd_apply, cmd_sweep, future commands): env-gated
+    enable (OPEN_SIMULATOR_SCOPE=1), one request span covering the whole
+    command so engine/probe/sweep spans share its trace id, and — FAILED
+    runs included, since a failed run's partial trace is exactly the
+    evidence it leaves behind — an OPEN_SIMULATOR_SCOPE_OUT trace dump on
+    exit. Yields the Scope, or None when scope is off."""
+    if not env_enabled(default=False):
+        yield None
+        return
+    sc = enable()
+    try:
+        with sc.request_span(name, **args):
+            yield sc
+    finally:
+        out = os.environ.get("OPEN_SIMULATOR_SCOPE_OUT", "")
+        if out:
+            sc.write_trace(out)
+            print(f"scope trace -> {out}", file=sys.stderr)

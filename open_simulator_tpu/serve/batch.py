@@ -26,6 +26,7 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from ..obs import instruments as obs
+from ..obs import scope
 from ..resilience import guard
 from .image import ResidentImage, WhatIfSession
 
@@ -35,15 +36,21 @@ MAX_BATCHED_PODS = 512
 
 
 class _Pending:
-    """One enqueued request and its rendezvous."""
+    """One enqueued request and its rendezvous. `tm` is the simonscope
+    timing/trace record (None with scope off — the zero-cost contract):
+    the request's TraceCtx + flow id, the phase-boundary timestamps the
+    dispatcher/kernel threads stamp in, and the attempt list a failover
+    replay appends to. One trace ID covers every attempt."""
 
-    __slots__ = ("session", "done", "response", "error")
+    __slots__ = ("session", "done", "response", "error", "tm")
 
-    def __init__(self, session: WhatIfSession) -> None:
+    def __init__(self, session: WhatIfSession,
+                 tm: Optional[dict] = None) -> None:
         self.session = session
         self.done = threading.Event()
         self.response: Optional[dict] = None
         self.error: Optional[BaseException] = None
+        self.tm = tm
 
 
 class WhatIfService:
@@ -72,19 +79,45 @@ class WhatIfService:
             raise ValueError("what-if request has no pods")
         if self._stopped:
             raise RuntimeError("serve dispatcher is stopped")
+        sc = scope.active()
+        if sc is None:  # the zero-cost contract: one None-check, old path
+            return self._submit(pods, drains, None)
+        # join the edge's trace (HTTP/gRPC handler minted one) or mint here
+        # (in-process callers: loadgen, tests, embedding code)
+        ctx = scope.current_ctx() or sc.mint_trace("whatif")
+        tm = {"ctx": ctx, "flow": sc.mint_flow(),
+              "tid": threading.get_ident(),
+              "t_sub": time.perf_counter(), "attempts": []}
+        token = scope._CTX.set(ctx)  # inline use_ctx: this is THE hot path
+        try:
+            resp = self._submit(pods, drains, tm)
+        except BaseException:
+            self._finish_scope(sc, tm, None, error=True)
+            raise
+        finally:
+            scope._CTX.reset(token)
+        self._finish_scope(sc, tm, resp)
+        return resp
+
+    def _submit(self, pods: List[dict], drains: Sequence[str],
+                tm: Optional[dict]) -> dict:
         if len(pods) > MAX_BATCHED_PODS or guard.default_quarantined():
-            return self._fresh(pods, drains)
+            return self._fresh(pods, drains, tm)
         session = self.image.session(pods, drains)
         gate = self.image.eligible(session.batch, pods)
         if gate is not None:
-            return self._fresh(pods, drains)
-        item = _Pending(session)
+            if tm is not None:
+                tm["gate"] = gate
+            return self._fresh(pods, drains, tm)
+        item = _Pending(session, tm)
         with self._cv:
             # re-check UNDER the lock: a stop() racing the encode above must
             # not let this item enqueue after the dispatcher exited — nothing
             # would ever set its event and the caller would hang forever
             if self._stopped:
                 raise RuntimeError("serve dispatcher is stopped")
+            if tm is not None:
+                tm["t_enq"] = time.perf_counter()
             self._queue.append(item)
             self._cv.notify_all()
         item.done.wait()
@@ -93,9 +126,45 @@ class WhatIfService:
         obs.SERVE_REQUESTS.labels(path=item.response["path"]).inc()
         return item.response
 
-    def _fresh(self, pods: List[dict], drains: Sequence[str]) -> dict:
+    def _fresh(self, pods: List[dict], drains: Sequence[str],
+               tm: Optional[dict] = None) -> dict:
         obs.SERVE_REQUESTS.labels(path="fresh").inc()
-        return self.image.fresh_probe(pods, drains)
+        if tm is None:
+            return self.image.fresh_probe(pods, drains)
+        # the detour expands to a 'fresh_detour' span from these marks; the
+        # engine's own probe span (engine.probe_pods) nests inside it via
+        # the bound trace ctx
+        tm["attempts"].append("fresh")
+        tm["t_fresh0"] = time.perf_counter()
+        resp = self.image.fresh_probe(pods, drains)
+        tm["t_fresh1"] = time.perf_counter()
+        return resp
+
+    def _finish_scope(self, sc, tm: dict, resp: Optional[dict],
+                      error: bool = False) -> None:
+        """Feed the SLO engine and append the request's raw trace record
+        (one lock + one append — the span tree expands lazily off the
+        serving path). The `total_s` float on the expanded root span is the
+        SAME float observed into the histogram, so trace and SLO sums
+        reconcile exactly (the acceptance criterion tests/test_scope.py
+        asserts)."""
+        now = time.perf_counter()
+        total = now - tm["t_sub"]
+        route = "error" if error else (resp or {}).get("path", "error")
+        phases: Dict[str, float] = {"total": total}
+        t_enq, t_batch = tm.get("t_enq"), tm.get("t_batch")
+        ke, fe = tm.get("kernel_end"), tm.get("fetch_end")
+        if t_enq is not None and t_batch is not None:
+            phases["queue"] = t_batch - t_enq
+        if t_batch is not None and ke is not None:
+            phases["dispatch"] = ke - t_batch
+        if ke is not None and fe is not None:
+            phases["fetch"] = fe - ke
+        if tm.get("t_fresh0") is not None and tm.get("t_fresh1") is not None:
+            # fresh path / failover replay: the probe IS the dispatch phase
+            phases.setdefault("dispatch", tm["t_fresh1"] - tm["t_fresh0"])
+        sc.record_request("whatif", tm, now, total, route)
+        sc.slo.record("whatif", route, phases, error=error)
 
     def stop(self) -> None:
         """Drain: wake the dispatcher and fail still-queued requests fast
@@ -145,19 +214,60 @@ class WhatIfService:
         # staleness is revalidated by dispatch_sessions UNDER the image lock
         # (a racing rebuild between here and there would invalidate any
         # check made outside it)
+        sc = scope.active()
+        tms = [item.tm for item in batch if item.tm is not None]
+        sink: dict = {}
+        if sc is not None and tms:
+            t_batch = time.perf_counter()
+            tid = threading.get_ident()
+            for tm in tms:
+                tm["t_batch"] = t_batch
+                tm["batch_tid"] = tid
+                tm["lanes"] = len(batch)
+                tm["attempts"].append("batched")
         try:
-            responses = self.image.dispatch_sessions(
-                [item.session for item in batch])
+            if sc is not None and tms:
+                with scope.collect_phases(sink), sc.span(
+                        "serve_batch", cat="serve", lanes=len(batch)):
+                    responses = self.image.dispatch_sessions(
+                        [item.session for item in batch])
+                # stamp the kernel-thread phase marks (guard.supervised's
+                # copied contextvars carried the sink reference into the
+                # watchdog worker) into every scoped request — on SUCCESS
+                # only, and before any done.set(): a failed attempt's
+                # partial marks must not masquerade as the fresh replay's
+                # dispatch phase, and stamping after wake-up would race
+                # the submitter threads reading tm in _finish_scope
+                for tm in tms:
+                    for k in ("kernel_begin", "kernel_end", "fetch_end"):
+                        if k in sink:
+                            tm[k] = sink[k]
+            else:
+                responses = self.image.dispatch_sessions(
+                    [item.session for item in batch])
         except BaseException as e:
             if guard.containment_cause(e) is None:
                 raise
             # contained device failure: the batch fails over to per-request
             # fresh probes (the engine routes those to the CPU fallback)
             guard.count_failover(guard.containment_cause(e), "serve")
+            cause = guard.containment_cause(e)
             for item in batch:
                 try:
-                    item.response = self.image.fresh_probe(
-                        item.session.pods, item.session.drains)
+                    if sc is not None and item.tm is not None:
+                        # the replay keeps the REQUEST's trace id: one trace
+                        # shows the wedged batched attempt and its fresh
+                        # replacement end to end
+                        item.tm["attempts"].append("fresh_replay")
+                        item.tm["t_fresh0"] = time.perf_counter()
+                        with sc.use_ctx(item.tm["ctx"]), sc.span(
+                                "fresh_replay", cat="serve", cause=cause):
+                            item.response = self.image.fresh_probe(
+                                item.session.pods, item.session.drains)
+                        item.tm["t_fresh1"] = time.perf_counter()
+                    else:
+                        item.response = self.image.fresh_probe(
+                            item.session.pods, item.session.drains)
                 except BaseException as fe:
                     import logging
 

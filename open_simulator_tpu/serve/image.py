@@ -43,6 +43,7 @@ tests/test_serve.py asserts it property-style over seeded event traces.
 
 from __future__ import annotations
 
+import contextlib
 import copy
 import functools
 import threading
@@ -52,6 +53,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..obs import instruments as obs
+from ..obs import scope
 from ..ops.resources import CPU_I, MEM_I
 from ..resilience import faults
 from ..resilience import guard
@@ -179,6 +181,9 @@ class ResidentImage:
                 self._pod_index[pod_key(pod)] = (pod, ni)
         self._restage(cause=None)
         self.build_s = time.perf_counter() - t0
+        # simonscope pool attribution: registration is a WeakSet add (cheap,
+        # leak-free); the runtime sampler only reads it when scope is on
+        scope.register_pools(self)
         return self
 
     @staticmethod
@@ -291,6 +296,24 @@ class ResidentImage:
         with self._lock:
             if self._stage_sig() != self._staged_sig:
                 self._restage(cause="groups")
+
+    # ---------------------------------------------------------- telemetry -----
+
+    def device_pool_bytes(self) -> Dict[str, int]:
+        """simonscope pool attribution: live device bytes owned by this
+        image, by pool — the staged cluster tables vs. the cached per-lane
+        base-seed carries. Holds the image lock only long enough to snapshot
+        the leaf references; nbytes reads never block on device work."""
+        with self._lock:
+            tables = list(self._tables)
+            carries = [leaf for c in self._carry_devcache.values()
+                       for leaf in c]
+        return {
+            "image_tables": sum(int(getattr(v, "nbytes", 0) or 0)
+                                for v in tables),
+            "carry_cache": sum(int(getattr(v, "nbytes", 0) or 0)
+                               for v in carries),
+        }
 
     # -------------------------------------------------------------- epoch -----
 
@@ -837,38 +860,61 @@ class ResidentImage:
     def _wave_round(self, carry_np, active_s, g_s, m_s, cap1_s, block, kmax):
         jnp = _jax()
         sim = self._sim
+        sc = scope.active()
         kns, carry_s, active, ctx = self._stage_lane_inputs(carry_np, active_s)
         with ctx:
             faults.maybe_fail("dispatch")
             faults.maybe_fail("oom_dispatch")
-            carry_s, placed = kns.serve_wave_fanout(
-                self._tables, carry_s, active,
-                jnp.asarray(g_s), jnp.asarray(m_s), jnp.asarray(cap1_s),
-                w=sim.score_w, filters=sim.filter_flags, block=block,
-                kmax=kmax)
+            # phase marks + spans run on the watchdog WORKER thread: the
+            # copied contextvars carry both the batcher's sink and the trace
+            # ctx here, so the trace shows dispatch/fetch on the thread that
+            # actually blocked on them
+            scope.mark("kernel_begin")
+            with (sc.span("kernel:serve_wave_fanout", cat="dispatch")
+                  if sc is not None else contextlib.nullcontext()):
+                carry_s, placed = kns.serve_wave_fanout(
+                    self._tables, carry_s, active,
+                    jnp.asarray(g_s), jnp.asarray(m_s), jnp.asarray(cap1_s),
+                    w=sim.score_w, filters=sim.filter_flags, block=block,
+                    kmax=kmax)
+            scope.mark("kernel_end")
             faults.maybe_fail("fetch")
-            return np.asarray(placed), np.asarray(carry_s.requested)
+            with (sc.span("fetch:serve_wave_fanout", cat="dispatch")
+                  if sc is not None else contextlib.nullcontext()):
+                out = np.asarray(placed), np.asarray(carry_s.requested)
+            scope.mark("fetch_end")
+            return out
 
     def _serial_round(self, carry_np, active_s, pod_group, forced_node,
                       valid_s):
         jnp = _jax()
         sim, btp = self._sim, self._bt
+        sc = scope.active()
         kns, carry_s, active, ctx = self._stage_lane_inputs(carry_np, active_s)
         with ctx:
             faults.maybe_fail("dispatch")
             faults.maybe_fail("oom_dispatch")
+            scope.mark("kernel_begin")
             # enable_gpu/enable_storage pinned False: the image gates decline
             # gpu/storage clusters AND requests, so the inert subgraphs
             # compile away and an ineligible interned group can never flip
             # the staged flags (and the compiled signature) underneath us
-            carry_s, placed = kns.serve_whatif_fanout(
-                self._tables, carry_s, active,
-                jnp.asarray(pod_group), jnp.asarray(forced_node),
-                jnp.asarray(valid_s),
-                n_zones=btp.n_zones, enable_gpu=False, enable_storage=False,
-                w=sim.score_w, filters=sim.filter_flags)
+            with (sc.span("kernel:serve_whatif_fanout", cat="dispatch")
+                  if sc is not None else contextlib.nullcontext()):
+                carry_s, placed = kns.serve_whatif_fanout(
+                    self._tables, carry_s, active,
+                    jnp.asarray(pod_group), jnp.asarray(forced_node),
+                    jnp.asarray(valid_s),
+                    n_zones=btp.n_zones, enable_gpu=False,
+                    enable_storage=False,
+                    w=sim.score_w, filters=sim.filter_flags)
+            scope.mark("kernel_end")
             faults.maybe_fail("fetch")
-            return np.asarray(placed), np.asarray(carry_s.requested)
+            with (sc.span("fetch:serve_whatif_fanout", cat="dispatch")
+                  if sc is not None else contextlib.nullcontext()):
+                out = np.asarray(placed), np.asarray(carry_s.requested)
+            scope.mark("fetch_end")
+            return out
 
     def _responses(self, sessions, totals, placed_s, requested_s, active_s,
                    lanes: int) -> List[dict]:

@@ -159,7 +159,8 @@ class GrpcBridge:
 
     # handlers: bytes-in/bytes-out via the wire codec
 
-    def _simulate(self, handler, request: bytes, context) -> bytes:
+    def _simulate(self, handler, request: bytes, context,
+                  endpoint: str = "grpc") -> bytes:
         from .http import count_http_error, error_body
 
         # the gRPC surface shares the REST drain gate: requests arriving
@@ -169,6 +170,13 @@ class GrpcBridge:
             return encode_simulate_response(
                 503, json.dumps(error_body(503, "server is draining")).encode())
         try:
+            # simonscope edge: the gRPC bridge mints the trace id exactly
+            # like the HTTP handler — the WhatIf RPC's micro-batched serve
+            # path joins it downstream (WhatIfService.submit)
+            from ..obs import scope as scope_mod
+
+            sc = scope_mod.active() if getattr(
+                self.server, "scope", False) else None
             try:
                 req = json.loads(decode_simulate_request(request) or b"{}")
             except ValueError as e:
@@ -179,21 +187,34 @@ class GrpcBridge:
                 code, body = 400, error_body(
                     400, f"fail to unmarshal content: {e}")
             else:
-                code, body = handler(req)
+                if sc is not None:
+                    import time as _time
+
+                    t0 = _time.perf_counter()
+                    with sc.request_span(endpoint):
+                        code, body = handler(req)
+                    sc.slo.record(endpoint, f"{code // 100}xx",
+                                  {"total": _time.perf_counter() - t0},
+                                  error=code >= 500)
+                else:
+                    code, body = handler(req)
             return encode_simulate_response(code, json.dumps(body).encode())
         finally:
             self.server._end_request()
 
     def _deploy(self, request: bytes, context) -> bytes:
-        return self._simulate(self.server.handle_deploy_apps, request, context)
+        return self._simulate(self.server.handle_deploy_apps, request, context,
+                              endpoint="grpc:deploy-apps")
 
     def _scale(self, request: bytes, context) -> bytes:
-        return self._simulate(self.server.handle_scale_apps, request, context)
+        return self._simulate(self.server.handle_scale_apps, request, context,
+                              endpoint="grpc:scale-apps")
 
     def _whatif(self, request: bytes, context) -> bytes:
         # simonserve: same JSON-in-bytes contract as Deploy/Scale — the
         # resident micro-batched path behind both surfaces is identical
-        return self._simulate(self.server.handle_whatif, request, context)
+        return self._simulate(self.server.handle_whatif, request, context,
+                              endpoint="grpc:whatif")
 
     def _health(self, request: bytes, context) -> bytes:
         return encode_health_response("ok")

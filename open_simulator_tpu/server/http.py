@@ -162,6 +162,7 @@ class Server:
         whatif: Optional[bool] = None,
         whatif_window_ms: Optional[float] = None,
         whatif_fanout: Optional[int] = None,
+        scope: Optional[bool] = None,
     ) -> None:
         # /debug/fault-plan is a process-global WRITE endpoint (testing/CI):
         # never enabled by default on a production server. Opt in explicitly
@@ -203,6 +204,23 @@ class Server:
         self.whatif_fanout = (
             whatif_fanout if whatif_fanout is not None
             else int(os.environ.get("OPEN_SIMULATOR_WHATIF_FANOUT", "8")))
+        # simonscope (obs/scope.py): request tracing + SLO engine + runtime
+        # telemetry. `simon serve` turns it on by default (serving-grade
+        # observability is the point of serve mode); everything else is off
+        # unless OPEN_SIMULATOR_SCOPE=1 / scope=True. Library/test default
+        # stays OFF so scope-off metrics remain byte-identical.
+        from ..obs import scope as scope_mod
+
+        if scope is None:
+            scope = scope_mod.env_enabled(default=False)
+        self.scope = scope
+        # ownership: only the server that CREATED the process-global scope
+        # tears it down on drain — an externally enabled scope (a test
+        # harness, an embedding process) outlives any one server, exactly
+        # like the xray recorder
+        self._scope_owned = bool(scope) and scope_mod.active() is None
+        if scope:
+            scope_mod.enable(sampler=True)
         self._whatif_svc = None
         self._whatif_declined = False
         self._whatif_lock = threading.Lock()
@@ -461,6 +479,15 @@ class Server:
         svc = self._whatif_svc
         if svc is not None:
             svc.stop()  # wake the micro-batch dispatcher; queued requests fail fast
+        if self._scope_owned:
+            # join the telemetry sampler and drop the trace buffer: the
+            # scope this server created must not outlive it (a later
+            # scope=False server in the same process would otherwise keep
+            # tracing through the leftover global)
+            from ..obs import scope as scope_mod
+
+            scope_mod.disable()
+            self._scope_owned = False
         httpd = self._httpd
         if httpd is not None:
             httpd.shutdown()
@@ -475,6 +502,7 @@ class Server:
 
             def _send(self, code: int, body: object) -> None:
                 data = json.dumps(body).encode()
+                self._last_code = code
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
@@ -485,13 +513,50 @@ class Server:
                 count_http_error(endpoint, code)
                 self._send(code, error_body(code, message))
 
+            # Fixed route-family table for the simonscope edge: SLO/metric
+            # endpoint labels must be BOUNDED (a per-pod /explain path or a
+            # scanner probing random 404s must not mint unbounded label
+            # children and window histograms), so paths normalize to these
+            # families and everything else buckets to "other".
+            _SCOPE_ROUTES = ("/v1/whatif", "/v1/ingest", "/v1/serve/stats",
+                             "/v1/serve/trace", "/api/deploy-apps",
+                             "/api/scale-apps", "/explain/", "/debug/vars",
+                             "/debug/pprof/profile", "/debug/fault-plan")
+
+            def _route_scoped(self, routes) -> None:
+                """simonscope edge: mint the request's trace id at the HTTP
+                boundary (the whatif path joins it downstream in
+                WhatIfService.submit) and record per-endpoint edge latency
+                into the SLO engine, labeled by status class. Scrape/health
+                surfaces stay unwrapped so scraping never traces itself."""
+                from ..obs import scope as scope_mod
+
+                sc = scope_mod.active() if server.scope else None
+                path = self.path.split("?")[0]
+                if sc is None or path in ("/healthz", "/metrics", "/test"):
+                    routes()
+                    return
+                family = next((r for r in self._SCOPE_ROUTES
+                               if path == r or (r.endswith("/")
+                                                and path.startswith(r))),
+                              "other")
+                endpoint = f"http:{family}"
+                self._last_code = 200
+                t_start = time.perf_counter()
+                with sc.request_span(endpoint):
+                    routes()
+                total = time.perf_counter() - t_start
+                sc.slo.record(endpoint, f"{self._last_code // 100}xx",
+                              {"total": total},
+                              error=self._last_code >= 500)
+
             def do_GET(self):
                 # the drain gate: in-flight requests finish, new ones get 503
                 if not server._begin_request():
                     self._send_err(503, "server is draining", "drain")
                     return
                 try:
-                    self._get_routes()
+                    self._route_scoped(self._get_routes)
                 finally:
                     server._end_request()
 
@@ -500,7 +565,7 @@ class Server:
                     self._send_err(503, "server is draining", "drain")
                     return
                 try:
-                    self._post_routes()
+                    self._route_scoped(self._post_routes)
                 finally:
                     server._end_request()
 
@@ -510,9 +575,16 @@ class Server:
                 elif self.path == "/metrics" or self.path.startswith("/metrics?"):
                     # Prometheus scrape surface (the reference mounts
                     # kube-scheduler's metrics handler; server.go:152) —
-                    # everything obs/instruments.py accumulates, text format
+                    # everything obs/instruments.py accumulates, text format.
+                    # With scope on, the rolling-window quantile/burn gauges
+                    # refresh first so the scrape carries current p50/p95/p99
+                    # (scope off never touches those families: byte-identity).
                     from ..obs import REGISTRY
+                    from ..obs import scope as scope_mod
 
+                    sc = scope_mod.active() if server.scope else None
+                    if sc is not None:
+                        sc.slo.refresh_gauges()
                     data = REGISTRY.render_text().encode()
                     self.send_response(200)
                     self.send_header(
@@ -575,8 +647,11 @@ class Server:
                     from ..resilience import guard
                     from ..utils.trace import recent_spans
 
+                    from ..obs import scope as scope_mod
+
                     started = getattr(server, "_t_start", None)
                     xrec = xray_mod.active() if server.xray else None
+                    _scope = scope_mod.active() if server.scope else None
                     self._send(200, {
                         "uptime_seconds": (
                             round(time.time() - started, 3) if started else None),
@@ -594,6 +669,8 @@ class Server:
                             **xrec.counts(),
                             "unscheduled_sample": xrec.unscheduled_summary(),
                         }} if xrec is not None else {}),
+                        **({"scope": _scope.stats()} if _scope is not None
+                           else {}),
                         "metrics": REGISTRY.values(),
                     })
                 elif self.path == "/debug/fault-plan":
@@ -607,14 +684,44 @@ class Server:
                     plan = active_plan()
                     self._send(200, plan.to_json() if plan is not None else {})
                 elif self.path == "/v1/serve/stats":
-                    # simonserve: the resident image / dispatcher state
+                    # simonserve: the resident image / dispatcher state —
+                    # plus, with scope on, the SLO engine's rolling-window
+                    # snapshot and the trace-buffer/sampler state (what
+                    # `simon slo` and `simon top` render)
+                    from ..obs import scope as scope_mod
+
                     svc = server._whatif_svc
                     if not server.whatif or svc is None:
                         self._send_err(
                             404, "resident what-if serving is off or not "
                             "yet built (POST /v1/whatif first)", "serve-stats")
                         return
-                    self._send(200, svc.stats())
+                    stats = svc.stats()
+                    sc = scope_mod.active() if server.scope else None
+                    if sc is not None:
+                        from ..obs import instruments as obs_i
+
+                        stats["slo"] = sc.slo.snapshot()
+                        stats["scope"] = sc.stats()
+                        stats["scope"]["pools"] = {
+                            s["labels"]["pool"]: s["value"]
+                            for s in obs_i.SCOPE_POOL_BYTES.samples()}
+                    self._send(200, stats)
+                elif self.path == "/v1/serve/trace":
+                    # simonscope: dump the in-memory request-trace buffer as
+                    # perfetto-loadable Chrome trace-event JSON (spans + flow
+                    # stitches + telemetry counter tracks)
+                    from ..obs import REGISTRY
+                    from ..obs import scope as scope_mod
+
+                    sc = scope_mod.active() if server.scope else None
+                    if sc is None:
+                        self._send_err(
+                            404, "simonscope is off (start with `simon "
+                            "serve` or OPEN_SIMULATOR_SCOPE=1)", "serve-trace")
+                        return
+                    self._send(200, sc.chrome_trace(
+                        metrics=REGISTRY.snapshot()))
                 elif self.path == "/test":
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")

@@ -387,7 +387,20 @@ class Simulator:
         CPU, to the identical placements (serial-order determinism). The
         failover is recorded on backend_path and
         simon_guard_failovers_total{cause}; it is never silent."""
+        from ..obs import scope
+
+        sc = scope.active()  # one None-check: the scope-off hot path pays
+        #                      nothing (same contract as xray.begin_run)
         t0 = time.perf_counter()
+        if sc is not None:
+            cm = sc.span("engine.schedule_pods", cat="engine", pods=len(pods))
+        else:
+            cm = contextlib.nullcontext()
+        with cm:
+            return self._schedule_pods_timed(pods, t0)
+
+    def _schedule_pods_timed(self, pods: List[dict], t0: float
+                             ) -> List[UnscheduledPod]:
         try:
             def attempt():
                 # fresh xray staging per ATTEMPT: records of a failed attempt
@@ -1299,13 +1312,19 @@ class Simulator:
         fallback and re-runs it there (probes are never BISECTED — splitting
         a probe run would let the second half see placements the first never
         committed, changing the counted semantics)."""
+        from ..obs import scope
+
         def attempt():
             self._xray_run = xray.begin_run("probe")
             with self._transaction():
                 return self._probe_pods_inner(pods)
 
+        sc = scope.active()
+        cm = (sc.span("engine.probe_pods", cat="engine", pods=len(pods))
+              if sc is not None else contextlib.nullcontext())
         try:
-            result = self._run_contained(attempt)
+            with cm:
+                result = self._run_contained(attempt)
             if self._xray_run is not None:
                 # probes never materialize placements: one summary record
                 # (counts + backend_path) per call, no per-pod rows

@@ -59,6 +59,7 @@ probes.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -469,16 +470,22 @@ class ProbeSession:
         xray.commit_run(run, [guard.current_backend()])
 
     def _dispatch(self, active_s: np.ndarray):
+        from ..obs import scope
+
         S = active_s.shape[0]
+        sc = scope.active()
+        cm = (sc.span("probe.fanout", cat="dispatch", lanes=int(S))
+              if sc is not None else contextlib.nullcontext())
         # The whole fan-out round — lane padding, seed broadcast, every
         # segment dispatch, the one fetch — runs as ONE supervised unit: the
         # mesh context is thread-local, so it must be entered inside the
         # watchdog's worker thread, and a wedge anywhere in the round
         # classifies the same way (the search then falls back to fresh
         # probes on the surviving backend).
-        placed_s, requested_s = guard.supervised(
-            functools.partial(self._dispatch_round, active_s),
-            site="dispatch", pods=self._run_len * max(1, S))
+        with cm:
+            placed_s, requested_s = guard.supervised(
+                functools.partial(self._dispatch_round, active_s),
+                site="dispatch", pods=self._run_len * max(1, S))
         return placed_s[:S], requested_s[:S]
 
     def _dispatch_round(self, active_s: np.ndarray):

@@ -32,6 +32,7 @@ notes). On a real scenario mesh the [S] axis shards one lane per device.
 
 from __future__ import annotations
 
+import contextlib
 import copy
 import functools
 from typing import Dict, List, NamedTuple, Optional, Tuple
@@ -147,14 +148,26 @@ class SweepRunner:
         # natural sizes — on the 1-core host this is also the cache story:
         # a [S, N, B] table for a modest S stays resident where one sized
         # for the outlier thrashes.
+        from ..obs import scope as scope_mod
+
+        scope_ = scope_mod.active()  # simonscope: sweep chunks become spans
+        #          in the same trace buffer the serve path fills — None-check
+        #          only when off (a `simon sweep` under a scoped server
+        #          shares the perfetto timeline)
         for _, chunk_lanes in sorted(_grouped(wave, self._wave_shape_key)):
             for chunk in _chunks(chunk_lanes, self.fanout):
-                self._run_contained(chunk, self._dispatch_wave_chunk)
+                with (scope_.span("sweep.wave_chunk", cat="dispatch",
+                                  lanes=len(chunk))
+                      if scope_ is not None else contextlib.nullcontext()):
+                    self._run_contained(chunk, self._dispatch_wave_chunk)
         for _, chunk_lanes in sorted(_grouped(
                 scan, lambda item: bucket_capped(
                     max(1, len(item[1].batch)), 2048))):
             for chunk in _chunks(chunk_lanes, self.fanout):
-                self._run_contained(chunk, self._dispatch_scan_chunk)
+                with (scope_.span("sweep.scan_chunk", cat="dispatch",
+                                  lanes=len(chunk))
+                      if scope_ is not None else contextlib.nullcontext()):
+                    self._run_contained(chunk, self._dispatch_scan_chunk)
         for sc, gate in fresh:
             self._finish(self._serial_result(sc, route="fresh", gate=gate))
         self._check_parity()

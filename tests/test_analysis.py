@@ -181,6 +181,25 @@ def test_naked_dispatch_spares_supervised_forms():
                    if f.rule == "naked-dispatch")
 
 
+def test_span_outside_guard_rule_fires():
+    # three spans (utils/trace.Span x2, scope .span()) around unsupervised
+    # kernel dispatches fire; the offline-harness waiver reports suppressed
+    assert _counts("span_guard_hazard.py", "span-outside-guard") == 3
+    assert _counts("span_guard_hazard.py", "span-outside-guard",
+                   suppressed=True) == 1
+
+
+def test_span_outside_guard_spares_supervised_and_plain_spans():
+    # a span AROUND guard.supervised is the sanctioned pattern (the span
+    # times a contained dispatch), and spans over host work never fire
+    fr = analyze_file(str(FIXTURES / "span_guard_hazard.py"))
+    src = (FIXTURES / "span_guard_hazard.py").read_text().splitlines()
+    ok_start = next(i for i, l in enumerate(src, 1)
+                    if "def span_around_supervised_is_fine" in l)
+    assert not any(f.line >= ok_start and not f.suppressed
+                   for f in fr.findings if f.rule == "span-outside-guard")
+
+
 def test_fetch_in_wave_loop_rule_fires():
     # two loops (per-seg fetch; epoch-poll block+get) yield three findings;
     # the deliberate blocking-probe waiver reports suppressed, not active

@@ -1,0 +1,400 @@
+"""simonscope tests: trace propagation across the hard serving paths
+(micro-batch demux, fresh-path detours, failover replays), SLO engine
+quantile/burn accounting, the consistent-snapshot metrics fix under a
+16-thread hammer, and runtime-sampler lifecycle.
+
+The contract under test (ISSUE 14 acceptance):
+- every served request yields ONE complete span tree whose phase spans and
+  counters reconcile exactly with the simon_serve_* / simon_scope_* metric
+  families;
+- a failover replay keeps the request's trace id across both backend
+  attempts; a census-dependent request's fresh detour is traced under the
+  same id;
+- tracing off reproduces bit-identical placements and byte-identical
+  metrics (scope families emit no samples);
+- a /metrics scrape racing 16 updating threads never renders a torn
+  histogram row (one locked snapshot per family per scrape).
+"""
+
+import json
+import math
+import threading
+import time
+
+import pytest
+
+from open_simulator_tpu.obs import REGISTRY, Registry
+from open_simulator_tpu.obs import scope
+from open_simulator_tpu.obs.scope import SLOEngine, _WindowHist
+from open_simulator_tpu.resilience import FaultPlan, FaultSpec, installed
+from open_simulator_tpu.resilience import guard
+from open_simulator_tpu.serve import ResidentImage, WhatIfService
+
+from fixtures import make_node, make_pod
+
+
+@pytest.fixture(autouse=True)
+def _clean_scope_and_guard():
+    scope.disable()
+    guard.reset_for_tests()
+    yield
+    scope.disable()
+    guard.reset_for_tests()
+
+
+def _vals():
+    return REGISTRY.values()
+
+
+def make_image(n_nodes=10, n_bound=4):
+    nodes = [make_node(f"n-{i}", cpu="8", memory="16Gi")
+             for i in range(n_nodes)]
+    bound = [make_pod(f"b-{i}", cpu="1", memory="1Gi",
+                      node_name=f"n-{i % n_nodes}",
+                      labels={"app": f"base-{i % 2}"})
+             for i in range(n_bound)]
+    img = ResidentImage.try_build(nodes, pods=bound)
+    assert img is not None
+    return img
+
+
+def whatif(tag, n=2):
+    return [make_pod(f"wi-{tag}-{j}", cpu="1", memory="1Gi",
+                     labels={"app": f"wi-{tag}"}) for j in range(n)]
+
+
+# ------------------------------------------------------------ SLO engine -----
+
+
+def test_window_hist_quantiles_interpolate():
+    h = _WindowHist(window_s=60.0, n_slices=12)
+    now = 1000.0
+    for ms in (1, 2, 4, 8, 100):
+        h.record(ms / 1000.0, now)
+    counts, total, n = h.merged(now)
+    assert n == 5
+    assert abs(total - 0.115) < 1e-9
+    p50 = _WindowHist.quantile(counts, n, 0.50)
+    assert 0.002 <= p50 <= 0.008
+    p99 = _WindowHist.quantile(counts, n, 0.99)
+    assert p99 >= 0.064  # the 100ms outlier's bucket
+
+
+def test_window_hist_slides_old_slices_out():
+    h = _WindowHist(window_s=10.0, n_slices=5)
+    h.record(0.001, 0.0)
+    assert h.merged(1.0)[2] == 1
+    # 20s later the window has slid past the old slice entirely
+    assert h.merged(20.0)[2] == 0
+
+
+def test_slo_engine_targets_and_burn():
+    eng = SLOEngine(targets={"ep": {"p99_ms": 10.0, "availability": 0.9}})
+    for _ in range(8):
+        eng.record("ep", "batched", {"total": 0.001})
+    for _ in range(2):
+        eng.record("ep", "batched", {"total": 0.5})  # violations
+    snap = eng.snapshot()["endpoints"]["ep"]
+    assert snap["slo"]["requests"] == 10
+    assert snap["slo"]["violations"] == 2
+    # bad fraction 0.2 over an allowed 0.1 -> burning at 2x
+    assert abs(snap["slo"]["budget_burn"] - 2.0) < 1e-6
+    assert snap["routes"] == {"batched": 10}
+
+
+def test_slo_engine_error_counts_as_violation():
+    eng = SLOEngine(targets={"ep": {"p99_ms": 1000.0, "availability": 0.5}})
+    eng.record("ep", "error", {"total": 0.001}, error=True)
+    assert eng.snapshot()["endpoints"]["ep"]["slo"]["violations"] == 1
+
+
+# -------------------------------------------------- micro-batch demux trace --
+
+
+def test_micro_batch_demux_complete_span_trees():
+    """N concurrent requests -> N complete span trees from one (or few)
+    coalesced dispatches, with queue-wait and lane counts reconciling
+    exactly with the simon_serve_* counters."""
+    img = make_image()
+    svc = WhatIfService(img, window_ms=50.0, fanout=8)
+    sc = scope.enable()
+    v0 = _vals()
+    results = [None] * 8
+
+    def run(i):
+        results[i] = svc.submit(whatif(f"d{i}"))
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    svc.stop()
+    assert all(r is not None and r["path"] == "batched" for r in results)
+    v1 = _vals()
+    events = sc.events()
+    roots = [e for e in events if e["name"] == "request:whatif"]
+    assert len(roots) == 8
+    # one complete tree per trace id: queue_wait + batched_dispatch +
+    # fetch + reply all carry the root's id
+    by_trace = {}
+    for e in events:
+        t = (e.get("args") or {}).get("trace_id")
+        if t is not None:
+            by_trace.setdefault(t, []).append(e["name"])
+    assert len(by_trace) == 8
+    for names in by_trace.values():
+        assert {"request:whatif", "queue_wait", "batched_dispatch",
+                "fetch", "reply"} <= set(names)
+    # flow stitches pair up (one s + one f per request)
+    flows = [e for e in events if e.get("cat") == "flow"]
+    assert sum(1 for e in flows if e["ph"] == "s") == 8
+    assert sum(1 for e in flows if e["ph"] == "f") == 8
+    # lane counts reconcile exactly: per-batch lane widths from the trace
+    # must sum to the request count AND match the serve histogram delta
+    batch_spans = [e for e in events if e["name"] == "serve_batch"]
+    d_batches = (v1.get("simon_serve_batches_total", 0)
+                 - v0.get("simon_serve_batches_total", 0))
+    assert len(batch_spans) == d_batches
+    assert sum(e["args"]["lanes"] for e in batch_spans) == 8
+    d_lanes_sum = (v1.get("simon_serve_batch_lanes_sum", 0)
+                   - v0.get("simon_serve_batch_lanes_sum", 0))
+    assert d_lanes_sum == 8
+    d_req = (v1.get('simon_scope_requests_total{endpoint="whatif",'
+                    'route="batched"}', 0)
+             - v0.get('simon_scope_requests_total{endpoint="whatif",'
+                      'route="batched"}', 0))
+    assert d_req == 8
+    # trace totals == SLO histogram sum (same floats)
+    span_total = math.fsum(e["args"]["total_s"] for e in roots)
+    d_sum = (v1.get('simon_scope_request_phase_seconds_sum'
+                    '{endpoint="whatif",phase="total"}', 0.0)
+             - v0.get('simon_scope_request_phase_seconds_sum'
+                      '{endpoint="whatif",phase="total"}', 0.0))
+    assert abs(span_total - d_sum) <= 1e-9
+
+
+def test_kernel_spans_ride_the_watchdog_worker_thread():
+    """The dispatch/fetch spans are emitted from inside guard.supervised's
+    worker (contextvars carry the sink + ctx): the trace shows them on a
+    tid different from the submitting thread."""
+    img = make_image()
+    svc = WhatIfService(img, window_ms=1.0, fanout=4)
+    sc = scope.enable()
+    svc.submit(whatif("k"))
+    svc.stop()
+    kernel_spans = [e for e in sc.events()
+                    if e["name"].startswith("kernel:serve_")]
+    assert kernel_spans, "kernel dispatch produced no span"
+    assert all(e["tid"] != threading.get_ident() for e in kernel_spans)
+
+
+# ------------------------------------------------------- fresh-path detour ---
+
+
+def test_fresh_detour_traced_under_same_trace_id():
+    """A census-dependent request (topology spread) routes to the fresh
+    path; the detour is traced under the request's own trace id and the SLO
+    route mix records it as fresh."""
+    img = make_image()
+    svc = WhatIfService(img, window_ms=1.0, fanout=4)
+    sc = scope.enable()
+    pod = make_pod("spread-1", cpu="1", memory="1Gi",
+                   labels={"app": "spread"})
+    pod["spec"]["topologySpreadConstraints"] = [{
+        "maxSkew": 1, "topologyKey": "kubernetes.io/hostname",
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": "spread"}},
+    }]
+    r = svc.submit([pod])
+    svc.stop()
+    assert r["path"] == "fresh"
+    events = sc.events()
+    roots = [e for e in events if e["name"] == "request:whatif"]
+    assert len(roots) == 1
+    root = roots[0]
+    assert root["args"]["route"] == "fresh"
+    assert root["args"]["attempts"] == ["fresh"]
+    tid_ = root["args"]["trace_id"]
+    detours = [e for e in events if e["name"] == "fresh_detour"]
+    assert len(detours) == 1
+    assert detours[0]["args"]["trace_id"] == tid_
+    assert "spread" in detours[0]["args"]["gate"]
+    # the engine's probe span nests under the same trace (ctx carried into
+    # the fresh Simulator call on the submitting thread)
+    probes = [e for e in events if e["name"] == "engine.probe_pods"
+              and (e.get("args") or {}).get("trace_id") == tid_]
+    assert probes, "fresh detour did not trace the engine probe"
+    snap = sc.slo.snapshot()["endpoints"]["whatif"]
+    assert snap["routes"] == {"fresh": 1}
+
+
+# -------------------------------------------------------- failover replay ----
+
+
+def test_failover_replay_keeps_one_trace_id():
+    """An injected watchdog_wedge mid-serve fails the batch over to
+    per-request fresh replays: ONE trace id covers the batched attempt and
+    its replacement, attempts = [batched, fresh_replay], and the guard
+    failover counter moves."""
+    img = make_image()
+    svc = WhatIfService(img, window_ms=1.0, fanout=4)
+    sc = scope.enable()
+    v0 = _vals()
+    with installed(FaultPlan([FaultSpec("watchdog_wedge", 1)])):
+        r = svc.submit(whatif("wedge"))
+    svc.stop()
+    assert r["path"] == "fresh"
+    v1 = _vals()
+    assert (v1.get('simon_guard_failovers_total{cause="watchdog_wedge"}', 0)
+            > v0.get('simon_guard_failovers_total{cause="watchdog_wedge"}', 0))
+    events = sc.events()
+    roots = [e for e in events if e["name"] == "request:whatif"]
+    assert len(roots) == 1
+    root = roots[0]
+    assert root["args"]["attempts"] == ["batched", "fresh_replay"]
+    tid_ = root["args"]["trace_id"]
+    replays = [e for e in events if e["name"] == "fresh_replay"]
+    assert len(replays) == 1
+    assert replays[0]["args"]["trace_id"] == tid_
+    assert replays[0]["args"]["cause"] == "watchdog_wedge"
+    # every span of this request carries the SAME trace id — the probe ran
+    # on the dispatcher thread under use_ctx, not a fresh trace
+    ids = {(e.get("args") or {}).get("trace_id")
+           for e in events if (e.get("args") or {}).get("trace_id")}
+    assert ids == {tid_}
+
+
+# --------------------------------------------------------- off bit-identity --
+
+
+def test_scope_off_bit_identity_and_silent_metrics():
+    img = make_image()
+    svc = WhatIfService(img, window_ms=1.0, fanout=4)
+    reqs = [whatif(f"bi{i}") for i in range(4)]
+    v0 = _vals()
+    off = [svc.submit(r) for r in reqs]
+    v1 = _vals()
+    # scope-off serving moved NO simon_scope_* sample (byte-identity of the
+    # scope families; other tests in this process may have touched them)
+    moved = {k for k in set(v0) | set(v1)
+             if k.startswith("simon_scope_")
+             and v0.get(k, 0) != v1.get(k, 0)}
+    assert not moved, moved
+    sc = scope.enable()
+    on = [svc.submit(r) for r in reqs]
+    svc.stop()
+    assert on == off
+    assert len([e for e in sc.events()
+                if e["name"] == "request:whatif"]) == 4
+
+
+# ------------------------------------------- consistent-snapshot hammer fix --
+
+
+def test_metrics_render_consistent_under_16_thread_hammer():
+    """16 threads hammer a histogram + a labeled counter while scrapers
+    render concurrently: every rendered histogram row must be internally
+    consistent (sum == count * observed value, +Inf cumulative == count) —
+    the torn-row bug one-locked-snapshot-per-scrape fixes."""
+    reg = Registry()
+    hist = reg.histogram("hammer_seconds", "h", buckets=(0.5, 1.0, 2.0))
+    ctr = reg.counter("hammer_total", "c", ("worker",))
+    stop = threading.Event()
+
+    def worker(i):
+        child = ctr.labels(worker=str(i))
+        while not stop.is_set():
+            hist.observe(1.0)  # sum must always equal count * 1.0
+            child.inc(3.0)     # rows must always be multiples of 3
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+    for t in ts:
+        t.start()
+    torn = []
+    try:
+        deadline = time.monotonic() + 1.5
+        while time.monotonic() < deadline:
+            for text in (reg.render_text(),):
+                inf = cnt = hsum = None
+                for line in text.splitlines():
+                    if line.startswith('hammer_seconds_bucket{le="+Inf"}'):
+                        inf = float(line.split()[-1])
+                    elif line.startswith("hammer_seconds_sum"):
+                        hsum = float(line.split()[-1])
+                    elif line.startswith("hammer_seconds_count"):
+                        cnt = float(line.split()[-1])
+                    elif line.startswith("hammer_total{"):
+                        v = float(line.split()[-1])
+                        if v % 3.0 != 0.0:
+                            torn.append(("counter", line))
+                if inf != cnt:
+                    torn.append(("inf!=count", inf, cnt))
+                if hsum != cnt:
+                    torn.append(("sum!=count*1.0", hsum, cnt))
+            # the JSON snapshot path must be consistent too (/debug/vars)
+            snap = reg.snapshot()["hammer_seconds"]["samples"][0]
+            if snap["buckets"][-1][1] + sum(
+                    c for _, c in snap["buckets"][:-1]) != snap["count"]:
+                torn.append(("snapshot buckets", snap))
+            if snap["sum"] != snap["count"] * 1.0:
+                torn.append(("snapshot sum", snap))
+    finally:
+        stop.set()
+        for t in ts:
+            t.join()
+    assert not torn, torn[:5]
+
+
+# ------------------------------------------------------------- sampler -------
+
+
+def test_sampler_pools_and_clean_shutdown():
+    img = make_image()
+    sc = scope.enable(sampler=False)
+    sampler = scope.RuntimeSampler(sc, interval_s=30.0)
+    sampler.start()
+    try:
+        sampler.sample_once()
+        pools = {s["labels"]["pool"]: s["value"]
+                 for s in __import__(
+                     "open_simulator_tpu.obs.instruments",
+                     fromlist=["x"]).SCOPE_POOL_BYTES.samples()}
+        assert pools.get("image_tables", 0) > 0, pools
+        assert "carry_cache" in pools
+        tracks = [e for e in sc.events() if e.get("ph") == "C"]
+        names = {e["name"] for e in tracks}
+        assert {"device_pool_bytes", "compile_cache_delta",
+                "transfer_bytes_per_s"} <= names
+    finally:
+        sampler.stop()
+    assert not sampler.alive
+    assert not any(t.name == "simon-scope-sampler"
+                   for t in threading.enumerate())
+    # keep a reference so the image's pools stay registered during the test
+    assert img.device_pool_bytes()["image_tables"] > 0
+
+
+def test_trace_buffer_cap_drops_and_counts():
+    sc = scope.enable(trace_cap=4)
+    for i in range(8):
+        sc.emit_span(f"s{i}", 0.0, 1.0)
+    assert len(sc.events()) == 4
+    dropped = sum(s["value"] for s in __import__(
+        "open_simulator_tpu.obs.instruments",
+        fromlist=["x"]).SCOPE_TRACE_DROPPED.samples())
+    assert dropped >= 4
+
+
+def test_chrome_trace_shape():
+    sc = scope.enable()
+    with sc.request_span("unit"):
+        with sc.span("inner", cat="serve"):
+            pass
+    doc = sc.chrome_trace(metrics={"m": 1})
+    assert doc["displayTimeUnit"] == "ms"
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"request:unit", "inner"} <= names
+    assert doc["metadata"]["metrics"] == {"m": 1}
+    assert "slo" in doc["metadata"]
+    json.dumps(doc)  # perfetto-loadable == valid JSON at minimum

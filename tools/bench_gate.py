@@ -1,0 +1,161 @@
+"""CI bench gate: `simon metrics --diff --fail-on-regression` over the
+serve/sweep workloads' obs_metrics vs a committed baseline.
+
+Runs the fixed gate workloads in THIS process (a fresh interpreter, so the
+compile-cache accounting starts from zero exactly like the baseline run):
+
+1. **serve** — a scaled-down closed-loop loadgen run on the resident image
+   (the serve_whatif_rps shape: warm templates, micro-batching, live churn,
+   a scoped window);
+2. **sweep** — the committed zone-outage example sweep with full parity
+   fuzzing.
+
+Then diffs the fresh registry snapshot against the committed baseline
+(tests/golden/bench_gate_baseline.json) with the SAME machinery as
+`simon metrics --diff --fail-on-regression` (cli/main.py _diff_metrics +
+_BAD_WHEN_UP), so bad-direction drift fails CI: fresh compile-cache misses
+(a new shape bucket snuck into the warm path), stale-session re-encodes,
+sweep parity mismatches, retries/rollbacks/faults, dropped trace events.
+
+On top of the diff, a small set of families must be ABSOLUTELY zero in the
+fresh run — parity mismatches or guard containment events in a fault-free
+fixed workload are failures regardless of what the baseline says.
+
+Families that drift with the installed jax version (XLA backend compile
+counts/seconds) are excluded from both sides: the gate checks THIS repo's
+dispatch accounting, not jaxlib's compiler internals.
+
+Usage:
+  python tools/bench_gate.py --check     # CI gate (exit 1 on regression)
+  python tools/bench_gate.py --update    # regenerate the committed baseline
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("OPEN_SIMULATOR_MESH", "0")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE = os.path.join(REPO, "tests", "golden", "bench_gate_baseline.json")
+
+# Counter families that must be ZERO in the fresh gate run, full stop: the
+# workload injects no faults and runs parity-fuzzed, so any of these moving
+# is a live regression even if a (stale) baseline contained it.
+MUST_BE_ZERO = (
+    "simon_sweep_parity_mismatches_total",
+    "simon_serve_stale_sessions_total",
+    "simon_http_errors_total",
+    "simon_guard_watchdog_expiries_total",
+    "simon_guard_oom_bisections_total",
+    "simon_guard_failovers_total",
+    "simon_faults_injected_total",
+    "simon_retries_total",
+    "simon_commit_rollbacks_total",
+    "simon_scope_trace_dropped_total",
+    "simon_scope_sampler_errors_total",
+)
+
+# jax-version-dependent families excluded from the baseline diff (see
+# module docstring).
+VERSION_DEPENDENT = ("simon_xla_backend_compile",)
+
+
+def run_workloads() -> dict:
+    """The fixed gate workloads; returns the fresh serve row (the sweep's
+    effect lands in the shared registry)."""
+    from loadgen import run_loadgen
+
+    from open_simulator_tpu.sweep import SweepRunner, load_spec
+
+    args = argparse.Namespace(
+        nodes=600, base_load=0.5, duration=1.5, concurrency=4,
+        window_ms=2.0, fanout=4, templates=8, parity_sample=2,
+        churn=True, http=False, scope_window=1.0, out="")
+    row = run_loadgen(args)
+    if row["errors"] or not row["parity_ok"]:
+        raise SystemExit(f"gate serve workload failed: {row}")
+    spec = load_spec(os.path.join(REPO, "examples", "sweeps",
+                                  "zone-outage.yaml"))
+    runner = SweepRunner(spec, parity="full")
+    runner.run()
+    return row
+
+
+def fresh_snapshot() -> dict:
+    from open_simulator_tpu.obs import REGISTRY
+
+    return filter_snapshot(REGISTRY.snapshot())
+
+
+def filter_snapshot(snap: dict) -> dict:
+    return {name: fam for name, fam in snap.items()
+            if not any(name.startswith(p) for p in VERSION_DEPENDENT)}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = parser.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--check", action="store_true",
+                      help="run the gate against the committed baseline")
+    mode.add_argument("--update", action="store_true",
+                      help="regenerate the committed baseline snapshot")
+    args = parser.parse_args(argv)
+
+    row = run_workloads()
+    snap = fresh_snapshot()
+    print(f"gate serve row: {row['value']} req/s, "
+          f"{row['requests']} requests, parity_ok={row['parity_ok']}")
+
+    if args.update:
+        with open(BASELINE, "w") as f:
+            json.dump(snap, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"bench gate baseline written: {BASELINE}")
+        return 0
+
+    from open_simulator_tpu.obs import values_from_snapshot
+
+    vals = values_from_snapshot(snap)
+    hard_failures = []
+    for fam in MUST_BE_ZERO:
+        moved = {k: v for k, v in vals.items()
+                 if k.startswith(fam) and v != 0}
+        if moved:
+            hard_failures.append(f"{fam} nonzero in a fault-free gate "
+                                 f"run: {moved}")
+    try:
+        with open(BASELINE) as f:
+            base = filter_snapshot(json.load(f))
+    except OSError as e:
+        print(f"bench gate: no baseline ({e}); run --update and commit it",
+              file=sys.stderr)
+        return 1
+
+    # the satellite contract: the SAME diff surface as
+    # `simon metrics --diff --fail-on-regression`, A=baseline B=fresh
+    from open_simulator_tpu.cli.main import _diff_metrics
+
+    changed, regressions = _diff_metrics(base, snap, sys.stdout)
+    for msg in hard_failures:
+        print(f"GATE FAILURE: {msg}", file=sys.stderr)
+    if regressions:
+        print(f"bench gate: {regressions} regression-direction counter(s) "
+              f"grew vs {os.path.relpath(BASELINE, REPO)} (re-baseline "
+              f"with --update ONLY if the growth is intended)",
+              file=sys.stderr)
+    if hard_failures or regressions:
+        return 1
+    print(f"bench gate: OK ({changed} metric(s) changed, 0 regressions)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

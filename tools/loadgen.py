@@ -87,15 +87,19 @@ def run_loadgen(args) -> dict:
     if args.http:
         submit = _http_submit(svc, args)
 
-    # warmup: compile every lane-count bucket (1, 2, 4, ..., fanout) for the
-    # wave fast lane directly, then touch every template through the service
+    # warmup: intern every template FIRST (the group axis G reaches its
+    # final padded size), THEN compile every lane-count bucket (1, 2, 4,
+    # ..., fanout) at that final G. The other order leaves (G_final, S)
+    # holes that compile mid-measurement only when coalescing happens to
+    # form an S-lane batch — a timing-dependent compile-miss set the bench
+    # gate would flag as nondeterministic drift.
+    for pods in pool:
+        submit(pods)
     S = 1
     while S <= args.fanout:
         image.dispatch_sessions(
             [image.session(pool[i % len(pool)]) for i in range(S)])
         S *= 2
-    for pods in pool:
-        submit(pods)
     warm = [None] * args.concurrency
 
     def warm_lane(i):
@@ -108,30 +112,45 @@ def run_loadgen(args) -> dict:
     for t in ts:
         t.join()
 
-    stop_at = time.monotonic() + args.duration
-    lat: list = []
-    counts = [0] * args.concurrency
     errors: list = []
     lock = threading.Lock()
 
-    def client(ci: int) -> None:
-        rng = np.random.default_rng(1000 + ci)
-        local_lat = []
-        done = 0
-        while time.monotonic() < stop_at:
-            pods = pool[int(rng.integers(0, len(pool)))]
-            t1 = time.perf_counter()
-            try:
-                submit(pods)
-            except Exception as e:  # counted, never silent
-                with lock:
-                    errors.append(repr(e))
-                break
-            local_lat.append(time.perf_counter() - t1)
-            done += 1
-        with lock:
-            lat.extend(local_lat)
-            counts[ci] = done
+    def drive(duration: float, seed_base: int, err_sink: list = errors):
+        """One closed-loop window: C clients for `duration` seconds.
+        Returns (requests, wall_s, latencies_s). `err_sink` defaults to the
+        ROW's error list; the scoped window passes its own so a failure
+        there cannot blame the measured tracing-off workload."""
+        stop_at = time.monotonic() + duration
+        lat: list = []
+        counts = [0] * args.concurrency
+
+        def client(ci: int) -> None:
+            rng = np.random.default_rng(seed_base + ci)
+            local_lat = []
+            done = 0
+            while time.monotonic() < stop_at:
+                pods = pool[int(rng.integers(0, len(pool)))]
+                t1 = time.perf_counter()
+                try:
+                    submit(pods)
+                except Exception as e:  # counted, never silent
+                    with lock:
+                        err_sink.append(repr(e))
+                    break
+                local_lat.append(time.perf_counter() - t1)
+                done += 1
+            with lock:
+                lat.extend(local_lat)
+                counts[ci] = done
+
+        t_run = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(args.concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return sum(counts), time.perf_counter() - t_run, lat
 
     churn_stop = threading.Event()
 
@@ -151,18 +170,22 @@ def run_loadgen(args) -> dict:
                     {"type": "pod_delete", "namespace": "default",
                      "name": f"pod-{900000 + i - 4:06d}"}] if i > 4 else []))
 
-    t_run = time.perf_counter()
-    threads = [threading.Thread(target=client, args=(i,))
-               for i in range(args.concurrency)]
     ch = threading.Thread(target=churner, daemon=True)
     if args.churn:
         ch.start()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
+    # the MEASURED window runs with simonscope OFF: the serve_whatif_rps
+    # row stays comparable across PRs, and the scoped window below reports
+    # its own rps so the overhead is an explicit column instead of silent
+    # drift. Batch/coalescing stats are COUNTER DELTAS around this window —
+    # warmup, parity-sample, and scope-window batches must not contaminate
+    # the row's lanes_mean.
+    from open_simulator_tpu.obs import REGISTRY
+
+    batches0 = REGISTRY.values().get("simon_serve_batches_total", 0)
+    n, wall, lat = drive(args.duration, seed_base=1000)
+    batches = int(REGISTRY.values().get("simon_serve_batches_total", 0)
+                  - batches0)
     churn_stop.set()
-    wall = time.perf_counter() - t_run
 
     # parity sample: resident answers vs the serial fresh-encode oracle
     parity_ok = True
@@ -174,17 +197,45 @@ def run_loadgen(args) -> dict:
                 or got["utilization"] != want["utilization"]):
             parity_ok = False
             errors.append(f"parity mismatch: {got} != {want}")
+
+    # simonscope window: a second (shorter) scoped run on the same warm
+    # image, measuring (a) the queue/dispatch/fetch latency decomposition
+    # the bench row now carries and (b) the tracing-on rps for the <=10%
+    # overhead gate (tools/scope_smoke.py enforces it; the row reports it)
+    scope_cols: dict = {}
+    if args.scope_window > 0:
+        from open_simulator_tpu.obs import scope as scope_mod
+
+        sc = scope_mod.enable(sampler=True, sampler_interval_s=0.5)
+        scope_errors: list = []
+        n_on, wall_on, _ = drive(args.scope_window, seed_base=5000,
+                                 err_sink=scope_errors)
+        rps_on = n_on / wall_on if wall_on > 0 else 0.0
+        snap = sc.slo.snapshot()["endpoints"].get("whatif", {})
+        phases = snap.get("phases", {})
+        rps_off_est = n / wall if wall > 0 else 0.0
+        scope_cols = {
+            **{f"{ph}_ms_{q}": phases.get(ph, {}).get(f"{q}_ms", 0.0)
+               for ph in ("queue", "dispatch", "fetch")
+               for q in ("p50", "p99")},
+            "scope_rps": round(rps_on, 1),
+            "scope_overhead_frac": round(
+                max(0.0, 1.0 - rps_on / rps_off_est)
+                if rps_off_est > 0 else 0.0, 4),
+            "scope_trace_events": (sc.stats()["trace_events"]
+                                   + sc.stats()["trace_requests"]),
+            "scope_errors": len(scope_errors),
+            "scope_error_sample": scope_errors[:3],
+        }
+        scope_mod.disable()
     svc.stop()
 
-    n = sum(counts)
     lat_ms = sorted(x * 1000.0 for x in lat)
 
     def pct(p: float) -> float:
         if not lat_ms:
             return 0.0
         return round(lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))], 3)
-
-    from open_simulator_tpu.obs import REGISTRY
 
     vals = REGISTRY.values()
     rps = n / wall if wall > 0 else 0.0
@@ -207,13 +258,16 @@ def run_loadgen(args) -> dict:
         "churn": bool(args.churn),
         "image_build_s": round(build_s, 3),
         "epoch": image.epoch,
-        "batches": int(vals.get("simon_serve_batches_total", 0)),
-        "lanes_mean": round(
-            n / max(1.0, vals.get("simon_serve_batches_total", 1)), 2),
+        "batches": batches,
+        "lanes_mean": round(n / max(1, batches), 2),
         "seed_refreshes": int(
             vals.get("simon_serve_seed_refreshes_total", 0)),
+        **scope_cols,
         "parity_ok": parity_ok,
         "backend": "default",
+        # the full flat registry dump rides the row (like bench.py's rows):
+        # tools/bench_gate.py diffs it against the committed baseline
+        "obs_metrics": vals,
     }
 
 
@@ -280,6 +334,12 @@ def main(argv=None) -> int:
     parser.add_argument("--http", action="store_true",
                         help="drive through the real HTTP stack instead of "
                              "in-process submit")
+    parser.add_argument("--scope-window", type=float, default=2.0,
+                        metavar="S",
+                        help="after the measured (tracing-off) window, run a "
+                             "scoped window of S seconds for the "
+                             "queue/dispatch/fetch breakdown columns and the "
+                             "tracing-on rps (0 disables; default 2)")
     parser.add_argument("--out", default="",
                         help="merge the row into this BENCH_DETAIL.json")
     args = parser.parse_args(argv)

@@ -1,0 +1,261 @@
+"""CI smoke for simonscope (obs/scope.py): tracing-grade serving checks.
+
+In-process serve stack (resident image + micro-batch dispatcher) under
+16-concurrent load with tracing ON, asserting the acceptance contract:
+
+1. **Span/counter reconciliation** — every request produces one complete
+   span tree (request:whatif root + queue_wait + reply for batched routes),
+   the root-span count equals both simon_scope_requests_total and the SLO
+   engine's total-phase histogram count, the summed root-span total_s equals
+   the histogram sum (same floats), flow start/finish events pair up, and
+   serve_batch spans equal the simon_serve_batches_total delta.
+2. **Trace-off bit-identity** — the same request set served with scope off
+   returns identical responses (placements), and moves NO simon_scope_*
+   metric sample (scope-off /metrics output byte-identical in the scope
+   families).
+3. **Sampler shutdown** — scope.disable() joins the telemetry thread;
+   no 'simon-scope-sampler' thread survives.
+4. **Overhead gate** — tracing on sustains >= (1 - GATE) x the tracing-off
+   request rate on the same host (GATE defaults to the ISSUE's 10%;
+   OPEN_SIMULATOR_SCOPE_GATE overrides for noisy hosts).
+5. **Perfetto-loadable trace** — the dumped Chrome trace parses, and every
+   batched request's span tree is complete.
+
+Run: JAX_PLATFORMS=cpu OPEN_SIMULATOR_MESH=0 python tools/scope_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+CONCURRENCY = 16
+# 4k nodes: per-request device work in the single-digit-ms range, the same
+# order as the serve_whatif_rps bench row the ISSUE's <=10% gate is stated
+# against. At toy node counts a request is ~0.6ms and the fixed ~15us of
+# per-request tracing work reads as an inflated 6-8% "overhead" that says
+# nothing about the serve row.
+NODES = 4000
+WINDOW_S = 3.0
+GATE = float(os.environ.get("OPEN_SIMULATOR_SCOPE_GATE", "0.10"))
+
+
+def drive(svc, pool, duration_s: float, seed_base: int):
+    """Closed-loop window: CONCURRENCY clients, returns (requests, wall_s,
+    responses-by-template)."""
+    import numpy as np
+
+    stop_at = time.monotonic() + duration_s
+    counts = [0] * CONCURRENCY
+    errors: list = []
+    lock = threading.Lock()
+
+    def client(ci: int) -> None:
+        rng = np.random.default_rng(seed_base + ci)
+        done = 0
+        while time.monotonic() < stop_at:
+            try:
+                svc.submit(pool[int(rng.integers(0, len(pool)))])
+            except Exception as e:
+                with lock:
+                    errors.append(repr(e))
+                break
+            done += 1
+        counts[ci] = done
+
+    t0 = time.perf_counter()
+    ts = [threading.Thread(target=client, args=(i,))
+          for i in range(CONCURRENCY)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors, f"request errors under load: {errors[:3]}"
+    return sum(counts), time.perf_counter() - t0
+
+
+def scope_sample_lines() -> list:
+    """Rendered simon_scope_* SAMPLE lines (HELP/TYPE headers excluded:
+    registering a family is free, emitting samples is what scope-off must
+    never do)."""
+    from open_simulator_tpu.obs import REGISTRY
+
+    return [l for l in REGISTRY.render_text().splitlines()
+            if l.startswith("simon_scope_") and not l.startswith("#")]
+
+
+def main() -> int:
+    from loadgen import build_image, request_pool
+
+    from open_simulator_tpu.obs import REGISTRY
+    from open_simulator_tpu.obs import scope
+    from open_simulator_tpu.serve import WhatIfService
+
+    image = build_image(NODES, base_load_frac=0.3)
+    svc = WhatIfService(image, window_ms=2.0, fanout=8)
+    pool = request_pool(12)
+
+    # warm every template first (final group axis), then every lane bucket
+    # at that G — same ordering rationale as tools/loadgen.py
+    for pods in pool:
+        svc.submit(pods)
+    s = 1
+    while s <= 8:
+        image.dispatch_sessions(
+            [image.session(pool[i % len(pool)]) for i in range(s)])
+        s *= 2
+
+    # throwaway window: concurrent-load shapes (lane buckets hit under real
+    # contention) finish compiling before anything is measured
+    drive(svc, pool, 1.5, seed_base=900)
+
+    # ---- trace-off: responses recorded + scope families stay silent
+    assert scope.active() is None
+    off_responses = [svc.submit(pods) for pods in pool]
+    leaked = scope_sample_lines()
+    assert not leaked, (
+        f"scope-off run emitted simon_scope_* samples (byte-identity "
+        f"broken): {leaked[:4]}")
+
+    # ---- bit-identity under tracing: same requests, same answers
+    sc = scope.enable(sampler=False)
+    on_responses = [svc.submit(pods) for pods in pool]
+    assert on_responses == off_responses, (
+        "tracing changed responses: placements must be bit-identical "
+        f"({on_responses[0]} vs {off_responses[0]})")
+    scope.disable()
+
+    # ---- overhead measurement: ALTERNATING off/on window pairs, gated on
+    # the median pairwise overhead. A single off->on comparison is
+    # confounded on a 1-core CI host: throughput drifts several percent
+    # between windows regardless of tracing, so each on-window is judged
+    # against its adjacent off-window and the median damps the noise.
+    import gc
+    import statistics
+
+    pair_overheads = []
+    n_on = 0
+    rps_off = rps_on = 0.0
+    vals0 = vals1 = None
+    for i in range(3):
+        gc.collect()
+        a_n, a_wall = drive(svc, pool, WINDOW_S, seed_base=100 + i)
+        sc = scope.enable(sampler=True, sampler_interval_s=0.5)
+        if i == 2:  # the reconciliation pair: metric deltas must cover
+            vals0 = REGISTRY.values()  # exactly this scope's trace buffer
+        gc.collect()
+        b_n, b_wall = drive(svc, pool, WINDOW_S, seed_base=100 + i)
+        if i == 2:
+            vals1 = REGISTRY.values()
+            n_on = b_n
+        pair_overheads.append(1.0 - (b_n / b_wall) / (a_n / a_wall))
+        rps_off, rps_on = a_n / a_wall, b_n / b_wall
+        if i < 2:
+            # tear scope down between pairs so the next off-window is a
+            # true off-window; the LAST scope stays alive for the
+            # reconciliation checks below
+            scope.disable()
+    n_off = a_n
+    overhead = statistics.median(pair_overheads)
+
+    # ---- span/counter reconciliation
+    events = sc.events()
+    roots = [e for e in events if e.get("cat") == "request"
+             and e["name"] == "request:whatif"]
+    queue_spans = [e for e in events if e["name"] == "queue_wait"]
+    reply_spans = [e for e in events if e["name"] == "reply"]
+    batch_spans = [e for e in events if e["name"] == "serve_batch"]
+    flows_s = [e for e in events if e.get("cat") == "flow"
+               and e.get("ph") == "s"]
+    flows_f = [e for e in events if e.get("cat") == "flow"
+               and e.get("ph") == "f"]
+    assert len(roots) == n_on, (len(roots), n_on)
+
+    d_req = (vals1.get('simon_scope_requests_total{endpoint="whatif",'
+                       'route="batched"}', 0)
+             - vals0.get('simon_scope_requests_total{endpoint="whatif",'
+                         'route="batched"}', 0))
+    batched_roots = [e for e in roots if e["args"].get("route") == "batched"]
+    assert len(batched_roots) == d_req, (len(batched_roots), d_req)
+    assert len(queue_spans) == len(batched_roots), (
+        len(queue_spans), len(batched_roots))
+    assert len(reply_spans) == len(roots), (len(reply_spans), len(roots))
+    assert len(flows_s) == len(flows_f) == len(batched_roots), (
+        len(flows_s), len(flows_f), len(batched_roots))
+    d_batches = (vals1.get("simon_serve_batches_total", 0)
+                 - vals0.get("simon_serve_batches_total", 0))
+    assert len(batch_spans) == d_batches, (len(batch_spans), d_batches)
+    # every batched root's span tree is complete: queue_wait + reply share
+    # its trace id
+    by_trace: dict = {}
+    for e in events:
+        t = (e.get("args") or {}).get("trace_id")
+        if t is not None:
+            by_trace.setdefault(t, set()).add(e["name"])
+    for e in batched_roots:
+        names = by_trace[e["args"]["trace_id"]]
+        assert {"queue_wait", "reply"} <= names, names
+    # histogram sums reconcile with the span totals (same floats)
+    span_total = math.fsum(e["args"]["total_s"] for e in roots)
+    hist_sum = (vals1.get('simon_scope_request_phase_seconds_sum'
+                          '{endpoint="whatif",phase="total"}', 0.0)
+                - vals0.get('simon_scope_request_phase_seconds_sum'
+                            '{endpoint="whatif",phase="total"}', 0.0))
+    assert abs(span_total - hist_sum) <= 1e-9 * max(1.0, abs(span_total)), (
+        span_total, hist_sum)
+    hist_n = (vals1.get('simon_scope_request_phase_seconds_count'
+                        '{endpoint="whatif",phase="total"}', 0)
+              - vals0.get('simon_scope_request_phase_seconds_count'
+                          '{endpoint="whatif",phase="total"}', 0))
+    assert hist_n == len(roots), (hist_n, len(roots))
+
+    # ---- perfetto-loadable dump
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "scope-trace.json")
+        sc.write_trace(path, metrics=REGISTRY.snapshot())
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["traceEvents"], "empty trace"
+        assert "slo" in doc["metadata"]
+
+    # ---- sampler shutdown leaves no thread
+    assert any(t.name == "simon-scope-sampler" for t in threading.enumerate())
+    scope.disable()
+    deadline = time.monotonic() + 5
+    while (any(t.name == "simon-scope-sampler"
+               for t in threading.enumerate())
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    assert not any(t.name == "simon-scope-sampler"
+                   for t in threading.enumerate()), (
+        "sampler thread survived scope.disable()")
+
+    svc.stop()
+
+    # ---- overhead gate
+    print(json.dumps({
+        "requests_off": n_off, "rps_off": round(rps_off, 1),
+        "requests_on": n_on, "rps_on": round(rps_on, 1),
+        "pair_overheads": [round(o, 4) for o in pair_overheads],
+        "overhead_frac": round(overhead, 4), "gate": GATE,
+        "spans": len(roots), "batches": len(batch_spans),
+        "flows": len(flows_s) + len(flows_f),
+    }))
+    assert overhead <= GATE, (
+        f"median tracing overhead {overhead:.1%} exceeds the {GATE:.0%} "
+        f"gate (pairs: {[f'{o:.1%}' for o in pair_overheads]})")
+    print("scope smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
