@@ -13,6 +13,9 @@ BENCH_DETAIL.json:
     anti-affinity + zone topology spread (wave + fused group-serial segments)
   - mesh8_cpu:           the mesh-sharded product path on an 8-device virtual
     CPU mesh, with a placements-match check against single-device
+  - mesh8_1m / mesh8_10m: the scale rows (1M pods / 100k nodes and
+    10M pods / 1M nodes) on the columnar host path — PodStore/NodeStore
+    template blocks, vectorized bulk commit (simulator/store.py)
   - capacity_plan_100k:  config 5, add-node auto-search until 100k pods fit
   - sweep_scenarios_256x10k: simonsweep — 256 what-if scenarios x 10k pods
     batched on the scenario axis vs a serial per-scenario Simulator loop,
@@ -188,7 +191,7 @@ def bench_capacity_plan(n_pods=100_000, repeats=1):
 
 
 def bench_mesh_cpu(n_nodes=1_000, n_pods=10_000, shards=8, hard=False,
-                   check_single=True, repeats=2, timeout=900):
+                   check_single=True, repeats=2, timeout=900, store=False):
     """Mesh-sharded product path on a virtual CPU mesh, in a subprocess (the
     CPU device count must be set before backend init). Measurement protocol
     matches bench_throughput exactly — fresh synth inputs per repeat, the
@@ -213,7 +216,7 @@ sys.path.insert(0, {repr(REPO)})
 from open_simulator_tpu.utils.devices import force_cpu_platform, request_cpu_devices
 request_cpu_devices({shards})
 force_cpu_platform()
-from open_simulator_tpu.utils.synth import synth_cluster
+from open_simulator_tpu.utils.synth import synth_cluster, synth_cluster_store
 from open_simulator_tpu.simulator.engine import Simulator
 from open_simulator_tpu.simulator.encode import scheduling_signature
 from open_simulator_tpu.obs import REGISTRY
@@ -226,19 +229,31 @@ def census(sim):
             out[key] = out.get(key, 0) + 1
     return out
 
-def one_run(use_mesh):
-    nodes, pods = synth_cluster({n_nodes}, {n_pods}, hard_predicates={hard})
+def one_run(use_mesh, want_census):
+    # store=True rides the columnar host path (simulator/store.py): the
+    # workload is template blocks, encode is one gather per template, and
+    # the commit is one bulk array pass — at 1M+ pods the dict form is the
+    # thing being replaced (and at 10M it does not fit in host memory)
+    if {store}:
+        nodes, pods = synth_cluster_store({n_nodes}, {n_pods},
+                                          hard_predicates={hard})
+    else:
+        nodes, pods = synth_cluster({n_nodes}, {n_pods},
+                                    hard_predicates={hard})
     sim = Simulator(nodes, use_mesh=use_mesh)
     t0 = time.perf_counter()
     failed = sim.schedule_pods(pods)
     dt = time.perf_counter() - t0
-    total = sum(len(p) for p in sim.pods_on_node)
-    return dt, total, total + len(failed), census(sim)
+    total = sim.pods_on_node.total()
+    # census materializes every placed pod (the lazy read-back boundary):
+    # only compute it when a single-device comparison will consume it
+    c = census(sim) if want_census else None
+    return dt, total, total + len(failed), c
 
 best = None
 n_runs = {repeats} + 1
 for _ in range(n_runs):  # first run pays the distributed compile
-    dt, placed, total, mesh_census = one_run(True)
+    dt, placed, total, mesh_census = one_run(True, {check_single})
     if best is None or dt < best[0]:
         best = (dt, placed, total, mesh_census)
 dt, placed, total, mesh_census = best
@@ -251,7 +266,7 @@ reshard = int(vals.get("simon_reshard_bytes_total") or 0)
 transfer = int(vals.get("simon_device_transfer_bytes_total") or 0) // n_runs
 match = True
 if {check_single}:
-    _, _, _, single_census = one_run(False)
+    _, _, _, single_census = one_run(False, True)
     match = single_census == mesh_census
 print(json.dumps({{
     "rate": placed / dt, "wall_s": dt, "scheduled": placed, "total": total,
@@ -463,10 +478,27 @@ def _row_mesh8_1m():
     program (the 'millions of users' shape, ~10x the north star). One timed
     run — at this size the single-device comparison would double a
     multi-minute row, and bit-identity is already asserted per-route by the
-    10k mesh rows, tests/test_mesh_sharding.py, and tools/mesh_smoke.py."""
+    10k mesh rows, tests/test_mesh_sharding.py, and tools/mesh_smoke.py.
+    Rides the columnar host path (store=True): workload as PodStore/NodeStore
+    template blocks, vectorized bulk commit — the dict-path encode/commit
+    loops were ~60% of this row's wall (ROADMAP item 2); double-encode
+    bit-identity columnar==dict is tests/test_store.py's job."""
     row = _mesh_row("mesh8_1m_pods_per_sec_1m_pods_100k_nodes",
                     n_nodes=100_000, n_pods=1_000_000, check_single=False,
-                    repeats=1, timeout=2700)
+                    repeats=1, timeout=2700, store=True)
+    row["placements_match_single_device"] = None  # not run at this size
+    return row
+
+
+def _row_mesh8_10m():
+    """Planet scale: 10M pods onto 1M nodes. Only expressible on the
+    columnar host path — 10M pod dicts alone would need ~25GB of host
+    memory before the first encode; the store holds the batch as template
+    blocks + three [P] columns (~200MB). One timed run, no single-device
+    comparison (same policy as the 1M row)."""
+    row = _mesh_row("mesh8_10m_pods_per_sec_10m_pods_1m_nodes",
+                    n_nodes=1_000_000, n_pods=10_000_000, check_single=False,
+                    repeats=0, timeout=2700, store=True)
     row["placements_match_single_device"] = None  # not run at this size
     return row
 
@@ -612,6 +644,7 @@ METRICS = [
     ("mesh8", _row_mesh8, 1200, False),
     ("mesh8_hard", _row_mesh8_hard, 1800, False),
     ("mesh8_1m", _row_mesh8_1m, 3000, False),
+    ("mesh8_10m", _row_mesh8_10m, 3000, False),
     ("capacity", _row_capacity, 1800, True),
     ("sweep", _row_sweep, 3000, True),
 ]
@@ -674,8 +707,46 @@ def _probe_backend(timeout: float, probe_log: list) -> bool:
     return ok
 
 
+# Benign XLA:CPU chatter that buries real bench output: the cpu_aot_loader
+# machine-feature mismatch warning is ~2KB of feature-list spam per compile
+# (it means only "this AOT cache entry was compiled on a different CPU
+# model"). The driver that runs `python bench.py` records the stderr tail,
+# so drop these lines before they reach our stderr — everything else passes
+# through untouched.
+_XLA_NOISE_MARKERS = (
+    "cpu_aot_loader.cc",
+    "Machine type used for XLA:CPU compilation",
+    "Compile machine features:",
+    "Host machine features:",
+    "could lead to execution errors such as SIGILL",
+)
+
+
+def _is_xla_noise(line: str) -> bool:
+    return any(m in line for m in _XLA_NOISE_MARKERS)
+
+
+def _pump_stderr(pipe) -> None:
+    """Forward a child's stderr line-by-line, dropping the known-benign
+    XLA noise (see _XLA_NOISE_MARKERS)."""
+    try:
+        for line in pipe:
+            if not _is_xla_noise(line):
+                sys.stderr.write(line)
+                sys.stderr.flush()
+    except (OSError, ValueError):
+        pass
+    finally:
+        try:
+            pipe.close()
+        except OSError:
+            pass
+
+
 def _run_metric(name: str, timeout: float, force_cpu: bool) -> dict | None:
     """Run one metric in a subprocess; returns its row or None on failure."""
+    import threading
+
     env = dict(os.environ)
     if force_cpu:
         env.pop("JAX_PLATFORMS", None)
@@ -685,11 +756,18 @@ def _run_metric(name: str, timeout: float, force_cpu: bool) -> dict | None:
         # "default"-labeled rows into CPU runs
     child = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--metric", name],
-        stdout=subprocess.PIPE, stderr=sys.stderr, text=True, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
         start_new_session=True,
     )
+    out_buf: list = []
+    t_out = threading.Thread(
+        target=lambda: out_buf.append(child.stdout.read()), daemon=True)
+    t_err = threading.Thread(
+        target=_pump_stderr, args=(child.stderr,), daemon=True)
+    t_out.start()
+    t_err.start()
     try:
-        out, _ = child.communicate(timeout=timeout)
+        child.wait(timeout=timeout)
     except subprocess.TimeoutExpired:
         child.kill()
         try:
@@ -697,6 +775,9 @@ def _run_metric(name: str, timeout: float, force_cpu: bool) -> dict | None:
         except subprocess.TimeoutExpired:
             pass
         return None
+    t_out.join(timeout=10)
+    t_err.join(timeout=10)
+    out = out_buf[0] if out_buf else ""
     if child.returncode != 0:
         return None
     # the worker writes its row as the final fd-1 line, but scan backwards

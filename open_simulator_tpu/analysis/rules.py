@@ -15,6 +15,8 @@ Rule ids (stable — they appear in suppression comments and CI output):
   config-scope-across-thread  jax config scope entered in one thread, work
                      submitted to another inside it
   suppression-reason a `simonlint: ignore[...]` waiver without its `-- reason`
+  per-pod-host-loop  O(pods) Python `for` over a pod batch in a module that
+                     adopted the columnar PodStore
 
 Every rule is a pure function ModuleContext -> List[Finding]; file IO,
 suppressions, and exit-code policy live in runner.py.
@@ -1002,5 +1004,64 @@ def rule_suppression_reason(ctx: ModuleContext) -> List[Finding]:
             f"waiver ignore[{m.group(1).strip()}] carries no `-- reason` "
             f"text{where} — state why the hazard is deliberate so reviewers "
             f"can audit it",
+        ))
+    return out
+
+
+# --------------------------------------------------------- per-pod-host-loop --
+
+# Modules that have adopted the columnar pod store (simulator/store.py) are
+# held to its contract: batch-sized work is array ops over the store's
+# columns, and a Python `for` over the pod batch is the O(pods) host loop the
+# store exists to remove (the 1M-pod row spent ~60% of wall in exactly two
+# such loops before the rewrite). Applicability is structural — the module
+# imports `.store` / `..simulator.store` — so adopting the store opts a
+# module into the fence, and fallback loops that must remain (dict batches,
+# armed preemption, gpu/storage ledgers) carry reasoned waivers naming the
+# columnar path that replaces them.
+_POD_BATCH_NAMES = {"pods", "to_schedule", "batch", "request_pods"}
+
+
+def _module_imports_store(ctx: ModuleContext) -> bool:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.split(".")[-1] == "store" or any(
+                    a.name == "store" for a in node.names):
+                return True
+        elif isinstance(node, ast.Import):
+            if any(a.name.split(".")[-1] == "store" for a in node.names):
+                return True
+    return False
+
+
+@register(
+    "per-pod-host-loop", Severity.WARNING,
+    "A Python `for` over a pod batch (pods / to_schedule / batch) in a "
+    "module that has adopted the columnar PodStore. Each iteration is host "
+    "work that scales with the batch — the O(pods) dict traversal the "
+    "struct-of-arrays store exists to replace (encode is one gather per "
+    "template, commit is one bulk array pass). Vectorize over the store's "
+    "columns, or whitelist a deliberate fallback with "
+    "`# simonlint: ignore[per-pod-host-loop] -- <why>` naming the columnar "
+    "path that covers the hot case.",
+)
+def rule_per_pod_host_loop(ctx: ModuleContext) -> List[Finding]:
+    if not _module_imports_store(ctx):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.For):
+            continue
+        hits = _names_in(node.iter) & _POD_BATCH_NAMES
+        if not hits:
+            continue
+        out.append(Finding(
+            "per-pod-host-loop", Severity.WARNING, ctx.path,
+            node.lineno, node.col_offset,
+            f"`for` over {'/'.join(sorted(hits))} runs O(pods) Python in a "
+            f"store-adopted hot module — vectorize over the PodStore columns "
+            f"(EncodedRows gather / bulk commit) or waive the deliberate "
+            f"fallback with its reason",
         ))
     return out

@@ -38,6 +38,24 @@ ENCODE_SECONDS = histogram(
     "simon_encode_seconds",
     "Host-side batch encode time (pods -> device tables) per scheduling run.",
     buckets=SECONDS_BUCKETS)
+HOST_COMMIT_SECONDS = histogram(
+    "simon_host_commit_seconds",
+    "Host-side commit time per scheduling run: placements applied to the "
+    "placed census / per-node registry / pod state after the device fetch "
+    "(the encode/commit/device decomposition of "
+    "simon_e2e_scheduling_duration_seconds — ROADMAP item 2's 60%-of-wall "
+    "slice, now measured on every run).",
+    buckets=SECONDS_BUCKETS)
+ENCODE_BYTES = counter(
+    "simon_encode_bytes_total",
+    "Host bytes of encoded batch tables + carry seeds produced per "
+    "scheduling/probe run (batch_tables_nbytes at encode time; the "
+    "device-transfer counter tracks the same bytes at staging).")
+STREAM_CHUNKS = counter(
+    "simon_stream_chunks_total",
+    "Scheduling runs dispatched as streaming chunks "
+    "(OPEN_SIMULATOR_STREAM_PODS): host encode of chunk k+1 overlaps the "
+    "device dispatch of chunk k.")
 BATCH_PODS = histogram(
     "simon_batch_pods",
     "Pods per contiguous unbound scheduling run handed to the device.",

@@ -809,6 +809,11 @@ explain_jit = jax.jit(
 # the serial loop being replaced at scale).
 
 WAVE_BLOCK = 64  # B: max score-table depth = max copies per node per wave iteration
+# Score-table entry budget (N*B) above which wave_block_for damps the depth:
+# past ~2M entries the per-iteration sort dominates the dispatch (and the
+# sharded gather replicates it per shard). 2^21 leaves every <=10k-node
+# shape untouched and caps the 100k/1M-node rows at a sort XLA can chew.
+_WAVE_TABLE_BUDGET = 1 << 21
 
 
 def wave_block_for(m: int, n: int) -> int:
@@ -822,11 +827,24 @@ def wave_block_for(m: int, n: int) -> int:
     BELOW the flat floor-quantized score runs (~3 copies wide at millicore
     granularity; a depth-2 bound lands inside the run, equal to every
     visible score, and stalls takes to the head fallback). Pow2 bucketing
-    keeps the number of distinct compiled wave kernels small."""
+    keeps the number of distinct compiled wave kernels small.
+
+    Planet-scale damping: the [N, B] table is sorted (top_k ~ full sort on
+    CPU) every iteration, and under GSPMD sharding the sort's gather
+    replicates that work per shard — at 100k+ nodes the 8x-headroom table
+    made the sort THE wall clock of the mesh8_1m row (block 64 -> 16 cut
+    the warm 1M-pod dispatch 15.5s -> 5.4s, bit-identical placements).
+    Above _WAVE_TABLE_BUDGET entries the depth halves toward the floor of
+    8: correctness is depth-independent (see above), and the extra
+    iterations at floor depth are cheap next to a 4x smaller sort. Every
+    shape with N*B within budget (all the <=10k-node rows) keeps its exact
+    old block."""
     b = 8
     target = (8 * m + max(n, 1) - 1) // max(n, 1)
     while b < min(WAVE_BLOCK, target):
         b *= 2
+    while b > 8 and n * b > _WAVE_TABLE_BUDGET:
+        b //= 2
     return b
 
 

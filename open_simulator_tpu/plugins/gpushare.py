@@ -205,9 +205,16 @@ class GpuShareHost:
     """The host half of the plugin: replays allocations for committed pods."""
 
     def __init__(self, nodes: List[dict]) -> None:
-        self.states: List[Optional[GpuNodeState]] = [
-            GpuNodeState(n) if node_total_gpu_memory(n) > 0 else None for n in nodes
-        ]
+        store = getattr(nodes, "store", None)  # simulator/store.py LazyNodeSeq
+        if store is not None and not store.may_have_gpu:
+            # columnar fast path: no block template advertises GPU memory, so
+            # the per-node dict scan would materialize N dicts to learn that
+            self.states: List[Optional[GpuNodeState]] = [None] * len(nodes)
+        else:
+            self.states = [
+                GpuNodeState(n) if node_total_gpu_memory(n) > 0 else None
+                for n in nodes
+            ]
         self.max_devs = max((s.gpu_count for s in self.states if s), default=0)
         self._assume_seq = 0
         # nodes whose annotation/allocatable writeback is pending: the ledger

@@ -192,7 +192,13 @@ class OpenLocalHost:
 
     def __init__(self, nodes: List[dict]) -> None:
         self.nodes = nodes
-        self.states: List[Optional[NodeStorage]] = [get_node_storage(n) for n in nodes]
+        store = getattr(nodes, "store", None)  # simulator/store.py LazyNodeSeq
+        if store is not None and not store.may_have_local_storage:
+            # columnar fast path: no block template carries the node-local-
+            # storage annotation — skip the N-dict materializing scan
+            self.states: List[Optional[NodeStorage]] = [None] * len(nodes)
+        else:
+            self.states = [get_node_storage(n) for n in nodes]
         self.vg_names: Dict[str, int] = {}  # name -> id (1-based; 0 = unnamed)
         for st in self.states:
             if st:

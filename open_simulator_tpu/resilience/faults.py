@@ -205,6 +205,33 @@ class FaultPlan:
             obs.FAULTS_INJECTED.labels(site=site).inc()
             _raise_for(site, n, error)
 
+    def on_arrivals(self, site: str, count: int) -> None:
+        """Count `count` arrivals at once (the engine's bulk commit: one call
+        covers a whole segment's commits). Replay-equal to `count` serial
+        on_arrival calls: the counter advances by `count`, and the FIRST spec
+        whose attempt lands inside the advanced window fires — exactly the
+        arrival the per-event loop would have died on."""
+        if count <= 0:
+            return
+        with self._lock:
+            base = self.arrivals.get(site, 0)
+            fired = None
+            by_attempt = self._by_site.get(site)
+            if by_attempt:
+                for a in sorted(by_attempt):
+                    if base < a <= base + count:
+                        fired = (a, by_attempt[a])
+                        break
+            # the serial loop dies AT the firing arrival — the remaining
+            # count-a events never happen, so the counter must stop there
+            # too or a failover replay's window would skip later specs
+            self.arrivals[site] = fired[0] if fired else base + count
+            if fired is not None:
+                self.trace.append((site, fired[0], fired[1]))
+        if fired is not None:
+            obs.FAULTS_INJECTED.labels(site=site).inc()
+            _raise_for(site, fired[0], fired[1])
+
 
 # ---------------------------------------------------------------- activation ---
 
@@ -249,3 +276,11 @@ def maybe_fail(site: str) -> None:
     plan = _PLAN
     if plan is not None:
         plan.on_arrival(site)
+
+
+def maybe_fail_bulk(site: str, count: int) -> None:
+    """`count` arrivals in one call (bulk commit); free when no plan is
+    active, replay-equal to `count` maybe_fail calls otherwise."""
+    plan = _PLAN
+    if plan is not None:
+        plan.on_arrivals(site, count)

@@ -96,10 +96,14 @@ class WhatIfSession:
     is an active-mask row plus a per-lane valid mask plus (for drains) a
     privately adjusted seed copy, all assembled at dispatch time."""
 
-    def __init__(self, image: "ResidentImage", pods: List[dict],
+    def __init__(self, image: "ResidentImage", pods,
                  drains: Sequence[str]) -> None:
         self.image = image
-        self.pods = pods
+        # a columnar PodStore rides whole (its encode is one gather per
+        # template); dict batches are snapshotted as before
+        from ..simulator.store import is_pod_store
+
+        self.pods = pods if is_pod_store(pods) else list(pods)
         self.drains = tuple(drains)
         self.generation = image.generation
         self.seq = image.seq
@@ -147,14 +151,25 @@ class ResidentImage:
         if guard.default_quarantined():
             return None  # the image commits device buffers to the default
             # backend; with it wedged, serve runs fresh probes on the fallback
+        from ..simulator.store import NodeStore
+
         t0 = time.perf_counter()
-        sim = Simulator(list(nodes), sched_config=sched_config, use_mesh=False)
+        # a columnar NodeStore passes through whole (the engine adopts its
+        # columns); list() would materialize N dicts just to hand them over
+        sim = Simulator(nodes if isinstance(nodes, NodeStore) else list(nodes),
+                        sched_config=sched_config, use_mesh=False)
         if cluster_objects is not None:
             sim.register_cluster_objects(cluster_objects)
         if sim.local_host.enabled or sim.gpu_host.enabled:
             return None  # host-mirrored storage/gpu ledgers: the delta path
             # does not replay reserve()/seed_pod() bookkeeping
-        if any((n.get("status") or {}).get("images") for n in sim.na.nodes):
+        lazy_store = getattr(sim.na.nodes, "store", None)
+        if lazy_store is not None:
+            has_images = lazy_store.has_images
+        else:
+            has_images = any((n.get("status") or {}).get("images")
+                             for n in sim.na.nodes)
+        if has_images:
             return None  # ImageLocality divides by the TOTAL node count
 
         self = object.__new__(cls)
@@ -165,7 +180,7 @@ class ResidentImage:
         self._pod_index: Dict[str, Tuple[dict, int]] = {}
         self.drained: set = set()
         self._mesh = mesh if mesh is not None else self._auto_mesh()
-        for pod in pods:
+        for pod in pods:  # simonlint: ignore[per-pod-host-loop] -- identity-keyed pod index: delta ingest removes pods BY dict, so staging materializes by design
             node_name = (pod.get("spec") or {}).get("nodeName")
             if not node_name:
                 # unbound snapshot pods are request material, not cluster
@@ -508,9 +523,9 @@ class ResidentImage:
         with self._lock:
             return self._sim.encode_batch_ids(pods)
 
-    def session(self, pods: List[dict],
+    def session(self, pods,
                 drains: Sequence[str] = ()) -> WhatIfSession:
-        return WhatIfSession(self, list(pods), drains)
+        return WhatIfSession(self, pods, drains)
 
     def eligible(self, batch: List[Tuple[int, int]],
                  pods: List[dict]) -> Optional[str]:
@@ -520,12 +535,18 @@ class ResidentImage:
         SelectorSpread) are computed over the node CENSUS at encode time, so
         a masked-inactive node is not equivalent to an absent one for them;
         gpu/storage groups carry host-mirrored state the image declines."""
-        for pod in pods:
-            if (pod.get("spec") or {}).get("nodeName"):
+        from ..simulator.store import is_pod_store
+
+        if is_pod_store(pods):
+            if pods.bound_mask() is not None:
                 return "pre-bound pod"
+        else:
+            for pod in pods:  # simonlint: ignore[per-pod-host-loop] -- dict-request gate scan; PodStore requests take the bound_mask branch above
+                if (pod.get("spec") or {}).get("nodeName"):
+                    return "pre-bound pod"
         with self._lock:
             enc = self._sim.encoder
-            for gi, _ in batch:
+            for gi, _ in batch:  # simonlint: ignore[per-pod-host-loop] -- small request batches; the rows are already encoded ids
                 if gi >= len(enc.group_list):
                     # the image re-encoded from scratch under the caller:
                     # conservative fresh routing (dispatch_sessions would

@@ -61,7 +61,15 @@ class NodeArrays:
     """Vectorized view of the node list: per-label-key interned value columns, taints,
     allocatable matrix, zone/domain interning."""
 
-    def __init__(self, nodes: List[dict], axis: ResourceAxis) -> None:
+    def __init__(self, nodes, axis: ResourceAxis) -> None:
+        from .store import NodeStore
+
+        if isinstance(nodes, NodeStore):
+            # columnar fast path: adopt the store's block recipes directly —
+            # no per-node dict parsing, and `self.nodes` becomes a lazy view
+            # that materializes dicts only on indexed access
+            self._init_from_store(nodes, axis)
+            return
         self.nodes = nodes
         self.axis = axis
         self.N = len(nodes)
@@ -105,6 +113,95 @@ class NodeArrays:
         # topology domains: (topo key, node's value) interned globally
         self.domains = StringTable()
         self._dom_cache: Dict[str, np.ndarray] = {}
+
+    def _init_from_store(self, store, axis: ResourceAxis) -> None:
+        """Build every column from a NodeStore's block recipes. Content is
+        bit-identical to parsing the materialized dicts (the store parity
+        suite holds BatchTables to byte equality); internal string-table ids
+        may differ numerically, which no table ever observes — only equality
+        and first-appearance order matter, and both are preserved because
+        blocks are visited in node order."""
+        from .store import LazyNodeSeq
+
+        self.axis = axis
+        self.N = N = len(store)
+        self.nodes = LazyNodeSeq(store)
+        self.names = store.gen_names()
+        self.index = {nm: i for i, nm in enumerate(self.names)}
+        self.values = StringTable()
+        self.label_vals = {}
+        self.taints = []
+        self.unschedulable = np.zeros(N, bool)
+        alloc_rows: List[np.ndarray] = []
+        zid = np.zeros(N, np.int32)
+        self.zones = StringTable()
+        intern = self.values.intern
+        off = 0
+        for blk in store.blocks:
+            cnt = blk.count
+            end = off + cnt
+            # per-node labels first, in the same per-node visitation order a
+            # dict parse would use (hostname before index labels before
+            # constants matters only for interner id assignment, which is
+            # unobservable — see docstring)
+            host_col = self.label_vals.get(HOSTNAME)
+            if host_col is None:
+                host_col = self.label_vals[HOSTNAME] = np.zeros(N, np.int32)
+            for i in range(off, end):
+                host_col[i] = intern(self.names[i])
+            for k in blk.index_labels:
+                col = self.label_vals.get(k)
+                if col is None:
+                    col = self.label_vals[k] = np.zeros(N, np.int32)
+                for i in range(off, end):
+                    col[i] = intern(str(i))
+            for k, v in blk.labels:
+                col = self.label_vals.get(k)
+                if col is None:
+                    col = self.label_vals[k] = np.zeros(N, np.int32)
+                col[off:end] = intern(str(v))
+            if blk.zone_cycle is not None:
+                key, fmt, mod = blk.zone_cycle
+                col = self.label_vals.get(key)
+                if col is None:
+                    col = self.label_vals[key] = np.zeros(N, np.int32)
+                ids = np.array([intern(fmt.format(j)) for j in range(mod)],
+                               np.int32)
+                col[off:end] = ids[np.arange(off, end) % mod]
+            lbl = dict(blk.labels)
+            region = (lbl.get(C.LabelTopologyRegion)
+                      or lbl.get("failure-domain.beta.kubernetes.io/region")
+                      or "")
+            zone_keys = (C.LabelTopologyZone, C.LabelTopologyZoneBeta)
+            if blk.zone_cycle is not None and blk.zone_cycle[0] in zone_keys:
+                key, fmt, mod = blk.zone_cycle
+                zids = np.array(
+                    [self.zones.intern((region, fmt.format(j)))
+                     for j in range(mod)], np.int32)
+                zid[off:end] = zids[np.arange(off, end) % mod]
+            else:
+                zone = next((str(lbl[k]) for k in zone_keys if k in lbl), "")
+                if region or zone:
+                    zid[off:end] = self.zones.intern((region, zone))
+            if blk.taint is not None:
+                t, every = blk.taint
+                self.taints.extend(
+                    ((t,) if i % every == 0 else ())
+                    for i in range(off, end))
+            else:
+                self.taints.extend(() for _ in range(cnt))
+            self.unschedulable[off:end] = bool(
+                (blk.template.get("spec") or {}).get("unschedulable"))
+            alloc_rows.append(np.repeat(
+                axis.node_vector(blk.template)[None, :], cnt, axis=0))
+            off = end
+        self.name_ids = self.label_vals[HOSTNAME].copy() if N else np.zeros(
+            0, np.int32)
+        self.alloc = (np.concatenate(alloc_rows) if alloc_rows
+                      else np.zeros((0, axis.R)))
+        self.zone_id = zid
+        self.domains = StringTable()
+        self._dom_cache = {}
 
     def extend(self, nodes: List[dict]) -> None:
         """Append nodes IN PLACE — the serving image's delta-ingest path
@@ -808,6 +905,13 @@ class Encoder:
                      ("ReplicationController", "ReplicaSet")), None)
         if ctrl is None:
             return raw
+        from .store import LazyNodeSeq
+
+        if (isinstance(self.na.nodes, LazyNodeSeq)
+                and not self.na.nodes.store.any_annotation(
+                    "scheduler.alpha.kubernetes.io/preferAvoidPods")
+                and not self.na.nodes._extra):
+            return raw  # no block carries the annotation: skip the N-scan
         for i, node in enumerate(self.na.nodes):
             anno = annotations_of(node).get("scheduler.alpha.kubernetes.io/preferAvoidPods")
             if not anno:
@@ -830,6 +934,15 @@ class Encoder:
         cached = getattr(self, "_image_sizes_cache", None)
         if cached is not None:
             return cached
+        from .store import LazyNodeSeq
+
+        if (isinstance(self.na.nodes, LazyNodeSeq)
+                and not self.na.nodes.store.has_images
+                and not self.na.nodes._extra):
+            # columnar fast path: the store knows no block advertises images,
+            # so don't materialize N dicts to learn the same thing
+            self._image_sizes_cache = ([], False)
+            return self._image_sizes_cache
         sizes: List[Dict[str, float]] = []
         have_any = False
         for node in self.na.nodes:
@@ -1253,10 +1366,19 @@ def build_pod_axis_tables(
     pod_group = np.zeros(P_pad, np.int32)
     forced_node = np.full(P_pad, -1, np.int32)
     valid = np.zeros(P_pad, bool)
-    for i, (gi, fn) in enumerate(batch):
-        pod_group[i] = gi
-        forced_node[i] = fn
-        valid[i] = True
+    from .store import EncodedRows
+
+    if isinstance(batch, EncodedRows):
+        # columnar fast path (simulator/store.py): the store's encode is
+        # already two arrays — three vectorized copies, no per-pod loop
+        pod_group[:P] = batch.pod_group
+        forced_node[:P] = batch.forced_node
+        valid[:P] = True
+    else:
+        for i, (gi, fn) in enumerate(batch):  # simonlint: ignore[per-pod-host-loop] -- legacy list-of-tuples form; EncodedRows takes the vectorized branch
+            pod_group[i] = gi
+            forced_node[i] = fn
+            valid[i] = True
 
     return dict(
         grp_requests=(

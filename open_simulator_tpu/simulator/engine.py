@@ -66,6 +66,7 @@ from .encode import (
     scheduling_signature,
     strip_daemon_pin,
 )
+from .store import EncodedRows, NodeStore, PodStore, PodsOnNode, is_pod_store
 
 _jnp = None  # lazy jax import so host-only paths (ingestion, reports) stay jax-free
 
@@ -170,7 +171,16 @@ class Simulator:
         # (Create deep-copies): the plugins write annotations/allocatable back into
         # nodes, and repeated simulations over one caller-owned cluster (the
         # capacity planner's probes) must never see a previous run's mutations.
-        nodes = copy.deepcopy(nodes)
+        # A columnar NodeStore (simulator/store.py) is immutable by contract and
+        # materializes per-Simulator dict views, so the deepcopy is a no-op
+        # there — UNLESS a block declares gpu/local-storage state, whose
+        # host-mirrored ledgers write node annotations back: those clusters
+        # materialize to real dicts up front (correctness over speed).
+        if isinstance(nodes, NodeStore):
+            if nodes.may_have_gpu or nodes.may_have_local_storage:
+                nodes = [nodes.materialize(i) for i in range(len(nodes))]
+        else:
+            nodes = copy.deepcopy(nodes)
         from ..api.schedconfig import DEFAULT_SCHEDULER_CONFIG, KERNEL_FILTERS
         from ..utils.devices import enable_compilation_cache
 
@@ -188,7 +198,11 @@ class Simulator:
             for name, flag in KERNEL_FILTERS.items()
         })
         self.axis = ResourceAxis()
-        self.axis.discover(nodes, [])
+        if isinstance(nodes, NodeStore):
+            for k in nodes.resource_names():
+                self.axis.intern(k)
+        else:
+            self.axis.discover(nodes, [])
         self.model = ClusterModel()
         self.na = NodeArrays(nodes, self.axis)
         self.encoder = Encoder(self.na, self.axis, self.model)
@@ -202,7 +216,12 @@ class Simulator:
         self.local_host = OpenLocalHost(self.na.nodes)
         self.encoder.local_host = self.local_host
         self.placed: Dict[object, PlacedGroup] = {}  # signature → aggregated commits
-        self.pods_on_node: List[List[dict]] = [[] for _ in nodes]
+        # per-node placement registry: dict lists + columnar spans, lazy
+        # materialization on read-back (simulator/store.py PodsOnNode)
+        self.pods_on_node: PodsOnNode = PodsOnNode(self.na.N)
+        # pod-store bases with bulk-committed rows: the _sig_rec fallback for
+        # preemption bookkeeping the bulk path skips per-pod
+        self._bulk_stores: List[object] = []
         self.homeless: List[dict] = []  # bound to a node name we don't know
         # Preemption bookkeeping (simulator/preemption.py). _sig_of and
         # _commits_prio are maintained on every commit (a dict store + int
@@ -268,6 +287,16 @@ class Simulator:
         # blocks on every segment's result, so it is OFF unless asked for.
         self._segment_timing = _os.environ.get(
             "OPEN_SIMULATOR_SEGMENT_TIMING") == "1"
+        # Streaming segment encode (_schedule_run_streaming): runs longer
+        # than this many pods schedule as double-buffered chunks. 0 disables
+        # (monolithic runs); the default keeps every existing bench shape
+        # (<=100k-pod runs) on the single-dispatch path.
+        self._stream_explicit = "OPEN_SIMULATOR_STREAM_PODS" in _os.environ
+        try:
+            self._stream_chunk = max(0, int(_os.environ.get(
+                "OPEN_SIMULATOR_STREAM_PODS", "131072")))
+        except ValueError:  # pure-performance knob: fall back, don't crash
+            self._stream_chunk = 131072
         # simonxray (obs/xray.py): per-attempt staging for the flight
         # recorder. None unless recording is active — the off path costs one
         # None-check per schedule/probe call and nothing else (no extra
@@ -340,6 +369,141 @@ class Simulator:
         nc[node_i] = nc.get(node_i, 0) + 1
         pod.pop(SIG_MEMO_KEY, None)  # internal marker; keep result objects clean
         self.pods_on_node[node_i].append(pod)
+
+    # Commit-log sentinel: a bulk entry is ("__bulk__", store_view, rows) —
+    # preemption.restore resets the columns instead of walking pod dicts.
+    _BULK_LOG = "__bulk__"
+
+    def _placed_group_for_template(self, b, ti: int) -> PlacedGroup:
+        """The PlacedGroup for one store template (same record _commit_pod
+        would create from the first committed replica — selector matching
+        reads template fields only, so the shared template is an exact
+        representative)."""
+        sig = b.sigs[ti]
+        pg = self.placed.get(sig)
+        if pg is None:
+            tmpl = b.templates[ti]
+            pg = self.placed[sig] = PlacedGroup(
+                pod=tmpl,
+                sig=sig,
+                req_vec=self.axis.pod_vector(tmpl).astype(np.float32),
+                nonzero=pod_nonzero_cpu_mem(tmpl).astype(np.float32),
+                port_ids=self.encoder.port_ids(pod_host_ports(tmpl)),
+                carrier_ids=[self.encoder.carrier_id(cs)
+                             for cs in carried_specs_of_pod(tmpl)],
+            )
+        return pg
+
+    def _sig_rec(self, pod: dict) -> Optional[tuple]:
+        """(signature, node_i, commit_seq) for any placed pod — the per-pod
+        _sig_of row when one exists, else the columnar record of a
+        bulk-committed store row (preemption's victim bookkeeping)."""
+        rec = self._sig_of.get(id(pod))
+        if rec is not None:
+            return rec
+        for b in self._bulk_stores:
+            row = b.row_by_id.get(id(pod))
+            if row is not None and b.node_of[row] >= 0:
+                seq = (int(b.commit_seq[row])
+                       if b.commit_seq is not None else -1)
+                return (b.sigs[int(b.tmpl_of[row])], int(b.node_of[row]), seq)
+        return None
+
+    def _commit_store_bulk(self, store: PodStore, bt: BatchTables,
+                           choices: np.ndarray, P: int, seg_of: np.ndarray,
+                           seg_carry_of: Dict[int, object], final_carry,
+                           tables) -> List[UnscheduledPod]:
+        """Apply a whole run's placements to host state as array ops — the
+        columnar replacement for P calls to _commit_pod. Ordering contracts
+        that keep it bit-identical to the per-pod loop (the double-encode
+        parity suite's commit half):
+        - PlacedGroup.node_counts keys are inserted in first-appearance order
+          of (template, node) over the pod sequence — exactly the order the
+          per-pod loop would have inserted them, so the f32 seed accumulation
+          order in build_node_axis_tables is unchanged;
+        - per-node span rows are in pod order (stable sort by node);
+        - _commits_prio grows by the committed rows in pod order.
+        Failures materialize — an unschedulable pod is read back by
+        definition (its dict rides the UnscheduledPod record)."""
+        b = store.base
+        failed: List[UnscheduledPod] = []
+        ch = np.asarray(choices[:P])
+        mask = ch >= 0
+        n = int(mask.sum())
+        if n:
+            faults.maybe_fail_bulk("commit", n)
+            self._commit_events += n
+            rows_abs = np.flatnonzero(mask).astype(np.int64) + store.lo  # simonlint: ignore[dtype-drift] -- host-side fancy index, never shipped to device
+            nodes = ch[mask].astype(np.int64)  # simonlint: ignore[dtype-drift] -- host-side aggregation key, never shipped to device
+            b.node_of[rows_abs] = nodes.astype(np.int32)
+            b.node_names = self.na.names
+            b.frozen = True  # committed columns: no more add_block
+            if not any(s is b for s in self._bulk_stores):
+                self._bulk_stores.append(b)
+            seq = store.ensure_commit_seq()
+            seq0 = len(self._commits_prio)
+            seq[rows_abs] = seq0 + np.arange(n, dtype=np.int64)  # simonlint: ignore[dtype-drift] -- host-side commit-order column
+            tids = b.tmpl_of[rows_abs].astype(np.int64)  # simonlint: ignore[dtype-drift] -- host-side aggregation key
+            utids = np.unique(tids)
+            if len(utids) == 1:
+                import itertools
+
+                self._commits_prio.extend(itertools.repeat(
+                    int(b.tmpl_priority[int(utids[0])]), n))
+            else:
+                prio_map = np.array(b.tmpl_priority, np.int64)  # simonlint: ignore[dtype-drift] -- host-side priority map
+                self._commits_prio.extend(prio_map[tids].tolist())
+            # a dict materialized BEFORE this commit must reflect it now;
+            # its pre-commit nodeName/status ride the bulk log entry so a
+            # rollback restores the exact objects (the per-pod log's
+            # caller-owned-dict contract)
+            patched = []
+            for r, d in store.cached_rows_in(rows_abs):
+                spec_d = d.setdefault("spec", {})
+                patched.append((r, spec_d.get("nodeName"), d.get("status")))
+                spec_d["nodeName"] = self.na.names[int(b.node_of[r])]
+                d["status"] = {"phase": "Running"}
+            if self._preempt_armed or self._txn_armed:
+                self._commit_log.append(
+                    (self._BULK_LOG, store, rows_abs, patched))
+            # placed census: (template, node) counts in first-appearance order
+            span = self.na.N + 1
+            key = tids * span + nodes
+            uniq, first, counts = np.unique(
+                key, return_index=True, return_counts=True)
+            for j in np.argsort(first, kind="stable").tolist():
+                k = int(uniq[j])
+                pg = self._placed_group_for_template(b, k // span)
+                node_i = k % span
+                pg.node_counts[node_i] = (
+                    pg.node_counts.get(node_i, 0) + int(counts[j]))
+            # per-node spans, rows in pod order within each node
+            order = np.argsort(nodes, kind="stable")
+            sn = nodes[order]
+            sr = rows_abs[order]
+            bounds = np.flatnonzero(np.diff(sn)) + 1
+            starts = np.concatenate([[0], bounds])
+            root = PodStore(b)
+            pon = self.pods_on_node
+            for nid, rows_chunk in zip(
+                    sn[starts].tolist(), np.split(sr, bounds)):
+                pon[int(nid)].add_span(root, rows_chunk)
+        if n < P:
+            reason_cache: Dict[Tuple[int, int, int], Dict[str, int]] = {}
+            for i in np.flatnonzero(~mask).tolist():
+                pod = store[i]
+                key = (int(bt.pod_group[i]), int(bt.forced_node[i]),
+                       int(seg_of[i]))
+                reasons = reason_cache.get(key)
+                if reasons is None:
+                    reasons = reason_cache[key] = self._explain_reasons(
+                        pod, key[0], key[1], tables,
+                        seg_carry_of.get(key[2], final_carry))
+                pod.pop(SIG_MEMO_KEY, None)
+                obs.record_filter_reasons(reasons)
+                failed.append(UnscheduledPod(
+                    pod, self._format_reason(pod, reasons, self.na.N)))
+        return failed
 
     def register_cluster_objects(self, rt: ResourceTypes) -> None:
         m = self.model
@@ -593,8 +757,12 @@ class Simulator:
             if uncounted > 0:
                 obs.COMMITS.inc(uncounted)
             restore(self, snap)
-            for p in memo_pods or ():
-                p.pop(SIG_MEMO_KEY, None)
+            # store batches never carry per-pod memos (templates do,
+            # transiently) — iterating one here would materialize the whole
+            # batch as dicts mid-failover, the exact cost the store removes
+            if not is_pod_store(memo_pods):
+                for p in memo_pods or ():
+                    p.pop(SIG_MEMO_KEY, None)
             raise
         else:
             # rollback info is only reachable within this call's restores;
@@ -612,11 +780,17 @@ class Simulator:
         if getattr(self.sched_config, "preemption_disabled", False):
             return False
         seen = self._priority_seen
-        seen.update((p.get("spec") or {}).get("priority") or 0 for p in pods)
+        if is_pod_store(pods):
+            seen.update(pods.priorities_present())
+        else:
+            seen.update((p.get("spec") or {}).get("priority") or 0
+                        for p in pods)
         self._preempt_armed = len(seen) > 1
         return self._preempt_armed
 
     def _schedule_pods_inner(self, pods: List[dict]) -> List[UnscheduledPod]:
+        if is_pod_store(pods):
+            return self._schedule_store_inner(pods)
         from ..utils.trace import Progress
 
         failed: List[UnscheduledPod] = []
@@ -628,7 +802,7 @@ class Simulator:
         self._progress = progress if progress.enabled else None
         xr = self._xray_run
         direct = None  # lazy xray batch for pre-bound/homeless direct commits
-        for pod in pods:
+        for pod in pods:  # simonlint: ignore[per-pod-host-loop] -- dict-batch run split; PodStore batches take _schedule_store_inner
             node_name = (pod.get("spec") or {}).get("nodeName")
             if not node_name:
                 run.append(pod)
@@ -657,6 +831,56 @@ class Simulator:
                     direct.add_pod(xray.pod_key(pod), xray.BOUND, ni, -1, -1)
         failed.extend(self._schedule_run(run))
         progress.close()
+        if self.gpu_host.enabled:
+            self.gpu_host.flush()
+        return failed
+
+    def _schedule_store_inner(self, pods: "PodStore") -> List[UnscheduledPod]:
+        """The inner loop for a columnar PodStore: the run split
+        (pre-bound pods flush the unbound run first — identical serial
+        semantics) comes from one vectorized mask instead of a per-pod scan.
+        Pre-bound rows materialize (they are read-back pods by definition:
+        the direct-commit path touches their dicts); unbound stretches ride
+        _schedule_run as store views."""
+        failed: List[UnscheduledPod] = []
+        self._progress = None  # columnar batches never render progress
+        bound = pods.bound_mask()
+        if bound is None:
+            failed.extend(self._schedule_run(pods))
+        else:
+            xr = self._xray_run
+            direct = None
+            n_rows = len(pods)
+            bound_idx = np.flatnonzero(bound)
+            prev = 0
+            # O(bound rows), not O(pods): each iteration is one pre-bound
+            # pod plus one store-view run over the unbound stretch before it
+            for bi in np.append(bound_idx, n_rows).tolist():
+                if bi > prev:
+                    failed.extend(self._schedule_run(pods[prev:bi]))
+                prev = bi + 1
+                if bi >= n_rows:
+                    break
+                pod = pods[bi]  # materializes: direct commits mutate dicts
+                node_name = (pod.get("spec") or {}).get("nodeName")
+                ni = self.na.index.get(node_name)
+                if xr is not None and direct is None:
+                    direct = xr.new_batch(self.na.names, self._cfg_digest(),
+                                          [])
+                if ni is None:
+                    pod.pop(SIG_MEMO_KEY, None)
+                    self.homeless.append(pod)
+                    obs.SCHED_ATTEMPTS.labels(result="homeless").inc()
+                    if direct is not None:
+                        direct.add_pod(xray.pod_key(pod), xray.HOMELESS,
+                                       -1, -1, -1)
+                else:
+                    self._commit_pod(pod, ni, scheduled=False)
+                    obs.SCHED_ATTEMPTS.labels(result="bound").inc()
+                    self._count_commits()
+                    if direct is not None:
+                        direct.add_pod(xray.pod_key(pod), xray.BOUND, ni,
+                                       -1, -1)
         if self.gpu_host.enabled:
             self.gpu_host.flush()
         return failed
@@ -705,8 +929,10 @@ class Simulator:
         path — when every group is already interned, a request encode is a
         dict lookup per pod and the resident node-side tables are reused
         untouched."""
+        if is_pod_store(to_schedule):
+            return self._encode_store_ids(to_schedule)
         batch: List[Tuple[int, int]] = []
-        for pod in to_schedule:
+        for pod in to_schedule:  # simonlint: ignore[per-pod-host-loop] -- dict-batch encode; PodStore batches take _encode_store_ids
             # strip_daemon_pin can only fire on pods with node affinity; the
             # inline guard keeps the (common) affinity-less pod off the call
             if ((pod.get("spec") or {}).get("affinity")) is not None:
@@ -730,6 +956,42 @@ class Simulator:
                 pod.pop(SIG_MEMO_KEY, None)
             batch.append((self.encoder.group_of(enc_pod), forced))
         return batch
+
+    def _encode_store_ids(self, store: PodStore) -> EncodedRows:
+        """encode_batch_ids for a columnar store view: one group interning +
+        daemon-pin decision per TEMPLATE (not per pod), then a vectorized
+        gather maps the decisions over the rows. Byte-identical to the
+        per-pod path: replicas of one template are scheduling-identical, so
+        the per-template (group, forced) pair IS each row's pair."""
+        b = store.base
+        tmpl_rows = store.tmpl_rows()
+        n_t = len(b.templates)
+        tg = np.zeros(n_t, np.int32)
+        tf = np.full(n_t, -1, np.int32)
+        for ti in np.unique(tmpl_rows).tolist():
+            tmpl = b.templates[ti]
+            if ((tmpl.get("spec") or {}).get("affinity")) is not None:
+                stripped, target = strip_daemon_pin(tmpl)
+            else:
+                stripped, target = tmpl, None
+            if target is None:
+                # transient memo: group_of must not recompute the signature,
+                # and the shared template must not keep the marker (lazy
+                # blobs would otherwise bake it into materialized pods)
+                tmpl[SIG_MEMO_KEY] = b.sigs[ti]
+                try:
+                    tg[ti] = self.encoder.group_of(tmpl)
+                finally:
+                    tmpl.pop(SIG_MEMO_KEY, None)
+            elif target in self.na.index:
+                tf[ti] = self.na.index[target]
+                tg[ti] = self.encoder.group_of(stripped)
+            else:
+                # pinned to an unknown node: the RAW template signature keeps
+                # the unsatisfiable matchFields pin (engine parity — see the
+                # per-pod path's memo handling)
+                tg[ti] = self.encoder.group_of(tmpl)
+        return EncodedRows(tg[tmpl_rows], tf[tmpl_rows])
 
     def _kernel_ns(self, donate: bool = True):
         """The dispatch namespace for this simulator: the plain `kernels`
@@ -948,6 +1210,18 @@ class Simulator:
                     xb.add_pod(xray.pod_key(u.pod), xray.UNSCHEDULABLE, -1,
                                -1, -1, reason=u.reason)
             return out
+        chunk = self._stream_chunk
+        if chunk and is_pod_store(to_schedule) and not self._stream_explicit:
+            # Columnar batches have no per-pod host encode to overlap and
+            # their per-run buffers are already O(templates) + a few [P]
+            # arrays — chunking them only re-pays the node-axis table build
+            # per chunk. By default stream only to bound the [P] working set
+            # at extreme sizes (the 10M-pod row runs as a handful of
+            # chunks); an EXPLICIT OPEN_SIMULATOR_STREAM_PODS applies as-is
+            # (the bench-gate RSS workload pins a small chunk on purpose).
+            chunk = max(chunk, 2_097_152)
+        if chunk and len(to_schedule) > chunk:
+            return self._schedule_run_streaming(to_schedule, chunk)
         try:
             return self._schedule_run_once(to_schedule)
         except BaseException as e:
@@ -955,6 +1229,69 @@ class Simulator:
             if site is None:
                 raise
             return self._bisect_oom(to_schedule, site, e)
+
+    def _schedule_run_streaming(self, to_schedule,
+                                chunk: int) -> List[UnscheduledPod]:
+        """Streaming segment encode: a run longer than
+        OPEN_SIMULATOR_STREAM_PODS schedules as fixed-size chunks, each an
+        ordinary _schedule_run — the OOM-bisection bit-identity argument
+        (tests/test_guard.py) makes the chunked run's placements provably
+        identical to the monolithic one, because chunk k's commits seed
+        chunk k+1's encode exactly as the serial loop would have.
+
+        Double buffering: while chunk k's dispatch is in flight, a worker
+        thread computes chunk k+1's scheduling signatures (the dominant
+        per-pod encode cost for dict batches; columnar stores need no
+        prefetch — their encode is already O(templates)). The worker touches
+        ONLY chunk k+1's pod dicts and only stamps the same memo the main
+        thread would compute, so interning order — and therefore every table
+        — is untouched. guard-compat: all device work stays on this thread
+        under the usual watchdog; a failure joins the worker, then the
+        transaction rolls the whole call back and failover replays it, so
+        crash/failover semantics are exactly the unstreamed ones. Memory:
+        per-chunk tables/choices cap the host working set instead of scaling
+        with the full run (the bench-gate RSS budget leans on this)."""
+        import threading
+
+        P = len(to_schedule)
+        failed: List[UnscheduledPod] = []
+        starts = list(range(0, P, chunk))
+        use_prefetch = not is_pod_store(to_schedule)
+        worker: Optional[threading.Thread] = None
+
+        def prefetch(pods_slice) -> None:
+            try:
+                for pod in pods_slice:
+                    # (iter name is chunk-local, not the whole batch: this is
+                    # the O(chunk) prefetch the streaming path exists for)
+                    # pin-carrying pods keep their main-thread treatment
+                    # (strip_daemon_pin decides their memo semantics)
+                    if ((pod.get("spec") or {}).get("affinity")) is not None:
+                        continue
+                    if SIG_MEMO_KEY not in pod:
+                        pod[SIG_MEMO_KEY] = scheduling_signature(pod)
+            except Exception:  # simonlint: ignore[swallowed-exception] -- pure precompute; the main thread recomputes and raises the real error
+                pass
+
+        try:
+            for k, off in enumerate(starts):
+                if worker is not None:
+                    worker.join()
+                    worker = None
+                if use_prefetch and k + 1 < len(starts):
+                    nxt = to_schedule[starts[k + 1]:
+                                      min(starts[k + 1] + chunk, P)]
+                    worker = threading.Thread(
+                        target=prefetch, args=(nxt,), daemon=True,
+                        name="simon-stream-prefetch")
+                    worker.start()
+                obs.STREAM_CHUNKS.inc()
+                failed.extend(
+                    self._schedule_run(to_schedule[off:min(off + chunk, P)]))
+        finally:
+            if worker is not None:
+                worker.join()
+        return failed
 
     def _bisect_oom(self, to_schedule: List[dict], site: str,
                     err: BaseException) -> List[UnscheduledPod]:
@@ -985,6 +1322,7 @@ class Simulator:
             t_enc = time.perf_counter()
             bt = self.encode_batch(to_schedule)
             obs.ENCODE_SECONDS.observe(time.perf_counter() - t_enc)
+            obs.ENCODE_BYTES.inc(batch_tables_nbytes(bt))
             obs.BATCH_PODS.observe(len(to_schedule))
             span.step("encode")
             tables, carry = self._to_device(bt)
@@ -1226,47 +1564,65 @@ class Simulator:
                 sid = set_cache[key] = xr.add_set(s)
             return sid
 
-        if xb is not None:
-            # plain-int views once per batch: per-pod numpy-scalar casts on a
-            # 100k loop are a measurable slice of the recording overhead
-            pg_l = bt.pod_group[:P].tolist()
-            fn_l = bt.forced_node[:P].tolist()
-            seg_l = seg_of.tolist()
-        for i, pod in enumerate(to_schedule):
-            if progress is not None:
-                progress.advance(1)
-            node_i = int(choices[i])
+        t_commit = time.perf_counter()
+        # Vectorized bulk commit (simulator/store.py): a columnar batch with
+        # the per-pod bookkeeping provably unneeded — no flight recorder, no
+        # armed preemption (which needs per-pod _sig_of rows), no
+        # gpu/local-storage ledgers (whose reserve() writes per-pod
+        # annotations) — applies the whole run's placements as array ops.
+        # Everything else takes the per-pod loop below, which materializes
+        # store rows transparently.
+        if (is_pod_store(to_schedule) and xb is None
+                and not self._preempt_armed
+                and not self.gpu_host.enabled
+                and not self.local_host.enabled):
+            failed.extend(self._commit_store_bulk(
+                to_schedule, bt, choices, P, seg_of, seg_carry_of,
+                final_carry, tables))
+        else:
             if xb is not None:
-                key = (pg_l[i], fn_l[i], seg_l[i])
-            elif node_i < 0:
-                key = (int(bt.pod_group[i]), int(bt.forced_node[i]),
-                       int(seg_of[i]))
-            else:
-                key = None
-            if node_i >= 0:
-                self._commit_pod(pod, node_i)
+                # plain-int views once per batch: per-pod numpy-scalar casts
+                # on a 100k loop are a measurable slice of recording overhead
+                pg_l = bt.pod_group[:P].tolist()
+                fn_l = bt.forced_node[:P].tolist()
+                seg_l = seg_of.tolist()
+            for i, pod in enumerate(to_schedule):  # simonlint: ignore[per-pod-host-loop] -- store-less fallback; columnar batches ride _commit_store_bulk
+                if progress is not None:
+                    progress.advance(1)
+                node_i = int(choices[i])
                 if xb is not None:
-                    xb.add_pod(xray.pod_key(pod), xray.SCHEDULED, node_i,
-                               key[2], xray_sid(key), group=key[0])
-            else:
-                # Pods of one group share tolerations/requests, so the per-stage
-                # failure counts are identical — diagnose once per
-                # (group, forced, segment), against that segment's end state.
-                reasons = reason_cache.get(key)
-                if reasons is None:
-                    reasons = reason_cache[key] = self._explain_reasons(
-                        pod, key[0], key[1], tables,
-                        seg_carry_of.get(int(seg_of[i]), final_carry)
-                    )
-                pod.pop(SIG_MEMO_KEY, None)
-                obs.record_filter_reasons(reasons)
-                reason = self._format_reason(pod, reasons, self.na.N)
-                if xb is not None:
-                    sid = xray_sid(key)
-                    xr.sets[sid][1].reasons = dict(reasons)
-                    xb.add_pod(xray.pod_key(pod), xray.UNSCHEDULABLE, -1,
-                               key[2], sid, group=key[0], reason=reason)
-                failed.append(UnscheduledPod(pod, reason))
+                    key = (pg_l[i], fn_l[i], seg_l[i])
+                elif node_i < 0:
+                    key = (int(bt.pod_group[i]), int(bt.forced_node[i]),
+                           int(seg_of[i]))
+                else:
+                    key = None
+                if node_i >= 0:
+                    self._commit_pod(pod, node_i)
+                    if xb is not None:
+                        xb.add_pod(xray.pod_key(pod), xray.SCHEDULED, node_i,
+                                   key[2], xray_sid(key), group=key[0])
+                else:
+                    # Pods of one group share tolerations/requests, so the
+                    # per-stage failure counts are identical — diagnose once
+                    # per (group, forced, segment), against that segment's
+                    # end state.
+                    reasons = reason_cache.get(key)
+                    if reasons is None:
+                        reasons = reason_cache[key] = self._explain_reasons(
+                            pod, key[0], key[1], tables,
+                            seg_carry_of.get(int(seg_of[i]), final_carry)
+                        )
+                    pod.pop(SIG_MEMO_KEY, None)
+                    obs.record_filter_reasons(reasons)
+                    reason = self._format_reason(pod, reasons, self.na.N)
+                    if xb is not None:
+                        sid = xray_sid(key)
+                        xr.sets[sid][1].reasons = dict(reasons)
+                        xb.add_pod(xray.pod_key(pod), xray.UNSCHEDULABLE, -1,
+                                   key[2], sid, group=key[0], reason=reason)
+                    failed.append(UnscheduledPod(pod, reason))
+        obs.HOST_COMMIT_SECONDS.observe(time.perf_counter() - t_commit)
         placed_n = P - len(failed)
         obs.SCHED_ATTEMPTS.labels(result="scheduled").inc(placed_n)
         if failed:
@@ -1338,24 +1694,28 @@ class Simulator:
         run: List[dict] = []
         scheduled = 0
         homeless = 0
-        for pod in pods:
-            node_name = (pod.get("spec") or {}).get("nodeName")
-            if not node_name:
-                run.append(pod)
-                continue
-            ni = self.na.index.get(node_name)
-            if ni is None:
-                homeless += 1
-                self.homeless.append(pod)
-            else:
-                self._commit_pod(pod, ni, scheduled=False)
-                scheduled += 1
+        if is_pod_store(pods) and pods.bound_mask() is None:
+            run = pods  # columnar fast path: no pre-bound rows, no per-pod scan
+        else:
+            for pod in pods:  # simonlint: ignore[per-pod-host-loop] -- pre-bound split for dict batches (stores carrying bound rows materialize by definition)
+                node_name = (pod.get("spec") or {}).get("nodeName")
+                if not node_name:
+                    run.append(pod)
+                    continue
+                ni = self.na.index.get(node_name)
+                if ni is None:
+                    homeless += 1
+                    self.homeless.append(pod)
+                else:
+                    self._commit_pod(pod, ni, scheduled=False)
+                    scheduled += 1
         total_known = len(pods) - homeless
         if not run:
             return scheduled, total_known
         if self.na.N == 0:
             return scheduled, total_known
         bt = self.encode_batch(run)
+        obs.ENCODE_BYTES.inc(batch_tables_nbytes(bt))
         tables, carry = self._to_device(bt)
         enable_gpu, enable_storage = plugin_flags(bt)
         jnp = _jax()

@@ -110,7 +110,9 @@ def snapshot(sim) -> dict:
     """Copy of everything schedule runs mutate, for the rewind-and-replay."""
     return {
         "placed": {sig: dict(pg.node_counts) for sig, pg in sim.placed.items()},
-        "pods_on_node": [list(l) for l in sim.pods_on_node],
+        # O(touched nodes): the container copies only non-empty per-node item
+        # lists (dicts by reference, columnar spans by reference)
+        "pods_on_node": sim.pods_on_node.snapshot(),
         "homeless": len(sim.homeless),
         "log": len(sim._commit_log),
         "nominate": len(sim._nominate_log),
@@ -127,7 +129,44 @@ def restore(sim, snap: dict) -> None:
     # original nodeName/status objects back (the crash-consistency rollback
     # must leave CALLER-owned pod dicts bit-identical)
     gpu_enabled = sim.gpu_host.enabled  # commit only logs annotations then
-    for pod, prev_idx, prev_assume, prev_nn, prev_status in sim._commit_log[snap["log"]:]:
+    for entry in sim._commit_log[snap["log"]:]:
+        if entry[0] == sim._BULK_LOG:
+            # bulk store commit: reset the columns, then restore any
+            # materialized dict. Rows the commit patched carry their exact
+            # pre-commit nodeName/status objects in the entry; a dict
+            # materialized AFTER the commit (baked committed state at
+            # materialization) falls back to the template's own view.
+            _, store, rows, patched = entry
+            bb = store.base
+            bb.node_of[rows] = -1
+            if bb.commit_seq is not None:
+                bb.commit_seq[rows] = -1
+            prev = {r: (nn, st) for r, nn, st in patched}
+            for r, d in store.cached_rows_in(rows):
+                dspec = d.get("spec")
+                if r in prev:
+                    nn, st = prev[r]
+                    if dspec is not None:
+                        if nn is None:
+                            dspec.pop("nodeName", None)
+                        else:
+                            dspec["nodeName"] = nn
+                    if st is None:
+                        d.pop("status", None)
+                    else:
+                        d["status"] = st
+                else:
+                    if dspec is not None:
+                        dspec.pop("nodeName", None)
+                    tmpl_status = store.template_of_row(r).get("status")
+                    if tmpl_status is None:
+                        d.pop("status", None)
+                    else:
+                        import copy as _copy
+
+                        d["status"] = _copy.deepcopy(tmpl_status)
+            continue
+        pod, prev_idx, prev_assume, prev_nn, prev_status = entry
         spec = pod.get("spec")
         if spec is not None:
             if prev_nn is None:
@@ -183,7 +222,7 @@ def restore(sim, snap: dict) -> None:
             del sim.placed[sig]
         else:
             sim.placed[sig].node_counts = dict(nc)
-    sim.pods_on_node = [list(l) for l in snap["pods_on_node"]]
+    sim.pods_on_node.restore(snap["pods_on_node"])
     del sim.homeless[snap["homeless"]:]
     if snap["gpu"] is not None:
         sim.gpu_host.restore(snap["gpu"])
@@ -199,7 +238,7 @@ def _placed_minus(sim, removed: List[dict], node_i: int) -> Dict[object, PlacedG
     """Hypothetical placed dict with `removed` pods taken off node_i."""
     rm: Dict[object, int] = {}
     for p in removed:
-        sig = sim._sig_of[id(p)][0]
+        sig = sim._sig_rec(p)[0]
         rm[sig] = rm.get(sig, 0) + 1
     placed2 = dict(sim.placed)
     for sig, k in rm.items():
@@ -278,7 +317,7 @@ def _pdb_split(sim, victims: List[dict]) -> Tuple[List[dict], List[dict]]:
 
 def _commit_seq(sim, pod: dict) -> int:
     """Commit-order proxy for pod start time (MoreImportantPod's second key)."""
-    rec = sim._sig_of.get(id(pod))
+    rec = sim._sig_rec(pod)
     return rec[2] if rec is not None else -1
 
 
@@ -432,7 +471,7 @@ def evict(sim, victims: List[dict], node_i: int, preemptor: dict) -> None:
     faults.maybe_fail("preempt_evict")
     lst = sim.pods_on_node[node_i]
     for p in victims:
-        sig = sim._sig_of[id(p)][0]
+        sig = sim._sig_rec(p)[0]
         pg = sim.placed[sig]
         c = pg.node_counts.get(node_i, 0)
         if c <= 1:

@@ -82,25 +82,32 @@ def build_pod(name: str, tmpl: PodTemplate) -> dict:
 
 
 def build_workload(templates: Sequence[PodTemplate],
-                   _cache: Optional[dict] = None) -> List[dict]:
-    """The ordered pod batch for one scenario: each template's replicas are
-    contiguous (one wave segment each) and names are unique within the
-    scenario so the serial oracle's census filters on them. Scenarios with
-    an IDENTICAL template list share one pod list (`_cache`): names only
-    need within-scenario uniqueness, the oracle deep-copies before
-    scheduling, and the shared encode is a warm dict hit per pod — at
-    256 scenarios x 10k pods the drain/outage grid would otherwise hold
-    millions of identical dicts."""
+                   _cache: Optional[dict] = None):
+    """The ordered pod batch for one scenario as a columnar PodStore
+    (simulator/store.py): one template block per PodTemplate, each block's
+    replicas contiguous (one wave segment each), names unique within the
+    scenario (block-local numbering) so the serial oracle's census filters
+    on them. Scenarios with an IDENTICAL template list share one store
+    (`_cache`) — at 256 scenarios x 10k pods the drain/outage grid would
+    otherwise hold millions of identical dicts — and the store's lane
+    encode is one gather per template instead of a dict hit per pod.
+    Consumers that read pods back (the scan-lane census, the serial
+    oracle's deepcopy) materialize lazily through the Sequence protocol,
+    exactly the dicts the old list held."""
     key = tuple(templates)
     if _cache is not None and key in _cache:
         return _cache[key]
-    pods: List[dict] = []
+    from ..simulator.store import PodStore
+
+    store = PodStore()
     for tmpl in templates:
-        for i in range(tmpl.replicas):
-            pods.append(build_pod(f"sw-{tmpl.name}-{i:05d}", tmpl))
+        proto = build_pod("sw-proto", tmpl)
+        proto["metadata"].pop("name", None)
+        store.add_block(proto, tmpl.replicas,
+                        name_fmt=f"sw-{tmpl.name}-{{0:05d}}", name_start=0)
     if _cache is not None:
-        _cache[key] = pods
-    return pods
+        _cache[key] = store
+    return store
 
 
 # ----------------------------------------------------------- base building ---

@@ -132,3 +132,71 @@ def synth_cluster(
                 pods.append(synth_pod(idx, labels={"app": app}))
         k += 1
     return nodes, pods
+
+
+def synth_cluster_store(
+    n_nodes: int,
+    n_pods: int,
+    hard_predicates: bool = False,
+):
+    """Columnar twin of synth_cluster: the SAME cluster and workload, emitted
+    as a (NodeStore, PodStore) pair (simulator/store.py) — one node template
+    block and one pod template block per synth "app" instead of n dicts. The
+    double-encode parity suite (tests/test_store.py) asserts a Simulator over
+    this form encodes and places bit-identically to the dict form; at 1M+
+    pods this is the only form that fits in host memory at all."""
+    from ..simulator.store import NodeStore, PodStore
+
+    def node_template(taint: bool = False) -> dict:
+        t = synth_node(0)
+        t["metadata"] = {}
+        if not taint:
+            t.get("spec", {}).pop("taints", None)
+        return t
+
+    def pod_template(**kw) -> dict:
+        t = synth_pod(0, **kw)
+        t["metadata"].pop("name", None)
+        return t
+
+    ns = NodeStore()
+    ps = PodStore()
+    if not hard_predicates:
+        ns.add_block(node_template(), n_nodes, name_fmt="node-{0:05d}",
+                     index_labels=("node-index",))
+        ps.add_block(pod_template(), n_pods, name_fmt="pod-{0:06d}")
+        return ns, ps
+
+    ns.add_block(
+        node_template(), n_nodes, name_fmt="node-{0:05d}",
+        index_labels=("node-index",),
+        zone_cycle=("topology.kubernetes.io/zone", "zone-{0}", 8),
+        taint=({"key": "synth/dedicated", "value": "batch",
+                "effect": "NoSchedule"}, 10))
+    block = max(1, n_pods // 50)
+    made = 0
+    k = 0
+    while made < n_pods:
+        n = min(block, n_pods - made)
+        kind = k % 5
+        app = f"synth-{k}"
+        if kind == 1:
+            ps.add_block(pod_template(labels={"app": app}, tolerate=True),
+                         n, name_fmt="pod-{0:06d}")
+        elif kind == 3:
+            cap = min(n, max(1, n_nodes // 2))
+            ps.add_block(pod_template(labels={"app": app},
+                                      anti_affinity_on=app),
+                         cap, name_fmt="pod-{0:06d}")
+            if n > cap:
+                ps.add_block(pod_template(labels={"app": app}), n - cap,
+                             name_fmt="pod-{0:06d}")
+        elif kind == 4:
+            ps.add_block(pod_template(spread_zone=True), n,
+                         name_fmt="pod-{0:06d}")
+        else:
+            ps.add_block(pod_template(labels={"app": app}), n,
+                         name_fmt="pod-{0:06d}")
+        made += n
+        k += 1
+    return ns, ps

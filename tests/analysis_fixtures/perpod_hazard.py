@@ -1,0 +1,47 @@
+"""Fixture: per-pod-host-loop — O(pods) Python loops in a store-adopted module.
+
+This module "adopts" the columnar store (the import below is the structural
+applicability marker), then runs per-pod Python loops over a pod batch —
+the exact host-path shape the struct-of-arrays rewrite removed from the
+engine. Expected findings: 3 (the waived one suppressed, the helpers clean).
+"""
+
+import numpy as np
+
+from open_simulator_tpu.simulator import store  # noqa: F401  (adoption marker)
+
+
+def encode_slow(encoder, pods):
+    out = []
+    for pod in pods:  # finding 1: per-pod encode traversal
+        out.append(encoder.group_of(pod))
+    return out
+
+
+def commit_slow(sim, to_schedule, choices):
+    for i, pod in enumerate(to_schedule):  # finding 2: per-pod commit loop
+        if choices[i] >= 0:
+            sim._commit_pod(pod, int(choices[i]))
+
+
+def track_slow(batch):
+    total = 0
+    for gi, fn in batch:  # finding 3: batch re-walk
+        total += gi + fn
+    return total
+
+
+def deliberate_fallback(sim, pods):
+    for pod in pods:  # simonlint: ignore[per-pod-host-loop] -- gpu ledger writes per-pod annotations; columnar batches ride the bulk path
+        sim.gpu_host.reserve(pod, 0)
+
+
+def vectorized_ok(store_view):
+    # the columnar form: one gather, no per-pod Python
+    rows = store_view.tmpl_rows()
+    return np.bincount(rows)
+
+
+def unrelated_loop_ok(nodes):
+    for n in nodes:  # node axis, not the pod batch
+        n.get("metadata")

@@ -533,3 +533,27 @@ def test_suppression_reason_comment_only_waiver_is_waivable(tmp_path):
     fr = analyze_file(str(mod))
     hits = [f for f in fr.findings if f.rule == "suppression-reason"]
     assert len(hits) == 1 and hits[0].suppressed and hits[0].line == 5
+
+
+def test_per_pod_host_loop_rule_fires():
+    # three per-pod loops in a store-adopted module fire; the gpu-ledger
+    # fallback waiver reports suppressed, not active
+    assert _counts("perpod_hazard.py", "per-pod-host-loop") == 3
+    assert _counts("perpod_hazard.py", "per-pod-host-loop",
+                   suppressed=True) == 1
+
+
+def test_per_pod_host_loop_needs_store_adoption():
+    # the same loops in a module that never imports the columnar store are
+    # out of scope — the rule fences store-adopted hot paths only
+    fr = analyze_file(str(FIXTURES / "hostsync_hazard.py"))
+    assert not any(f.rule == "per-pod-host-loop" for f in fr.findings)
+
+
+def test_per_pod_host_loop_spares_columnar_and_node_loops():
+    fr = analyze_file(str(FIXTURES / "perpod_hazard.py"))
+    src = (FIXTURES / "perpod_hazard.py").read_text().splitlines()
+    ok_start = next(i for i, l in enumerate(src, 1)
+                    if "def vectorized_ok" in l)
+    assert not any(f.line >= ok_start for f in fr.findings
+                   if f.rule == "per-pod-host-loop")

@@ -8,7 +8,12 @@ compile-cache accounting starts from zero exactly like the baseline run):
    (the serve_whatif_rps shape: warm templates, micro-batching, live churn,
    a scoped window);
 2. **sweep** — the committed zone-outage example sweep with full parity
-   fuzzing.
+   fuzzing;
+3. **host_1m RSS gate** — the 1M-pod columnar host-path workload
+   (PodStore/NodeStore, streaming encode forced on) in its own interpreter,
+   with a hard peak-RSS budget: the struct-of-arrays store must CUT host
+   memory vs the dict path, and streaming must cap per-run buffers
+   (RSS_1M_BUDGET_MB; see the constant's comment for measurements).
 
 Then diffs the fresh registry snapshot against the committed baseline
 (tests/golden/bench_gate_baseline.json) with the SAME machinery as
@@ -67,6 +72,58 @@ MUST_BE_ZERO = (
 # module docstring).
 VERSION_DEPENDENT = ("simon_xla_backend_compile",)
 
+# Peak-RSS budget for the 1M-pod columnar host-path workload (PR 15): the
+# struct-of-arrays store + streaming encode must CUT host memory, not grow
+# it. Measured: ~300MB peak (store + jax runtime + streamed chunks) vs
+# ~2.8GB for the same workload as 1M pod dicts — the budget sits 3x above
+# the columnar measurement and far below the dict floor, so a regression
+# back toward per-pod dict state trips it long before it ships.
+RSS_1M_BUDGET_MB = 1024
+RSS_WORKLOAD = r"""
+import json, os, resource, sys, time
+sys.path.insert(0, {repo!r})
+from open_simulator_tpu.utils.synth import synth_cluster_store
+from open_simulator_tpu.simulator.engine import Simulator
+
+t0 = time.perf_counter()
+ns, ps = synth_cluster_store(10_000, 1_000_000)
+sim = Simulator(ns, use_mesh=False)
+failed = sim.schedule_pods(ps)
+print(json.dumps({{
+    "wall_s": round(time.perf_counter() - t0, 2),
+    "placed": sim.pods_on_node.total(),
+    "failed": len(failed),
+    "rss_mb": round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1),
+}}))
+"""
+
+
+def run_rss_gate() -> dict:
+    """The 1M-row RSS probe, in its own interpreter (the gate process'
+    serve/sweep allocations would pollute ru_maxrss). A small explicit
+    OPEN_SIMULATOR_STREAM_PODS forces the store batch through the streaming
+    path, so the gate also proves chunking caps the per-run buffers."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["OPEN_SIMULATOR_STREAM_PODS"] = "262144"
+    out = subprocess.run(
+        [sys.executable, "-c", RSS_WORKLOAD.format(repo=REPO)],
+        env=env, capture_output=True, text=True, timeout=900)
+    row = None
+    for line in reversed(out.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            row = json.loads(line)
+            break
+    if row is None:
+        raise SystemExit(
+            f"rss gate workload produced no row (rc={out.returncode}, "
+            f"stderr tail: {out.stderr[-300:]!r})")
+    if row["placed"] != 1_000_000 or row["failed"]:
+        raise SystemExit(f"rss gate workload mis-scheduled: {row}")
+    return row
+
 
 def run_workloads() -> dict:
     """The fixed gate workloads; returns the fresh serve row (the sweep's
@@ -114,6 +171,16 @@ def main(argv=None) -> int:
     print(f"gate serve row: {row['value']} req/s, "
           f"{row['requests']} requests, parity_ok={row['parity_ok']}")
 
+    rss = run_rss_gate()
+    print(f"gate 1M-row rss: {rss['rss_mb']}MB peak "
+          f"(budget {RSS_1M_BUDGET_MB}MB), {rss['wall_s']}s, "
+          f"{rss['placed']} placed")
+    rss_failure = None
+    if rss["rss_mb"] > RSS_1M_BUDGET_MB:
+        rss_failure = (f"1M-pod columnar workload peaked at "
+                       f"{rss['rss_mb']}MB > {RSS_1M_BUDGET_MB}MB budget — "
+                       f"the host path is growing per-pod state again")
+
     if args.update:
         with open(BASELINE, "w") as f:
             json.dump(snap, f, indent=1, sort_keys=True)
@@ -146,12 +213,14 @@ def main(argv=None) -> int:
     changed, regressions = _diff_metrics(base, snap, sys.stdout)
     for msg in hard_failures:
         print(f"GATE FAILURE: {msg}", file=sys.stderr)
+    if rss_failure:
+        print(f"GATE FAILURE: {rss_failure}", file=sys.stderr)
     if regressions:
         print(f"bench gate: {regressions} regression-direction counter(s) "
               f"grew vs {os.path.relpath(BASELINE, REPO)} (re-baseline "
               f"with --update ONLY if the growth is intended)",
               file=sys.stderr)
-    if hard_failures or regressions:
+    if hard_failures or regressions or rss_failure:
         return 1
     print(f"bench gate: OK ({changed} metric(s) changed, 0 regressions)")
     return 0
