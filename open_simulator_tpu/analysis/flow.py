@@ -1,0 +1,584 @@
+"""simonflow: CFG + intraprocedural dataflow over the simonlint AST model.
+
+simonlint's original rules are single-statement pattern matchers; simonaudit
+sees the compiled artifact. Neither can answer flow questions — "does this
+value ever reach that call?", "is this attribute ever touched off-lock?" —
+which is exactly the class the two worst shipped concurrency bugs (the PR 14
+torn-scrape histogram race, the PR 5 thread-local config-scope escape)
+belonged to. This module is the third tier's foundation:
+
+  * `build_cfg(fn)` — an intraprocedural control-flow graph over a function
+    (or module) body: if/while/for with back edges, try/except/finally with
+    conservative exception edges, with-blocks inline, break/continue/return/
+    raise terminators. Nested defs/lambdas are opaque statements (separate
+    execution contexts with their own CFGs).
+  * `dataflow_forward(cfg, ...)` — a worklist fixpoint solver for forward
+    may-analyses (facts join by union at block entries).
+  * the **entropy taint pass** (`entropy-into-report`, WARNING): ambient
+    entropy sources (wall clocks, unseeded `random`, `os.urandom`, `id()`,
+    set iteration order) flowing into deterministic report sinks
+    (json.dump/json.dumps — the sweep reports, golden writers, journals, and
+    trace files every byte-identical-report suite depends on). Taint
+    propagates through assignments on the CFG and one level deep through
+    module-local helper calls (an `entropy-returning` function summary).
+
+The lock-discipline and thread-escape passes built on the same foundation
+live in threads.py. All passes register as ordinary rules, so `simon lint`,
+the LintCache, suppressions, and both output formats work unchanged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from .base import Finding, Severity, register
+from .context import ModuleContext
+
+# --------------------------------------------------------------------- CFG ----
+
+
+class Block:
+    """One basic block: a straight-line run of statements and its successor
+    edges. `label` is a construction hint ("if.then", "while.head", ...) for
+    tests and debugging only."""
+
+    __slots__ = ("id", "label", "stmts", "succs")
+
+    def __init__(self, bid: int, label: str = "") -> None:
+        self.id = bid
+        self.label = label
+        self.stmts: List[ast.stmt] = []
+        self.succs: List["Block"] = []
+
+    def link(self, other: "Block") -> None:
+        if other is not self and other not in self.succs:
+            self.succs.append(other)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"Block({self.id}, {self.label!r}, "
+                f"stmts={len(self.stmts)}, "
+                f"succs={[b.id for b in self.succs]})")
+
+
+class CFG:
+    """Entry/exit plus every block of one function (or module) body."""
+
+    def __init__(self, fn: ast.AST) -> None:
+        self.fn = fn
+        self.blocks: List[Block] = []
+        self.entry: Block = self._new("entry")
+        self.exit: Block = self._new("exit")
+
+    def _new(self, label: str = "") -> Block:
+        b = Block(len(self.blocks), label)
+        self.blocks.append(b)
+        return b
+
+    def preds(self) -> Dict[int, List[Block]]:
+        out: Dict[int, List[Block]] = {b.id: [] for b in self.blocks}
+        for b in self.blocks:
+            for s in b.succs:
+                out[s.id].append(b)
+        return out
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG over `fn.body` (a FunctionDef, or any node with a stmt-list body
+    — an ast.Module works). Nested function/class definitions are recorded
+    as plain statements, never descended into."""
+    cfg = CFG(fn)
+    builder = _Builder(cfg)
+    end = builder.seq(list(getattr(fn, "body", [])), cfg.entry,
+                      loops=[], handlers=[])
+    if end is not None:
+        end.link(cfg.exit)
+    return cfg
+
+
+class _Builder:
+    def __init__(self, cfg: CFG) -> None:
+        self.cfg = cfg
+
+    def seq(self, stmts: Sequence[ast.stmt], cur: Optional[Block],
+            loops: List[Tuple[Block, Block]],
+            handlers: List[Block]) -> Optional[Block]:
+        """Thread `stmts` through blocks starting at `cur`; returns the open
+        block after the last statement, or None when control cannot fall
+        through (return/raise/break/continue on every path)."""
+        for stmt in stmts:
+            if cur is None:
+                # dead code after a terminator still gets a (preds-free)
+                # block so walkers and per-statement facts can see it
+                cur = self.cfg._new("dead")
+            if isinstance(stmt, ast.If):
+                cur = self._if(stmt, cur, loops, handlers)
+            elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+                cur = self._loop(stmt, cur, loops, handlers)
+            elif isinstance(stmt, ast.Try):
+                cur = self._try(stmt, cur, loops, handlers)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                # with-blocks are straight-line: the item exprs (and any
+                # `as` targets) evaluate in the current block, the body
+                # continues inline
+                cur.stmts.append(stmt)
+                cur = self.seq(stmt.body, cur, loops, handlers)
+            elif isinstance(stmt, ast.Return):
+                cur.stmts.append(stmt)
+                cur.link(self.cfg.exit)
+                cur = None
+            elif isinstance(stmt, ast.Raise):
+                cur.stmts.append(stmt)
+                for h in handlers:
+                    cur.link(h)
+                cur.link(self.cfg.exit)
+                cur = None
+            elif isinstance(stmt, ast.Break):
+                cur.stmts.append(stmt)
+                if loops:
+                    cur.link(loops[-1][1])
+                cur = None
+            elif isinstance(stmt, ast.Continue):
+                cur.stmts.append(stmt)
+                if loops:
+                    cur.link(loops[-1][0])
+                cur = None
+            else:
+                # plain statement — including nested FunctionDef/ClassDef,
+                # which are definitions here, not control flow
+                cur.stmts.append(stmt)
+        return cur
+
+    def _if(self, stmt: ast.If, cur: Block, loops, handlers) -> Block:
+        cur.stmts.append(stmt)  # the test expression evaluates here
+        after = self.cfg._new("if.after")
+        then = self.cfg._new("if.then")
+        cur.link(then)
+        t_end = self.seq(stmt.body, then, loops, handlers)
+        if t_end is not None:
+            t_end.link(after)
+        if stmt.orelse:
+            els = self.cfg._new("if.else")
+            cur.link(els)
+            e_end = self.seq(stmt.orelse, els, loops, handlers)
+            if e_end is not None:
+                e_end.link(after)
+        else:
+            cur.link(after)
+        return after
+
+    def _loop(self, stmt, cur: Block, loops, handlers) -> Block:
+        head = self.cfg._new("loop.head")
+        cur.link(head)
+        head.stmts.append(stmt)  # test / iter+target evaluate per iteration
+        after = self.cfg._new("loop.after")
+        body = self.cfg._new("loop.body")
+        head.link(body)
+        head.link(after)
+        b_end = self.seq(stmt.body, body, loops + [(head, after)], handlers)
+        if b_end is not None:
+            b_end.link(head)
+        if stmt.orelse:
+            els = self.cfg._new("loop.else")
+            head.link(els)
+            e_end = self.seq(stmt.orelse, els, loops, handlers)
+            if e_end is not None:
+                e_end.link(after)
+        return after
+
+    def _try(self, stmt: ast.Try, cur: Block, loops, handlers) -> Optional[Block]:
+        after = self.cfg._new("try.after")
+        h_entries = [self.cfg._new(f"except.{i}")
+                     for i, _ in enumerate(stmt.handlers)]
+        body = self.cfg._new("try.body")
+        cur.link(body)
+        watermark = len(self.cfg.blocks)
+        b_end = self.seq(stmt.body, body, loops, handlers + h_entries)
+        # conservative exception edges: any block of the protected body may
+        # raise into any handler (a may-analysis over-approximates safely)
+        for blk in [body] + self.cfg.blocks[watermark:]:
+            for h in h_entries:
+                blk.link(h)
+        fin: Optional[Block] = None
+        fin_end: Optional[Block] = None
+        if stmt.finalbody:
+            fin = self.cfg._new("finally")
+            fin_end = self.seq(stmt.finalbody, fin, loops, handlers)
+            if fin_end is not None:
+                fin_end.link(after)
+                # the exceptional continuation: finally runs, then re-raises
+                fin_end.link(self.cfg.exit)
+                for h in handlers:
+                    fin_end.link(h)
+        tail = fin if fin is not None else after
+        if b_end is not None:
+            if stmt.orelse:
+                els = self.cfg._new("try.else")
+                b_end.link(els)
+                e_end = self.seq(stmt.orelse, els, loops, handlers + h_entries)
+                if e_end is not None:
+                    e_end.link(tail)
+            else:
+                b_end.link(tail)
+        for i, handler in enumerate(stmt.handlers):
+            h_end = self.seq(handler.body, h_entries[i], loops, handlers)
+            if h_end is not None:
+                h_end.link(tail)
+        if fin is not None and not fin_end and not stmt.finalbody:
+            fin.link(after)
+        return after
+
+
+# ---------------------------------------------------------------- dataflow ----
+
+Fact = Dict[str, Tuple[str, int]]  # name -> (source label, source line)
+
+
+def dataflow_forward(cfg: CFG,
+                     transfer: Callable[[ast.stmt, Fact], Fact],
+                     init: Optional[Fact] = None,
+                     max_iters: int = 100) -> Dict[int, Fact]:
+    """Worklist fixpoint for a forward may-analysis: block-entry facts join
+    by dict-union (first writer of a name wins — stable, deterministic), the
+    per-statement `transfer` threads facts through each block in order.
+    Returns {block id -> entry fact}. Blocks unreachable from entry keep the
+    bottom fact ({})."""
+    preds = cfg.preds()
+    entry_facts: Dict[int, Fact] = {cfg.entry.id: dict(init or {})}
+
+    def block_out(b: Block) -> Fact:
+        fact = dict(entry_facts.get(b.id, {}))
+        for stmt in b.stmts:
+            fact = transfer(stmt, fact)
+        return fact
+
+    work = [cfg.entry]
+    iters = 0
+    while work and iters < max_iters * max(1, len(cfg.blocks)):
+        iters += 1
+        b = work.pop(0)
+        out = block_out(b)
+        for s in b.succs:
+            cur = entry_facts.get(s.id)
+            merged = dict(out) if cur is None else dict(cur)
+            if cur is not None:
+                for k, v in out.items():
+                    merged.setdefault(k, v)
+            if merged != cur:
+                entry_facts[s.id] = merged
+                if s not in work:
+                    work.append(s)
+    return entry_facts
+
+
+# ------------------------------------------------------------ entropy taint ----
+
+# Ambient entropy: every call here returns a value that differs run to run.
+ENTROPY_CALLS = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.process_time",
+    "os.urandom", "os.getpid",
+    "uuid.uuid1", "uuid.uuid4",
+    "secrets.token_hex", "secrets.token_bytes", "secrets.token_urlsafe",
+    "random.random", "random.randint", "random.randrange", "random.uniform",
+    "random.choice", "random.choices", "random.sample", "random.shuffle",
+    "random.gauss", "random.getrandbits", "random.randbytes",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.date.today",
+}
+
+# Deterministic report sinks: the serializers every byte-identical artifact
+# (sweep reports, goldens, journals, traces) funnels through.
+SINK_CALLS = {"json.dump", "json.dumps"}
+
+_SET_FACTORIES = {"set", "frozenset"}
+_TAINT_MUTATORS = {"append", "add", "extend", "insert", "update",
+                   "setdefault", "appendleft"}
+
+
+def _is_builtin(ctx: ModuleContext, node: ast.expr, name: str) -> bool:
+    return (isinstance(node, ast.Name) and node.id == name
+            and name not in ctx.aliases)
+
+
+class _TaintEngine:
+    """Per-module entropy-taint machinery. `entropy_fns` is the set of
+    module-local function names whose RETURN value is tainted assuming
+    untainted arguments (the one-level helper summary); `setish` tracks
+    names bound to set()/frozenset()/set-literal values so only their
+    ITERATION (the order hazard), not membership tests, taints."""
+
+    def __init__(self, ctx: ModuleContext, entropy_fns: Set[str]) -> None:
+        self.ctx = ctx
+        self.entropy_fns = entropy_fns
+        self.sink_hits: List[Tuple[ast.Call, str, Tuple[str, int]]] = []
+        self.return_taints: List[Tuple[str, int]] = []
+        self.setish: Set[str] = set()
+        # sink scanning is the expensive half of transfer() and only the
+        # post-fixpoint replay needs it — off during worklist iteration
+        self.scan_enabled = False
+
+    # ---- expression taint ----------------------------------------------------
+
+    def expr_taint(self, expr: Optional[ast.expr],
+                   fact: Fact) -> Optional[Tuple[str, int]]:
+        if expr is None:
+            return None
+        if isinstance(expr, ast.Name):
+            return fact.get(expr.id)
+        if isinstance(expr, ast.Call):
+            r = self.ctx.resolve(expr.func)
+            if r in ENTROPY_CALLS:
+                return (r, expr.lineno)
+            if _is_builtin(self.ctx, expr.func, "id"):
+                return ("id()", expr.lineno)
+            # sorted(...) neutralizes ORDER taint: sorted(set(x)) is clean,
+            # but value taint (time flowing through sorted) survives below
+            neutralized = _is_builtin(self.ctx, expr.func, "sorted")
+            if not neutralized and isinstance(expr.func, ast.Name) \
+                    and expr.func.id in self.entropy_fns:
+                return (f"{expr.func.id}() [entropy-returning helper]",
+                        expr.lineno)
+            if not neutralized and isinstance(expr.func, ast.Attribute) \
+                    and expr.func.attr in self.entropy_fns:
+                return (f"{expr.func.attr}() [entropy-returning helper]",
+                        expr.lineno)
+            for sub in list(expr.args) + [k.value for k in expr.keywords]:
+                t = self.expr_taint(sub, fact)
+                if t is not None and not (
+                        neutralized and t[0] == "set-iteration-order"):
+                    return t
+            t = self.expr_taint(expr.func if isinstance(expr.func, ast.Attribute)
+                                else None, fact)
+            return t
+        if isinstance(expr, ast.Attribute):
+            return self.expr_taint(expr.value, fact)
+        if isinstance(expr, (ast.Lambda, ast.FunctionDef)):
+            return None
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                t = self.expr_taint(child, fact)
+                if t is not None:
+                    return t
+        return None
+
+    def _iter_order_taint(self, iter_expr: ast.expr,
+                          fact: Fact) -> Optional[Tuple[str, int]]:
+        """Taint from ITERATING `iter_expr`: a set literal, a direct
+        set()/frozenset() call, or a name bound to one — the iteration order
+        is hash-seed-dependent and differs across processes."""
+        e = iter_expr
+        if isinstance(e, ast.Set):
+            return ("set-iteration-order", e.lineno)
+        if isinstance(e, ast.Call) and any(
+                _is_builtin(self.ctx, e.func, n) for n in _SET_FACTORIES):
+            return ("set-iteration-order", e.lineno)
+        if isinstance(e, ast.Name) and e.id in self.setish:
+            return ("set-iteration-order", e.lineno)
+        return None
+
+    def _is_setish_value(self, value: ast.expr) -> bool:
+        if isinstance(value, ast.Set):
+            return True
+        if isinstance(value, ast.Call):
+            return any(_is_builtin(self.ctx, value.func, n)
+                       for n in _SET_FACTORIES)
+        if isinstance(value, ast.Name):
+            return value.id in self.setish
+        return False
+
+    # ---- statement transfer --------------------------------------------------
+
+    def transfer(self, stmt: ast.stmt, fact: Fact) -> Fact:
+        fact = dict(fact)
+        self._scan_sinks(stmt, fact)
+        # container mutation propagates taint into the container: report
+        # rows accumulate via rows.append(tainted)
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            if (isinstance(call.func, ast.Attribute)
+                    and call.func.attr in _TAINT_MUTATORS
+                    and isinstance(call.func.value, ast.Name)):
+                for sub in list(call.args) + [k.value for k in call.keywords]:
+                    t = self.expr_taint(sub, fact)
+                    if t is not None:
+                        fact.setdefault(call.func.value.id, t)
+                        break
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        elif isinstance(stmt, ast.AugAssign):
+            t = self.expr_taint(stmt.value, fact)
+            for name in _target_names(stmt.target):
+                if t is not None:
+                    fact.setdefault(name, t)
+            return fact
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            t = (self.expr_taint(stmt.iter, fact)
+                 or self._iter_order_taint(stmt.iter, fact))
+            for name in _target_names(stmt.target):
+                if t is not None:
+                    fact[name] = t
+                else:
+                    fact.pop(name, None)
+            return fact
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is None:
+                    continue
+                t = self.expr_taint(item.context_expr, fact)
+                for name in _target_names(item.optional_vars):
+                    if t is not None:
+                        fact[name] = t
+                    else:
+                        fact.pop(name, None)
+            return fact
+        elif isinstance(stmt, ast.Return):
+            t = self.expr_taint(stmt.value, fact)
+            if t is not None:
+                self.return_taints.append(t)
+            return fact
+        if value is not None:
+            t = self.expr_taint(value, fact)
+            setish = self._is_setish_value(value)
+            for tgt in targets:
+                for name in _target_names(tgt):
+                    if t is not None:
+                        fact[name] = t
+                    else:
+                        fact.pop(name, None)
+                    if setish:
+                        self.setish.add(name)
+                    else:
+                        self.setish.discard(name)
+        return fact
+
+    def _scan_sinks(self, stmt: ast.stmt, fact: Fact) -> None:
+        """Record every sink call in `stmt` fed by a tainted argument. Walks
+        the whole statement (sinks hide in returns, nested calls, f-strings)
+        but never into nested defs."""
+        if not self.scan_enabled:
+            return
+        for node in _walk_stmt_exprs(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            r = self.ctx.resolve(node.func)
+            if r not in SINK_CALLS:
+                continue
+            args = list(node.args)
+            if r == "json.dump" and len(args) >= 2:
+                # the stream argument carries no CONTENT taint (a pid- or
+                # time-suffixed tmp filename is still a deterministic record)
+                args = args[:1]
+            for arg in args + [k.value for k in node.keywords
+                               if k.arg not in ("fp", "default")]:
+                t = self.expr_taint(arg, fact)
+                if t is None and isinstance(arg, (ast.Name,)):
+                    t = self._iter_order_taint(arg, fact)
+                if t is not None:
+                    self.sink_hits.append((node, r, t))
+                    break
+
+
+def _target_names(tgt: ast.expr) -> List[str]:
+    return [n.id for n in ast.walk(tgt) if isinstance(n, ast.Name)]
+
+
+def _walk_stmt_exprs(stmt: ast.stmt):
+    """Every node of a statement, skipping nested function/class bodies."""
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _analyze_function(ctx: ModuleContext, fn: ast.AST,
+                      entropy_fns: Set[str],
+                      want_sinks: bool = True) -> _TaintEngine:
+    eng = _TaintEngine(ctx, entropy_fns)
+    cfg = build_cfg(fn)
+    entry_facts = dataflow_forward(cfg, eng.transfer)
+    # return taints collected during the fixpoint are already sound (entry
+    # facts grow monotonically, so the last visit of a returning block saw
+    # its converged fact) — summary computation stops here
+    if not want_sinks:
+        return eng
+    # fixpoint reached: replay each block once from its final entry fact so
+    # sink hits and return taints reflect the converged solution
+    eng.scan_enabled = True
+    eng.sink_hits = []
+    eng.return_taints = []
+    eng.setish = set()
+    for b in cfg.blocks:
+        fact = dict(entry_facts.get(b.id, {}))
+        for stmt in b.stmts:
+            fact = eng.transfer(stmt, fact)
+    return eng
+
+
+def entropy_returning_functions(ctx: ModuleContext) -> Set[str]:
+    """Module-local functions whose return value carries entropy taint given
+    untainted arguments — the summary that lets taint cross ONE call level
+    (`stamp = _now_ms()` into a report is the same hazard as inlining the
+    clock read). Iterated to a fixpoint so helper chains resolve."""
+    out: Set[str] = set()
+    for _ in range(len(ctx.functions) + 1):
+        grew = False
+        for fname in sorted(ctx.functions):
+            if fname in out:
+                continue
+            for fn in ctx.functions[fname]:
+                eng = _analyze_function(ctx, fn, out, want_sinks=False)
+                if eng.return_taints:
+                    out.add(fname)
+                    grew = True
+                    break
+        if not grew:
+            break
+    return out
+
+
+@register(
+    "entropy-into-report", Severity.WARNING,
+    "A value derived from ambient entropy (wall clock, unseeded random, "
+    "os.urandom, id(), set iteration order) flows into a deterministic "
+    "report sink (json.dump/json.dumps). Every byte-identical-report suite "
+    "— sweep reports, golden writers, replay journals — depends on these "
+    "serializations being pure functions of their seeded inputs; one "
+    "timestamp or hash-order leak breaks the contract in a way the suites "
+    "only catch per-artifact, after the fact. Thread the value through the "
+    "seeded inputs (or sort the iteration), or whitelist a deliberately "
+    "wall-clocked record with `# simonlint: ignore[entropy-into-report] -- "
+    "<why>` naming the artifact that tolerates it.",
+)
+def rule_entropy_into_report(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    summaries = entropy_returning_functions(ctx)
+    seen: Set[Tuple[int, int]] = set()
+    units: List[ast.AST] = [ctx.tree]
+    for defs in ctx.functions.values():
+        units.extend(defs)
+    for unit in units:
+        eng = _analyze_function(ctx, unit, summaries)
+        for call, sink, (label, src_line) in eng.sink_hits:
+            key = (call.lineno, call.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(Finding(
+                "entropy-into-report", Severity.WARNING, ctx.path,
+                call.lineno, call.col_offset,
+                f"{sink}(...) receives a value tainted by {label} "
+                f"(source at line {src_line}) — entropy in a deterministic "
+                f"report sink breaks the byte-identical-artifact contract; "
+                f"derive the value from seeded inputs or waive with the "
+                f"artifact that tolerates wall-clock fields",
+            ))
+    return out

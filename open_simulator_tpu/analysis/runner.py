@@ -23,7 +23,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
+from . import flow as _flow  # noqa: F401  (imported for rule registration)
 from . import rules as _rules  # noqa: F401  (imported for rule registration)
+from . import threads as _threads  # noqa: F401  (imported for rule registration)
 from .base import RULE_REGISTRY, Finding, Severity, is_suppressed, suppressions_for
 from .context import ModuleContext
 
@@ -44,8 +46,10 @@ _ANALYSIS_DIR = os.path.dirname(os.path.abspath(__file__))
 _DIGEST_SOURCES = (
     os.path.join(_ANALYSIS_DIR, "base.py"),
     os.path.join(_ANALYSIS_DIR, "context.py"),
+    os.path.join(_ANALYSIS_DIR, "flow.py"),
     os.path.join(_ANALYSIS_DIR, "rules.py"),
     os.path.join(_ANALYSIS_DIR, "runner.py"),
+    os.path.join(_ANALYSIS_DIR, "threads.py"),
     os.path.join(os.path.dirname(_ANALYSIS_DIR), "ops", "contracts.py"),
 )
 
@@ -323,11 +327,13 @@ def run_lint(argv: Optional[Sequence[str]] = None) -> int:
 
 
 def write_bench(report: Report, path: str,
-                warm: Optional[Report] = None) -> None:
+                warm: Optional[Report] = None,
+                extra: Optional[dict] = None) -> None:
     """Record analyzer wall time + finding counts so future PRs can assert the
     pass stays fast (budget: <10s on the full tree) and watch finding drift.
     With `warm` (a second cache-backed pass over the same tree), the record
-    carries cold/warm timings and the warm hit rate."""
+    carries cold/warm timings and the warm hit rate. `extra` merges
+    additional sub-records (the flow-pass timings) into the document."""
     rec = {
         "tool": "simonlint",
         "files": len(report.files),
@@ -342,6 +348,8 @@ def write_bench(report: Report, path: str,
         rec["elapsed_warm_s"] = round(warm.elapsed_s, 4)
         rec["warm_cache_hits"] = warm.cache_hits
         rec["warm_cache_misses"] = warm.cache_misses
+    if extra:
+        rec.update(extra)
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(rec, fh, indent=2)
         fh.write("\n")

@@ -1,0 +1,761 @@
+"""simonrace: lock-discipline, lock-order, and thread-ownership passes.
+
+Built on the flow.py CFG tier but mostly lexical: lock scopes in this
+codebase are `with`-blocks, so "which locks are held at this node" is a
+syntactic property, and the interesting analysis is the MODEL — which names
+are locks, which attributes they guard, which classes other threads can
+actually reach, and which lock is acquired while which is held.
+
+The model, per module (cross-file analysis would poison the per-file
+LintCache, and every shipped lock structure here is module-local):
+
+  * **locks** — module-level `NAME = threading.Lock()/RLock()/Condition()`
+    assignments, class attributes `self.X = threading.Lock()` (any method),
+    and cross-object locks reached through a typed attribute chain
+    (`self._family._lock` canonicalizes via the `__init__` annotation
+    `family: "MetricFamily"`). Lock-ish names that cannot be canonicalized
+    still count as "a lock is held" (race pass, FP control) but are excluded
+    from the order graph (a "?" node would fabricate cycles).
+  * **guarded attributes** — `self.X` written under any held lock in any
+    non-dunder method is guarded; writes include item/slice stores, `del`,
+    and the standard mutator methods (`.append`, `.update`, ...).
+  * **thread reachability** — a class is multi-thread-reachable when it owns
+    a lock (locks exist to be contended) or it escapes: a bound method or a
+    locally-constructed instance reaches `threading.Thread/Timer`,
+    `executor.submit`-style dispatch, or a `guard.supervised` worker.
+  * **acquires-while-holding** — the digraph whose edges are inner `with`
+    acquisitions and calls-under-lock into module functions whose transitive
+    acquire set is known; any cycle is a deadlock order violation.
+
+Three rules ride the model: `race-unguarded-attr` (ERROR, off-lock access of
+a guarded attribute in a reachable method, both sites cited),
+`lock-order-cycle` (ERROR, witness chain), and `thread-owner` (WARNING,
+every started Thread must be daemon-with-name or joined in-module).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .base import Finding, Severity, register
+from .context import ModuleContext
+
+LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+}
+THREAD_FACTORIES = {"threading.Thread", "threading.Timer"}
+SUBMIT_ATTRS = {"submit", "run_in_executor", "apply_async", "map_async"}
+
+# Mutating calls on a container attribute count as writes for guarded-attr
+# inference: `self._queue.append(x)` under the lock guards `_queue`.
+_MUTATORS = {
+    "append", "extend", "insert", "add", "remove", "discard", "pop",
+    "popleft", "appendleft", "clear", "update", "setdefault", "sort",
+    "reverse", "put", "put_nowait",
+}
+
+_LOCKISH_RE = re.compile(r"(?:^|_)(?:lock|locks|cv|cond|condition|mutex)$",
+                         re.IGNORECASE)
+
+_DUNDER_SKIP = {"__init__", "__new__", "__del__", "__enter__", "__exit__"}
+
+
+# -------------------------------------------------------------------- model --
+
+
+@dataclass
+class GuardSite:
+    """First observed guarded write of one attribute."""
+
+    lock: str
+    line: int
+    cls: str
+    method: str
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    node: ast.ClassDef
+    lock_attrs: Dict[str, int] = field(default_factory=dict)
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    methods: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+    guarded: Dict[str, GuardSite] = field(default_factory=dict)
+    escape_lines: List[int] = field(default_factory=list)
+
+    @property
+    def reachable(self) -> bool:
+        return bool(self.lock_attrs) or bool(self.escape_lines)
+
+
+@dataclass
+class ModuleConcurrency:
+    module_locks: Dict[str, int] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    # module-wide attr name -> guard site, for foreign-object accesses
+    # (`child._counts` read in MetricFamily.samples matches _HistChild's
+    # guarded `_counts`) and for module-global discipline
+    guarded_attrs: Dict[str, GuardSite] = field(default_factory=dict)
+    guarded_globals: Dict[str, GuardSite] = field(default_factory=dict)
+
+
+def _iter_with_items(node):
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        return node.items
+    return []
+
+
+def _walk_no_defs(stmts):
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _attr_chain(expr: ast.expr) -> Optional[List[str]]:
+    """["self", "_family", "_lock"] for self._family._lock, else None."""
+    parts: List[str] = []
+    cur = expr
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if not isinstance(cur, ast.Name):
+        return None
+    parts.append(cur.id)
+    return list(reversed(parts))
+
+
+def _canon_lock(ctx: ModuleContext, mc: ModuleConcurrency,
+                cls: Optional[ClassInfo], expr: ast.expr) -> Optional[str]:
+    """Canonical name for a lock expression, or None when it is not lock-like.
+
+    Canonical forms: `MODULE.NAME` for module-level locks (resolved through
+    import aliases, so `guard._STATE_LOCK` keeps one identity), `Class.attr`
+    for instance locks, following ONE typed attribute hop
+    (`self._family._lock` -> `MetricFamily._lock` when `__init__` annotates
+    the `_family` param). Lock-ish names that cannot be canonicalized return
+    `"?<name>"`: held for the race pass, excluded from the order graph.
+    """
+    chain = _attr_chain(expr)
+    if chain is None:
+        return None
+    if len(chain) == 1:
+        name = chain[0]
+        if name in mc.module_locks:
+            return name
+        return f"?{name}" if _LOCKISH_RE.search(name) else None
+    if chain[0] == "self" and cls is not None:
+        if len(chain) == 2:
+            if chain[1] in cls.lock_attrs:
+                return f"{cls.name}.{chain[1]}"
+            return (f"?{cls.name}.{chain[1]}"
+                    if _LOCKISH_RE.search(chain[1]) else None)
+        if len(chain) == 3:
+            # one typed hop: self.<attr: T>.<lock>
+            tname = cls.attr_types.get(chain[1])
+            target = mc.classes.get(tname) if tname else None
+            if target is not None and chain[2] in target.lock_attrs:
+                return f"{target.name}.{chain[2]}"
+            return (f"?{cls.name}.{chain[1]}.{chain[2]}"
+                    if _LOCKISH_RE.search(chain[2]) else None)
+        return (f"?{'.'.join(chain)}"
+                if _LOCKISH_RE.search(chain[-1]) else None)
+    # module-qualified: resolve through import aliases
+    r = ctx.resolve(expr)
+    if r is not None and _LOCKISH_RE.search(r.rsplit(".", 1)[-1]):
+        return r
+    return None
+
+
+def _held_map(ctx: ModuleContext, mc: ModuleConcurrency,
+              cls: Optional[ClassInfo],
+              body: List[ast.stmt]) -> Dict[ast.AST, frozenset]:
+    """id-keyed map: every node in `body` -> frozenset of held lock names.
+    Lexical (with-block nesting); nested defs are separate execution
+    contexts and are not entered."""
+    held_at: Dict[ast.AST, frozenset] = {}
+
+    def visit(node: ast.AST, held: frozenset) -> None:
+        held_at[node] = held
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                # the lock expression itself evaluates BEFORE acquisition
+                visit(item, held)
+                ln = _canon_lock(ctx, mc, cls, item.context_expr)
+                if ln is not None:
+                    inner = inner | {ln}
+            for child in node.body:
+                visit(child, inner)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in body:
+        visit(stmt, frozenset())
+    return held_at
+
+
+def _write_targets(node: ast.AST) -> List[ast.Attribute]:
+    """Attribute nodes WRITTEN by this statement/expression: assignment
+    targets, item/slice stores (`self.x[k] = v`), `del self.x[...]`, and
+    mutator calls (`self.x.append(v)`)."""
+    out: List[ast.Attribute] = []
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Attribute):
+                    out.append(sub)
+                    break  # outermost attribute of this target only
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            base = t.value if isinstance(t, ast.Subscript) else t
+            if isinstance(base, ast.Attribute):
+                out.append(base)
+    elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if node.func.attr in _MUTATORS and isinstance(node.func.value,
+                                                      ast.Attribute):
+            out.append(node.func.value)
+    elif isinstance(node, ast.Subscript) and isinstance(node.ctx,
+                                                        (ast.Store, ast.Del)):
+        if isinstance(node.value, ast.Attribute):
+            out.append(node.value)
+    return out
+
+
+def _is_self_attr(node: ast.Attribute) -> bool:
+    return isinstance(node.value, ast.Name) and node.value.id == "self"
+
+
+def _class_of(ctx: ModuleContext, node: ast.AST) -> Optional[ast.ClassDef]:
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        if isinstance(cur, ast.FunctionDef) and not isinstance(
+                ctx.parents.get(cur), ast.ClassDef):
+            # a method's nested worker def belongs to the method's class;
+            # keep climbing only through function scopes
+            pass
+        cur = ctx.parents.get(cur)
+    return None
+
+
+def module_concurrency(ctx: ModuleContext) -> ModuleConcurrency:
+    """Build (and memoize on the ctx) the per-module concurrency model."""
+    cached = getattr(ctx, "_simonrace_model", None)
+    if cached is not None:
+        return cached
+    mc = ModuleConcurrency()
+
+    # module-level locks
+    for stmt in ctx.tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            if ctx.resolve(stmt.value.func) in LOCK_FACTORIES:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        mc.module_locks[t.id] = stmt.lineno
+
+    # classes: methods, lock attrs, typed attrs
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        ci = ClassInfo(name=node.name, node=node)
+        for item in node.body:
+            if isinstance(item, ast.FunctionDef):
+                ci.methods.setdefault(item.name, item)
+        init = ci.methods.get("__init__")
+        ann: Dict[str, str] = {}
+        if init is not None:
+            for p in init.args.posonlyargs + init.args.args + init.args.kwonlyargs:
+                if p.annotation is not None:
+                    if isinstance(p.annotation, ast.Constant) and isinstance(
+                            p.annotation.value, str):
+                        ann[p.arg] = p.annotation.value
+                    elif isinstance(p.annotation, ast.Name):
+                        ann[p.arg] = p.annotation.id
+        for m in ci.methods.values():
+            for sub in _walk_no_defs(m.body):
+                if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1):
+                    continue
+                t = sub.targets[0]
+                if not (isinstance(t, ast.Attribute) and _is_self_attr(t)):
+                    continue
+                if isinstance(sub.value, ast.Call):
+                    r = ctx.resolve(sub.value.func)
+                    if r in LOCK_FACTORIES:
+                        ci.lock_attrs.setdefault(t.attr, sub.lineno)
+                        continue
+                    if isinstance(sub.value.func, ast.Name):
+                        ci.attr_types.setdefault(t.attr, sub.value.func.id)
+                if isinstance(sub.value, ast.Name) and sub.value.id in ann:
+                    ci.attr_types.setdefault(t.attr, ann[sub.value.id])
+        mc.classes[node.name] = ci
+
+    # guarded-attr inference (needs every class's lock_attrs complete first)
+    for cname in sorted(mc.classes):
+        ci = mc.classes[cname]
+        for mname in sorted(ci.methods):
+            if mname in _DUNDER_SKIP:
+                continue
+            method = ci.methods[mname]
+            held_at = _held_map(ctx, mc, ci, method.body)
+            for sub in _walk_no_defs(method.body):
+                held = held_at.get(sub, frozenset())
+                if not held:
+                    continue
+                for attr in _write_targets(sub):
+                    if not _is_self_attr(attr) or attr.attr in ci.lock_attrs:
+                        continue
+                    site = GuardSite(sorted(held)[0], attr.lineno,
+                                     cname, mname)
+                    ci.guarded.setdefault(attr.attr, site)
+                    mc.guarded_attrs.setdefault(attr.attr, site)
+
+    # module-global discipline: `global NAME` writes / NAME.mutator() calls
+    # under a module-level lock guard that global
+    for fname in sorted(ctx.functions):
+        for fn in ctx.functions[fname]:
+            if _class_of(ctx, fn) is not None:
+                continue
+            held_at = _held_map(ctx, mc, None, fn.body)
+            declared = {n for sub in _walk_no_defs(fn.body)
+                        if isinstance(sub, ast.Global) for n in sub.names}
+            for sub in _walk_no_defs(fn.body):
+                held = held_at.get(sub, frozenset())
+                mod_held = [h for h in held if h in mc.module_locks]
+                if not mod_held:
+                    continue
+                names: List[Tuple[str, int]] = []
+                if isinstance(sub, ast.Assign):
+                    names = [(t.id, t.lineno) for t in sub.targets
+                             if isinstance(t, ast.Name) and t.id in declared]
+                elif isinstance(sub, ast.Call) and isinstance(
+                        sub.func, ast.Attribute):
+                    v = sub.func.value
+                    if (sub.func.attr in _MUTATORS and isinstance(v, ast.Name)
+                            and v.id not in mc.module_locks):
+                        names = [(v.id, v.lineno)]
+                elif isinstance(sub, ast.Subscript) and isinstance(
+                        sub.ctx, (ast.Store, ast.Del)):
+                    if isinstance(sub.value, ast.Name):
+                        names = [(sub.value.id, sub.value.lineno)]
+                for name, line in names:
+                    if name.isupper() or name in declared:
+                        mc.guarded_globals.setdefault(
+                            name, GuardSite(sorted(mod_held)[0], line,
+                                            "<module>", fname))
+
+    _collect_escapes(ctx, mc)
+    ctx._simonrace_model = mc  # type: ignore[attr-defined]
+    return mc
+
+
+def _collect_escapes(ctx: ModuleContext, mc: ModuleConcurrency) -> None:
+    """Mark classes whose instances/bound methods reach another thread."""
+    method_owner: Dict[str, List[str]] = {}
+    for cname, ci in mc.classes.items():
+        for mname in ci.methods:
+            method_owner.setdefault(mname, []).append(cname)
+
+    def local_types(site: ast.AST) -> Dict[str, str]:
+        fn = ctx.enclosing_function(site)
+        out: Dict[str, str] = {}
+        if fn is None:
+            return out
+        for sub in _walk_no_defs(fn.body):
+            if (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Name)
+                    and isinstance(sub.value, ast.Call)
+                    and isinstance(sub.value.func, ast.Name)
+                    and sub.value.func.id in mc.classes):
+                out[sub.targets[0].id] = sub.value.func.id
+        return out
+
+    def mark(expr: Optional[ast.expr], site: ast.AST) -> None:
+        if expr is None:
+            return
+        line = getattr(expr, "lineno", getattr(site, "lineno", 0))
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            for el in expr.elts:
+                mark(el, site)
+            return
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                cls = _class_of(ctx, site)
+                if cls is not None and cls.name in mc.classes:
+                    mc.classes[cls.name].escape_lines.append(line)
+                return
+            if isinstance(base, ast.Name):
+                t = local_types(site).get(base.id)
+                if t is None:
+                    owners = method_owner.get(expr.attr, [])
+                    t = owners[0] if len(owners) == 1 else None
+                if t in mc.classes:
+                    mc.classes[t].escape_lines.append(line)
+            return
+        if isinstance(expr, ast.Name):
+            t = local_types(site).get(expr.id)
+            if t in mc.classes:
+                mc.classes[t].escape_lines.append(line)
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        r = ctx.resolve(node.func) or ""
+        is_thread = r in THREAD_FACTORIES
+        is_submit = (isinstance(node.func, ast.Attribute)
+                     and node.func.attr in SUBMIT_ATTRS)
+        is_supervised = r == "supervised" or r.endswith(".supervised")
+        if is_thread:
+            for kw in node.keywords:
+                if kw.arg in ("target", "args", "kwargs"):
+                    mark(kw.value, node)
+            for a in node.args[:2]:
+                mark(a, node)
+        elif is_submit or is_supervised:
+            for a in node.args:
+                mark(a, node)
+            for kw in node.keywords:
+                mark(kw.value, node)
+
+
+# ---------------------------------------------------- race-unguarded-attr --
+
+
+@register(
+    "race-unguarded-attr", Severity.ERROR,
+    "An attribute consistently written under a lock is read or written "
+    "OFF-lock in a method of a multi-thread-reachable class (one that owns "
+    "a lock or escapes to threading.Thread/Timer, executor.submit, or a "
+    "guard.supervised worker). The PR 14 torn-scrape bug was exactly this "
+    "shape: histogram child state mutated under the family lock, then read "
+    "bucket-by-bucket off-lock by samples(), yielding rows whose sum/count "
+    "never co-occurred. Take the lock (or copy under it), or waive a "
+    "deliberate racy fast path with `# simonlint: ignore[race-unguarded-"
+    "attr] -- <why>` naming the happens-before argument.",
+)
+def rule_race_unguarded_attr(ctx: ModuleContext) -> List[Finding]:
+    mc = module_concurrency(ctx)
+    out: List[Finding] = []
+    base = os.path.basename(ctx.path)
+    for cname in sorted(mc.classes):
+        ci = mc.classes[cname]
+        if not ci.reachable:
+            continue
+        for mname in sorted(ci.methods):
+            # `*_locked` is this repo's caller-holds-lock contract (xray's
+            # _reindex_locked): the method is only entered with the lock
+            # held, so its lexically off-lock accesses are guarded
+            if mname in _DUNDER_SKIP or mname.endswith("_locked"):
+                continue
+            method = ci.methods[mname]
+            held_at = _held_map(ctx, mc, ci, method.body)
+            reported: Set[Tuple[str, bool]] = set()
+            for sub in _walk_no_defs(method.body):
+                if not isinstance(sub, ast.Attribute):
+                    continue
+                if held_at.get(sub, frozenset()):
+                    continue
+                is_self = _is_self_attr(sub)
+                if is_self:
+                    site = ci.guarded.get(sub.attr)
+                    if site is None or sub.attr in ci.lock_attrs:
+                        continue
+                else:
+                    site = mc.guarded_attrs.get(sub.attr)
+                    if site is None or site.cls == cname:
+                        continue
+                    # only object-attribute loads, not module attrs
+                    if not isinstance(sub.value, ast.Name):
+                        continue
+                    if sub.value.id in ctx.aliases:
+                        continue
+                key = (sub.attr, is_self)
+                if key in reported:
+                    continue
+                reported.add(key)
+                kind = ("written" if isinstance(sub.ctx, (ast.Store, ast.Del))
+                        else "read")
+                where = (f"'{cname}.{mname}'" if is_self
+                         else f"'{cname}.{mname}' via "
+                              f"'{ast.unparse(sub.value)}.{sub.attr}'")
+                out.append(Finding(
+                    "race-unguarded-attr", Severity.ERROR, ctx.path,
+                    sub.lineno, sub.col_offset,
+                    f"attribute '{sub.attr}' is guarded by {site.lock} "
+                    f"(written under it at {base}:{site.line} in "
+                    f"'{site.cls}.{site.method}') but {kind} off-lock in "
+                    f"{where} — torn or stale state once another thread "
+                    f"holds the lock; acquire it, copy under it, or waive "
+                    f"with the happens-before argument",
+                ))
+
+    # module-global discipline: guarded globals read/written off-lock in
+    # module-level functions (guard._EVENTS / faults._PLAN shape)
+    if mc.guarded_globals:
+        for fname in sorted(ctx.functions):
+            for fn in ctx.functions[fname]:
+                if _class_of(ctx, fn) is not None or fname in _DUNDER_SKIP:
+                    continue
+                held_at = _held_map(ctx, mc, None, fn.body)
+                locals_: Set[str] = {
+                    t.id for sub in _walk_no_defs(fn.body)
+                    if isinstance(sub, ast.Assign)
+                    for t in sub.targets if isinstance(t, ast.Name)}
+                declared = {n for sub in _walk_no_defs(fn.body)
+                            if isinstance(sub, ast.Global)
+                            for n in sub.names}
+                reported_g: Set[str] = set()
+                for sub in _walk_no_defs(fn.body):
+                    if not isinstance(sub, ast.Name):
+                        continue
+                    name = sub.id
+                    site = mc.guarded_globals.get(name)
+                    if site is None or name in reported_g:
+                        continue
+                    if name in locals_ and name not in declared:
+                        continue  # a local shadows the global
+                    if held_at.get(sub, frozenset()):
+                        continue
+                    if site.method == fname:
+                        pass  # same function can still misuse it off-lock
+                    reported_g.add(name)
+                    kind = ("written"
+                            if isinstance(sub.ctx, (ast.Store, ast.Del))
+                            else "read")
+                    out.append(Finding(
+                        "race-unguarded-attr", Severity.ERROR, ctx.path,
+                        sub.lineno, sub.col_offset,
+                        f"module global '{name}' is guarded by {site.lock} "
+                        f"(written under it at {base}:{site.line} in "
+                        f"'{site.method}') but {kind} off-lock in "
+                        f"'{fname}' — acquire the lock or waive with the "
+                        f"happens-before argument",
+                    ))
+    return out
+
+
+# ------------------------------------------------------- lock-order-cycle --
+
+
+def _function_class(ctx: ModuleContext,
+                    mc: ModuleConcurrency,
+                    fn: ast.FunctionDef) -> Optional[ClassInfo]:
+    cls = _class_of(ctx, fn)
+    return mc.classes.get(cls.name) if cls is not None else None
+
+
+def _acquire_summaries(ctx: ModuleContext,
+                       mc: ModuleConcurrency) -> Dict[str, Set[str]]:
+    """function name -> transitive set of canonical locks it may acquire.
+    Name-keyed (collisions merge conservatively); resolved through direct
+    calls `f()` and method calls `self.m()` / `obj.m()` by name."""
+    direct: Dict[str, Set[str]] = {}
+    calls: Dict[str, Set[str]] = {}
+    for fname, defs in ctx.functions.items():
+        acq: Set[str] = set()
+        callees: Set[str] = set()
+        for fn in defs:
+            ci = _function_class(ctx, mc, fn)
+            for sub in _walk_no_defs(fn.body):
+                for item in _iter_with_items(sub):
+                    ln = _canon_lock(ctx, mc, ci, item.context_expr)
+                    if ln is not None and not ln.startswith("?"):
+                        acq.add(ln)
+                if isinstance(sub, ast.Call):
+                    if isinstance(sub.func, ast.Name):
+                        callees.add(sub.func.id)
+                    elif isinstance(sub.func, ast.Attribute):
+                        callees.add(sub.func.attr)
+        direct[fname] = acq
+        calls[fname] = callees & set(ctx.functions)
+    out = {f: set(a) for f, a in direct.items()}
+    for _ in range(len(out) + 1):
+        changed = False
+        for f in out:
+            for c in calls[f]:
+                extra = out.get(c, set()) - out[f]
+                if extra:
+                    out[f] |= extra
+                    changed = True
+        if not changed:
+            break
+    return out
+
+
+@register(
+    "lock-order-cycle", Severity.ERROR,
+    "The acquires-while-holding graph of this module has a cycle: two code "
+    "paths take the same locks in opposite orders (directly nested `with` "
+    "blocks, or a call made under one lock into a function that takes "
+    "another). Two threads interleaving those paths deadlock, and on the "
+    "serving path that means a wedged dispatcher with live watchdogs. Break "
+    "the cycle by ordering the acquisitions consistently or by copying "
+    "state out of the inner lock before taking the outer one; waive only "
+    "with `# simonlint: ignore[lock-order-cycle] -- <why>` proving the "
+    "paths cannot run concurrently.",
+)
+def rule_lock_order_cycle(ctx: ModuleContext) -> List[Finding]:
+    mc = module_concurrency(ctx)
+    summaries = _acquire_summaries(ctx, mc)
+    # adj[a][b] = (line, description) for the first a->b edge witnessed
+    adj: Dict[str, Dict[str, Tuple[int, str]]] = {}
+
+    def edge(a: str, b: str, line: int, desc: str) -> None:
+        if a == b:
+            return  # re-entrant acquisition (RLock) — not an order fact
+        adj.setdefault(a, {}).setdefault(b, (line, desc))
+
+    for fname in sorted(ctx.functions):
+        for fn in ctx.functions[fname]:
+            ci = _function_class(ctx, mc, fn)
+            held_at = _held_map(ctx, mc, ci, fn.body)
+            for sub in _walk_no_defs(fn.body):
+                held = {h for h in held_at.get(sub, frozenset())
+                        if not h.startswith("?")}
+                if not held:
+                    continue
+                for item in _iter_with_items(sub):
+                    ln = _canon_lock(ctx, mc, ci, item.context_expr)
+                    if ln is None or ln.startswith("?"):
+                        continue
+                    for h in sorted(held):
+                        edge(h, ln, sub.lineno,
+                             f"with-block in '{fname}'")
+                if isinstance(sub, ast.Call):
+                    callee = None
+                    if isinstance(sub.func, ast.Name):
+                        callee = sub.func.id
+                    elif isinstance(sub.func, ast.Attribute):
+                        callee = sub.func.attr
+                    if callee is None or callee not in summaries:
+                        continue
+                    for ln in sorted(summaries[callee]):
+                        if ln in held:
+                            continue
+                        for h in sorted(held):
+                            edge(h, ln, sub.lineno,
+                                 f"call to '{callee}' in '{fname}'")
+
+    out: List[Finding] = []
+    seen_cycles: Set[frozenset] = set()
+    for start in sorted(adj):
+        # BFS back to `start` through the edge set
+        parent: Dict[str, str] = {}
+        queue = [start]
+        found: Optional[List[str]] = None
+        visited: Set[str] = set()
+        while queue and found is None:
+            a = queue.pop(0)
+            for b in sorted(adj.get(a, {})):
+                if b == start:
+                    path = [a]
+                    while path[-1] != start and path[-1] in parent:
+                        path.append(parent[path[-1]])
+                    found = list(reversed(path)) + [start]
+                    break
+                if b not in visited:
+                    visited.add(b)
+                    parent[b] = a
+                    queue.append(b)
+        if found is None:
+            continue
+        key = frozenset(found)
+        if key in seen_cycles:
+            continue
+        seen_cycles.add(key)
+        base = os.path.basename(ctx.path)
+        hops = []
+        first_line = None
+        for a, b in zip(found, found[1:]):
+            line, desc = adj[a][b]
+            if first_line is None:
+                first_line = line
+            hops.append(f"{a} -> {b} ({base}:{line}, {desc})")
+        out.append(Finding(
+            "lock-order-cycle", Severity.ERROR, ctx.path,
+            first_line or 1, 0,
+            "lock-order cycle — two interleaved threads deadlock: "
+            + "; ".join(hops)
+            + "; order the acquisitions consistently or copy state out of "
+              "the inner lock first",
+        ))
+    return out
+
+
+# ------------------------------------------------------------ thread-owner --
+
+
+@register(
+    "thread-owner", Severity.WARNING,
+    "A threading.Thread/Timer is started without an owner: it is neither "
+    "daemon-with-a-name (the documented fire-and-forget convention — the "
+    "name is how `simon top`, the sampler, and a stack dump attribute it) "
+    "nor joined on any code path in this module. Anonymous threads are "
+    "exactly how the scope-sampler leak class happens: shutdown paths "
+    "cannot find them. Name it and set daemon=True, join it on a shutdown "
+    "path, or waive with `# simonlint: ignore[thread-owner] -- <why>` "
+    "naming the owner.",
+)
+def rule_thread_owner(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    joined: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"):
+            chain = _attr_chain(node.func.value)
+            if chain is not None:
+                joined.add(chain[-1])
+                joined.add(".".join(chain))
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if ctx.resolve(node.func) not in THREAD_FACTORIES:
+            continue
+        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+        daemon = kwargs.get("daemon")
+        is_daemon = (isinstance(daemon, ast.Constant)
+                     and daemon.value is True)
+        has_name = "name" in kwargs
+        if is_daemon and has_name:
+            continue
+        # joined? — the constructed thread must be bound to a name/attr that
+        # some path in this module joins
+        target_names: Set[str] = set()
+        parent = ctx.parents.get(node)
+        while isinstance(parent, (ast.Attribute, ast.Call)):
+            parent = ctx.parents.get(parent)
+        if isinstance(parent, ast.Assign):
+            for t in parent.targets:
+                chain = _attr_chain(t)
+                if chain is not None:
+                    target_names.add(chain[-1])
+                    target_names.add(".".join(chain))
+        if target_names & joined:
+            continue
+        why = ("started as daemon but anonymous (no name= for attribution)"
+               if is_daemon else
+               "neither daemon-with-name nor joined in this module")
+        out.append(Finding(
+            "thread-owner", Severity.WARNING, ctx.path,
+            node.lineno, node.col_offset,
+            f"thread has no owner: {why} — name it and set daemon=True, "
+            f"join it on a shutdown path, or waive with the owner named",
+        ))
+    return out

@@ -128,6 +128,9 @@ class MetricFamily:
                 f"{self.name}: labels {sorted(kv)} != declared "
                 f"{sorted(self.label_names)}")
         key = tuple(str(kv[n]) for n in self.label_names)
+        # simonlint: ignore[race-unguarded-attr] -- double-checked fast path:
+        # dict.get is GIL-atomic and a miss re-checks under _lock below, which
+        # is the only publisher; a stale miss costs one lock round-trip
         child = self._children.get(key)
         if child is None:
             with self._lock:

@@ -288,10 +288,10 @@ class XrayRecorder:
 
     # ------------------------------------------------------------- writing --
 
-    def _file(self):
+    def _file_locked(self):
         if self.path and self._f is None:
             self._f = open(self.path + ".jsonl", "w", encoding="utf-8")
-            self._write(self._header())
+            self._write_locked(self._header())
         return self._f
 
     def _header(self) -> dict:
@@ -302,7 +302,7 @@ class XrayRecorder:
             "component_names": list(_component_order()),
         }
 
-    def _write(self, rec: dict) -> None:
+    def _write_locked(self, rec: dict) -> None:
         f = self._f
         if f is not None:
             f.write(json.dumps(rec, separators=(",", ":")) + "\n")
@@ -317,7 +317,7 @@ class XrayRecorder:
             nid = len(self._nodes)
             self._node_ids[key] = nid
             self._nodes[nid] = list(names)
-            self._write({"kind": "nodes", "id": nid, "names": self._nodes[nid]})
+            self._write_locked({"kind": "nodes", "id": nid, "names": self._nodes[nid]})
         return nid
 
     def commit(self, run: XrayRun, backend_path: List[str],
@@ -326,7 +326,7 @@ class XrayRecorder:
         with self._lock:
             if self.closed:
                 return
-            self._file()
+            self._file_locked()
             sid_of: Dict[int, int] = {}
             dropped = 0
             first_bid = self._next_batch  # run-local batch k -> first_bid + k
@@ -344,7 +344,7 @@ class XrayRecorder:
                 self._arrays[f"s{sid}_comp"] = s.comp
                 self._arrays[f"s{sid}_mask"] = s.mask_bits
                 self._arrays[f"s{sid}_feas"] = s.feas_bits
-                self._write(rec)
+                self._write_locked(rec)
                 obs.XRAY_RECORDS.labels(kind="set").inc()
             if dropped:
                 # counted on EVERY commit that drops (not only the first):
@@ -390,7 +390,7 @@ class XrayRecorder:
                                 for new_ri, ri in enumerate(keep)
                                 if ri in b.reasons},
                 }
-                self._write(rec)
+                self._write_locked(rec)
                 obs.XRAY_RECORDS.labels(kind="batch").inc()
                 obs.XRAY_RECORDS.labels(kind="pod").inc(len(keep))
                 self._pod_rows += len(keep)
@@ -398,12 +398,12 @@ class XrayRecorder:
                 self._pending.append(("batch", rec))
             for p in run.preempts:
                 p = dict(p, backend_path=list(backend_path))
-                self._write(p)
+                self._write_locked(p)
                 obs.XRAY_RECORDS.labels(kind="preempt").inc()
                 self._pending.append(("preempt", p))
             for p in run.probes:
                 p = dict(p, backend_path=list(backend_path))
-                self._write(p)
+                self._write_locked(p)
                 obs.XRAY_RECORDS.labels(kind="probe").inc()
             if len(self._pending) >= self._PENDING_FLUSH:
                 self._reindex_locked()
@@ -751,6 +751,9 @@ def active() -> Optional[XrayRecorder]:
     global _RECORDER, _ENV_CHECKED
     if _RECORDER is not None:
         return _RECORDER
+    # simonlint: ignore[race-unguarded-attr] -- double-checked init: _ENV_CHECKED
+    # is set under _LOCK before any recorder publish, and a stale False only
+    # routes this reader through the locked slow path once more
     if _ENV_CHECKED:
         return None
     with _LOCK:
@@ -760,6 +763,8 @@ def active() -> Optional[XrayRecorder]:
                     "", "0", "false", "no"):
                 _RECORDER = XrayRecorder(
                     os.environ.get("OPEN_SIMULATOR_XRAY_OUT") or None)
+    # simonlint: ignore[race-unguarded-attr] -- reference read is GIL-atomic;
+    # _RECORDER is published exactly once under _LOCK and never reassigned
     return _RECORDER
 
 

@@ -183,13 +183,16 @@ class FaultPlan:
         return cls(specs, seed=doc.get("seed"))
 
     def to_json(self) -> dict:
-        return {
-            "seed": self.seed,
-            "faults": [{"site": s.site, "attempt": s.attempt, "error": s.error}
-                       for s in self.specs],
-            "arrivals": dict(self.arrivals),
-            "trace": [list(t) for t in self.trace],
-        }
+        # snapshot under the lock: a concurrent on_arrival mutating
+        # `arrivals` mid-dict() would raise or yield a torn count set
+        with self._lock:
+            return {
+                "seed": self.seed,
+                "faults": [{"site": s.site, "attempt": s.attempt,
+                            "error": s.error} for s in self.specs],
+                "arrivals": dict(self.arrivals),
+                "trace": [list(t) for t in self.trace],
+            }
 
     # -------------------------------------------------------------- firing ----
 
@@ -254,6 +257,9 @@ def clear_plan() -> None:
 
 
 def active_plan() -> Optional[FaultPlan]:
+    # simonlint: ignore[race-unguarded-attr] -- reference read is GIL-atomic;
+    # install/clear happen-before worker start/join in every harness, so a
+    # stale None only skips an already-cleared plan
     return _PLAN
 
 
@@ -273,6 +279,8 @@ class installed:
 
 def maybe_fail(site: str) -> None:
     """The per-site hook the hot paths call. Free when no plan is active."""
+    # simonlint: ignore[race-unguarded-attr] -- GIL-atomic reference read on
+    # the hot path; plan installation happens-before the run it targets
     plan = _PLAN
     if plan is not None:
         plan.on_arrival(site)
@@ -281,6 +289,8 @@ def maybe_fail(site: str) -> None:
 def maybe_fail_bulk(site: str, count: int) -> None:
     """`count` arrivals in one call (bulk commit); free when no plan is
     active, replay-equal to `count` maybe_fail calls otherwise."""
+    # simonlint: ignore[race-unguarded-attr] -- GIL-atomic reference read on
+    # the hot path; plan installation happens-before the run it targets
     plan = _PLAN
     if plan is not None:
         plan.on_arrivals(site, count)

@@ -202,9 +202,9 @@ class CircuitBreaker:
         self._state = _CLOSED
         self._opened_at = 0.0
         self._probing = False
-        self._set_gauge()
+        self._set_gauge_locked()  # construction: not yet published, no contention
 
-    def _set_gauge(self) -> None:
+    def _set_gauge_locked(self) -> None:
         obs.BREAKER_STATE.labels(name=self.name).set(self._state)
 
     @property
@@ -225,7 +225,7 @@ class CircuitBreaker:
                         f"({self._failures} consecutive failures)")
                 self._state = _HALF_OPEN
                 self._probing = False
-                self._set_gauge()
+                self._set_gauge_locked()
             # half-open: admit one probe at a time
             if self._probing:
                 raise BreakerOpen(f"circuit {self.name!r} half-open, probe in flight")
@@ -237,7 +237,7 @@ class CircuitBreaker:
             self._probing = False
             if self._state != _CLOSED:
                 self._state = _CLOSED
-                self._set_gauge()
+                self._set_gauge_locked()
 
     def record_failure(self) -> None:
         with self._lock:
@@ -247,4 +247,4 @@ class CircuitBreaker:
                     or self._failures >= self.failure_threshold):
                 self._state = _OPEN
                 self._opened_at = self._clock()
-                self._set_gauge()
+                self._set_gauge_locked()

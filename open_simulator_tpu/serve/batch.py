@@ -77,6 +77,9 @@ class WhatIfService:
         "utilization", "epoch", "lanes", "path"}."""
         if not pods:
             raise ValueError("what-if request has no pods")
+        # simonlint: ignore[race-unguarded-attr] -- racy fast-fail: _submit
+        # re-checks under _cv before enqueueing, so a stale False here only
+        # defers the rejection to that locked check
         if self._stopped:
             raise RuntimeError("serve dispatcher is stopped")
         sc = scope.active()
@@ -295,5 +298,7 @@ class WhatIfService:
             "window_ms": self.window_s * 1000.0,
             "fanout": self.fanout,
             "mesh": img._mesh is not None,
+            # simonlint: ignore[race-unguarded-attr] -- monitoring snapshot:
+            # len() is GIL-atomic and the gauge tolerates one-batch staleness
             "queued": len(self._queue),
         }

@@ -176,6 +176,8 @@ class ResidentImage:
         self._sim = sim
         self._lock = threading.RLock()
         self.generation = 1
+        # simonlint: ignore[race-unguarded-attr] -- construction: the instance
+        # is not published until try_build returns; no concurrent reader yet
         self.seq = 0
         self._pod_index: Dict[str, Tuple[dict, int]] = {}
         self.drained: set = set()
@@ -334,6 +336,9 @@ class ResidentImage:
 
     @property
     def epoch(self) -> str:
+        # simonlint: ignore[race-unguarded-attr] -- epoch stamp: GIL-atomic
+        # int read; racing apply_events yields the previous epoch, which is a
+        # consistent published state
         return f"{self.generation}.{self.seq}"
 
     @property
@@ -948,6 +953,8 @@ class ResidentImage:
                 "unscheduled": total - placed,
                 "utilization": self._utilization(active_s[li],
                                                  requested_s[li]),
+                # simonlint: ignore[race-unguarded-attr] -- epoch stamp:
+                # GIL-atomic int read, same contract as the epoch property
                 "epoch": f"{s.generation}.{self.seq}",
                 "lanes": lanes,
                 "path": "batched",

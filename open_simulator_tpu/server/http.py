@@ -434,7 +434,7 @@ class Server:
             # never drain on the signal frame itself: serve_forever must keep
             # running until the drain thread shuts it down
             threading.Thread(target=self.drain, args=(drain_deadline,),
-                             daemon=True).start()
+                             name="simon-http-drain", daemon=True).start()
 
         try:
             signal.signal(signal.SIGTERM, _on_term)
@@ -458,6 +458,9 @@ class Server:
 
     @property
     def draining(self) -> bool:
+        # simonlint: ignore[race-unguarded-attr] -- GIL-atomic bool read for
+        # monitoring; admission itself re-checks under _state_cv in
+        # _begin_request, so a stale False never admits past a drain
         return self._draining
 
     def drain(self, deadline: Optional[float] = None) -> int:
@@ -476,7 +479,12 @@ class Server:
                     break
                 self._state_cv.wait(timeout=min(left, 0.1))
             stranded = self._inflight
-        svc = self._whatif_svc
+        # read under the init lock: a request that won admission just before
+        # _draining flipped may still be lazily creating the service; the
+        # lock orders that creation before this read so its dispatcher is
+        # stopped too instead of orphaned
+        with self._whatif_lock:
+            svc = self._whatif_svc
         if svc is not None:
             svc.stop()  # wake the micro-batch dispatcher; queued requests fail fast
         if self._scope_owned:

@@ -1,0 +1,76 @@
+"""Fixture for the entropy-into-report rule: ambient entropy (wall clocks,
+unseeded random, set iteration order) flowing into json.dump/json.dumps must
+fire — including through ONE level of module-local helper call (`_now_ms`).
+The waived half is a bench-style record whose wall timings ARE the payload;
+the clean half shows sorted-set, seeded-rng, and pid-suffixed-tmp-path forms
+that must stay quiet."""
+
+import json
+import os
+import random
+import time
+
+
+# ---------------------------------------------------------------- findings ----
+
+
+def stamped_report(rows):
+    doc = {"rows": rows, "generated_at": time.time()}
+    return json.dumps(doc, sort_keys=True)  # finding: wall clock in report
+
+
+def _now_ms():
+    return int(time.time() * 1000)
+
+
+def helper_stamped(path, rows):
+    stamp = _now_ms()  # one call level deep: the helper summary carries it
+    with open(path, "w") as f:
+        json.dump({"rows": rows, "at": stamp}, f)  # finding
+
+
+def jittered_pick(rows):
+    pick = random.choice(rows)  # unseeded module-level random
+    return json.dumps({"pick": pick})  # finding
+
+
+def set_order_leak(names):
+    seen = set(names)
+    out = []
+    for n in seen:  # set iteration order is hash-seed-dependent
+        out.append(n)
+    return json.dumps(out)  # finding
+
+
+# ------------------------------------------------------------------ waived ----
+
+
+def bench_record(rows, elapsed_s):
+    # simonlint: ignore[entropy-into-report] -- bench record: wall timings
+    # ARE the payload (BENCH_ANALYSIS-style artifact, not a golden)
+    return json.dumps({"rows": rows, "recorded_unix": time.time(),
+                       "elapsed_s": elapsed_s})
+
+
+# ------------------------------------------------------------------- clean ----
+
+
+def sorted_set_is_deterministic(names):
+    return json.dumps(sorted(set(names)))  # clean: sorted() fixes the order
+
+
+def seeded_rng_is_deterministic(rows, seed):
+    rng = random.Random(seed)
+    pick = rng.choice(rows)  # clean: seeded instance, not module-level
+    return json.dumps({"pick": pick})
+
+
+def pid_tmp_path_is_content_clean(rec, path):
+    tmp = f"{path}.tmp.{os.getpid()}"  # clean: entropy names the FILE,
+    with open(tmp, "w") as f:          # not the record
+        json.dump(rec, f)
+    os.replace(tmp, path)
+
+
+def pure_payload(rows):
+    return json.dumps({"rows": rows}, sort_keys=True)  # clean

@@ -1,0 +1,141 @@
+"""Fixture for the race-unguarded-attr rule: attributes consistently written
+under a lock must not be touched off-lock in multi-thread-reachable classes.
+The findings half includes a reconstruction of the PR 14 pre-fix torn-scrape
+bug (off-lock samples() read of lock-guarded child state) — the known-bug
+regression the pass exists to catch. The waived half shows a deliberate racy
+fast path with its happens-before argument; the clean half shows the locked,
+`*_locked`-convention, and never-escaping forms that must stay quiet."""
+
+import threading
+
+_STATE_LOCK = threading.Lock()
+_EVENTS = []
+
+
+# ------------------------------------------------- findings: torn scrape ----
+
+
+class TornScrapeFamily:
+    """PR 14 pre-fix shape: children mutate under the family lock, samples()
+    reads them bucket-by-bucket OFF-lock — rows whose sum/count never
+    co-occurred."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._children = {}
+
+    def labels(self, key):
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = TornScrapeChild(self)
+                self._children[key] = child
+        return child
+
+    def samples(self):
+        out = []
+        # finding: _children read off-lock (guarded write in labels)
+        for child in list(self._children.values()):
+            # findings: _count/_sum are written under the family lock in
+            # TornScrapeChild.observe but read here with no lock held
+            out.append((child._count, child._sum))
+        return out
+
+
+class TornScrapeChild:
+    def __init__(self, family: "TornScrapeFamily"):
+        self._family = family
+        self._count = 0
+        self._sum = 0.0
+
+    def observe(self, v):
+        with self._family._lock:
+            self._count += 1
+            self._sum += v
+
+
+# ------------------------------------- findings: escape + module globals ----
+
+
+class EscapingWorker:
+    """No lock of its own, but a bound method escapes to a Thread — the
+    class is multi-thread-reachable, so off-lock reads of its guarded state
+    are findings."""
+
+    def __init__(self):
+        self.items = []
+        self.t = None
+
+    def start(self):
+        self.t = threading.Thread(target=self._run, name="fixture-worker",
+                                  daemon=True)
+        self.t.start()
+
+    def _run(self):
+        with _STATE_LOCK:
+            self.items.append(1)
+
+    def snapshot(self):
+        return len(self.items)  # finding: off-lock read, class escapes
+
+
+def record_event(evt):
+    with _STATE_LOCK:
+        _EVENTS.append(evt)
+
+
+def peek_events():
+    return list(_EVENTS)  # finding: module global guarded by _STATE_LOCK
+
+
+# ------------------------------------------------------------------ waived ----
+
+
+class RacyGauge:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self):
+        with self._lock:
+            self._value += 1
+
+    def peek(self):
+        # simonlint: ignore[race-unguarded-attr] -- monitoring read: int load
+        # is GIL-atomic and the gauge tolerates one-increment staleness
+        return self._value
+
+
+# ------------------------------------------------------------------- clean ----
+
+
+class LockedCounter:
+    """Clean: every access takes the lock, and the `*_locked` suffix marks
+    the caller-holds-lock contract."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def inc(self):
+        with self._lock:
+            self._n += 1
+            self._reset_if_huge_locked()
+
+    def value(self):
+        with self._lock:
+            return self._n
+
+    def _reset_if_huge_locked(self):
+        if self._n > 1 << 30:
+            self._n = 0
+
+
+class Unshared:
+    """Clean: owns no lock and never escapes to a thread — not patrolled."""
+
+    def __init__(self):
+        self.hits = 0
+
+    def bump(self):
+        self.hits += 1

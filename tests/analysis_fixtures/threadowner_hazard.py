@@ -1,0 +1,62 @@
+"""Fixture for the thread-owner rule: every started Thread/Timer must be
+daemon-with-a-name (the attribution convention `simon top` and stack dumps
+rely on) or joined somewhere in the module. The waived half names its owner;
+the clean half shows the named-daemon, joined-local, and joined-attribute
+forms that must stay quiet."""
+
+import threading
+
+
+# ---------------------------------------------------------------- findings ----
+
+
+def anon_daemon_worker(fn):
+    # finding: daemon but anonymous — nothing can attribute or find it
+    threading.Thread(target=fn, daemon=True).start()
+
+
+def named_but_unowned(fn):
+    # finding: named yet neither daemon nor joined in this module
+    loose = threading.Thread(target=fn, name="fixture-loose")
+    loose.start()
+
+
+def anon_timer(fn):
+    # finding: Timers are threads too
+    threading.Timer(0.1, fn).start()
+
+
+# ------------------------------------------------------------------ waived ----
+
+
+def one_shot_cli_worker(fn):
+    # simonlint: ignore[thread-owner] -- owner: the CLI one-shot path;
+    # process exit reaps it before any shutdown path exists
+    threading.Thread(target=fn).start()
+
+
+# ------------------------------------------------------------------- clean ----
+
+
+def named_daemon(fn):
+    threading.Thread(target=fn, name="fixture-owned", daemon=True).start()
+
+
+def joined_local(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+
+
+class OwnedSampler:
+    """Clean: the constructed thread is an attribute joined on a named
+    shutdown path (the obs.scope RuntimeSampler shape)."""
+
+    def __init__(self, fn):
+        self._thread = threading.Thread(target=fn)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._thread.join()

@@ -224,7 +224,9 @@ def test_fixture_tree_reports_all_families_and_fails():
     assert {"host-sync-in-jit", "recompile-trigger",
             "dtype-drift", "carry-contract", "metric-in-jit",
             "swallowed-exception", "naked-dispatch",
-            "fetch-in-wave-loop"} <= fired
+            "fetch-in-wave-loop", "race-unguarded-attr",
+            "lock-order-cycle", "entropy-into-report",
+            "thread-owner"} <= fired
     assert report.active(Severity.WARNING)
     rc = run_lint([str(FIXTURES)])
     assert rc == 1
@@ -506,6 +508,9 @@ def test_ruleset_digest_covers_contract_grammar_and_driver(monkeypatch, tmp_path
 
     names = [Path(p).name for p in runner._DIGEST_SOURCES]
     assert "contracts.py" in names and "runner.py" in names
+    # the flow tier: editing the CFG/taint engine or the lock model must
+    # invalidate every cached finding set
+    assert "flow.py" in names and "threads.py" in names
     copies = []
     for p in runner._DIGEST_SOURCES:
         dst = tmp_path / Path(p).name
@@ -557,3 +562,347 @@ def test_per_pod_host_loop_spares_columnar_and_node_loops():
                     if "def vectorized_ok" in l)
     assert not any(f.line >= ok_start for f in fr.findings
                    if f.rule == "per-pod-host-loop")
+
+
+# ------------------------------------------------- simonrace: race detector --
+
+
+def test_race_unguarded_attr_rule_fires():
+    # torn-scrape x3, escaping-worker snapshot, module-global peek = 5; the
+    # RacyGauge monitoring read carries its happens-before waiver
+    assert _counts("race_hazard.py", "race-unguarded-attr") == 5
+    assert _counts("race_hazard.py", "race-unguarded-attr",
+                   suppressed=True) == 1
+
+
+def test_race_torn_scrape_regression():
+    """The PR 14 known-bug regression: the pre-fix torn-scrape pattern
+    (off-lock samples() read of lock-guarded child state) must be reported
+    as race-unguarded-attr, with BOTH sites cited — the off-lock read and
+    the guarded write it races."""
+    fr = analyze_file(str(FIXTURES / "race_hazard.py"))
+    hits = [f for f in fr.findings
+            if f.rule == "race-unguarded-attr" and not f.suppressed]
+    by_attr = {f.message.split("'")[1]: f for f in hits}
+    assert {"_children", "_count", "_sum"} <= set(by_attr)
+    for attr in ("_count", "_sum"):
+        f = by_attr[attr]
+        assert "TornScrapeFamily.samples" in f.message  # the off-lock read
+        assert "TornScrapeChild.observe" in f.message   # the guarded write
+        assert "race_hazard.py:" in f.message           # ...cited by site
+        # the child's lock is reached through the typed `family` attribute
+        assert "TornScrapeFamily._lock" in f.message
+
+
+def test_race_spares_locked_convention_and_unshared_classes():
+    fr = analyze_file(str(FIXTURES / "race_hazard.py"))
+    src = (FIXTURES / "race_hazard.py").read_text().splitlines()
+    ok_start = next(i for i, l in enumerate(src, 1)
+                    if "class LockedCounter" in l)
+    assert not any(f.line >= ok_start for f in fr.findings
+                   if f.rule == "race-unguarded-attr")
+
+
+def test_race_guarded_inference_on_real_metrics_module():
+    """Hand-labeled ground truth from obs/metrics.py: MetricFamily owns
+    _lock and guards _children; the child classes' histogram state is
+    guarded through the typed `family` attribute hop."""
+    from open_simulator_tpu.analysis.context import ModuleContext
+    from open_simulator_tpu.analysis.threads import module_concurrency
+
+    path = PACKAGE / "obs" / "metrics.py"
+    ctx = ModuleContext(str(path), path.read_text())
+    mc = module_concurrency(ctx)
+    fam = mc.classes["MetricFamily"]
+    assert "_lock" in fam.lock_attrs
+    assert fam.reachable
+    assert fam.guarded["_children"].lock == "MetricFamily._lock"
+    hist = mc.classes["_HistChild"]
+    for attr in ("_counts", "_sum", "_count"):
+        assert hist.guarded[attr].lock == "MetricFamily._lock", attr
+    reg = mc.classes["Registry"]
+    assert "_lock" in reg.lock_attrs
+    assert "_families" in reg.guarded
+
+
+def test_race_guarded_inference_on_real_batch_module():
+    """serve/batch.py ground truth: WhatIfService owns the dispatch
+    Condition and guards the queue + stop flag under it."""
+    from open_simulator_tpu.analysis.context import ModuleContext
+    from open_simulator_tpu.analysis.threads import module_concurrency
+
+    path = PACKAGE / "serve" / "batch.py"
+    ctx = ModuleContext(str(path), path.read_text())
+    mc = module_concurrency(ctx)
+    svc = mc.classes["WhatIfService"]
+    assert "_cv" in svc.lock_attrs
+    assert svc.reachable  # owns a lock AND its _loop escapes to the thread
+    assert svc.escape_lines  # Thread(target=self._loop) marks the escape
+    assert svc.guarded["_queue"].lock == "WhatIfService._cv"
+    assert svc.guarded["_stopped"].lock == "WhatIfService._cv"
+
+
+# --------------------------------------------- simonrace: lock-order graph --
+
+
+def test_lock_order_cycle_rule_fires():
+    # the crafted 3-lock cycle fires once (deduped across its 3 rotations);
+    # the phase-exclusive 2-lock inversion carries its waiver
+    assert _counts("lockorder_hazard.py", "lock-order-cycle") == 1
+    assert _counts("lockorder_hazard.py", "lock-order-cycle",
+                   suppressed=True) == 1
+
+
+def test_lock_order_cycle_reports_witness_chain():
+    fr = analyze_file(str(FIXTURES / "lockorder_hazard.py"))
+    hit = next(f for f in fr.findings
+               if f.rule == "lock-order-cycle" and not f.suppressed)
+    for hop in ("_ALLOC -> _BILL", "_BILL -> _COMMIT", "_COMMIT -> _ALLOC"):
+        assert hop in hit.message
+    # each hop cites its acquisition site
+    assert hit.message.count("lockorder_hazard.py:") == 3
+
+
+def test_lock_order_spares_consistent_order_and_reentry():
+    fr = analyze_file(str(FIXTURES / "lockorder_hazard.py"))
+    src = (FIXTURES / "lockorder_hazard.py").read_text().splitlines()
+    ok_start = next(i for i, l in enumerate(src, 1)
+                    if "def outer_then_inner" in l)
+    assert not any(f.line >= ok_start for f in fr.findings
+                   if f.rule == "lock-order-cycle")
+
+
+def test_lock_order_sees_calls_under_lock():
+    """An inversion hidden behind a call (A held, callee takes B; elsewhere
+    B held, caller takes A) is still a cycle — the transitive acquire
+    summary carries it."""
+    import textwrap
+
+    from open_simulator_tpu.analysis.context import ModuleContext
+    from open_simulator_tpu.analysis.threads import rule_lock_order_cycle
+
+    src = textwrap.dedent("""
+        import threading
+        _A = threading.Lock()
+        _B = threading.Lock()
+
+        def helper():
+            with _B:
+                pass
+
+        def forward():
+            with _A:
+                helper()
+
+        def backward():
+            with _B:
+                with _A:
+                    pass
+    """)
+    ctx = ModuleContext("m.py", src)
+    hits = rule_lock_order_cycle(ctx)
+    assert len(hits) == 1
+    assert "call to 'helper'" in hits[0].message
+
+
+# ------------------------------------------------ simonrace: thread-owner --
+
+
+def test_thread_owner_rule_fires():
+    # anonymous daemon, named-but-loose, and a Timer fire; the one-shot CLI
+    # worker names its owner in the waiver
+    assert _counts("threadowner_hazard.py", "thread-owner") == 3
+    assert _counts("threadowner_hazard.py", "thread-owner",
+                   suppressed=True) == 1
+
+
+def test_thread_owner_spares_named_daemons_and_joined_threads():
+    fr = analyze_file(str(FIXTURES / "threadowner_hazard.py"))
+    src = (FIXTURES / "threadowner_hazard.py").read_text().splitlines()
+    ok_start = next(i for i, l in enumerate(src, 1)
+                    if "def named_daemon" in l)
+    assert not any(f.line >= ok_start for f in fr.findings
+                   if f.rule == "thread-owner")
+
+
+# ----------------------------------------------- simonflow: entropy taint --
+
+
+def test_entropy_into_report_rule_fires():
+    # direct clock, one-level helper, unseeded random, set iteration = 4;
+    # the bench record waives with the artifact named
+    assert _counts("entropy_hazard.py", "entropy-into-report") == 4
+    assert _counts("entropy_hazard.py", "entropy-into-report",
+                   suppressed=True) == 1
+
+
+def test_entropy_taint_labels_every_source_kind():
+    fr = analyze_file(str(FIXTURES / "entropy_hazard.py"))
+    msgs = "\n".join(f.message for f in fr.findings
+                     if f.rule == "entropy-into-report" and not f.suppressed)
+    assert "time.time" in msgs
+    assert "_now_ms() [entropy-returning helper]" in msgs
+    assert "random.choice" in msgs
+    assert "set-iteration-order" in msgs
+
+
+def test_entropy_spares_sorted_seeded_and_tmp_path_forms():
+    fr = analyze_file(str(FIXTURES / "entropy_hazard.py"))
+    src = (FIXTURES / "entropy_hazard.py").read_text().splitlines()
+    ok_start = next(i for i, l in enumerate(src, 1)
+                    if "def sorted_set_is_deterministic" in l)
+    assert not any(f.line >= ok_start for f in fr.findings
+                   if f.rule == "entropy-into-report")
+
+
+def test_entropy_helper_summaries_one_level_deep():
+    import textwrap
+
+    from open_simulator_tpu.analysis.context import ModuleContext
+    from open_simulator_tpu.analysis.flow import entropy_returning_functions
+
+    src = textwrap.dedent("""
+        import time
+
+        def _stamp():
+            return time.time()
+
+        def _wraps_stamp():
+            return {"at": _stamp()}
+
+        def _pure(x):
+            return x + 1
+    """)
+    ctx = ModuleContext("m.py", src)
+    fns = entropy_returning_functions(ctx)
+    assert "_stamp" in fns
+    assert "_wraps_stamp" in fns  # the summary fixpoint carries the chain
+    assert "_pure" not in fns
+
+
+# ------------------------------------------------------ simonflow: the CFG --
+
+
+def _cfg_of(src):
+    import ast as _ast
+    import textwrap
+
+    from open_simulator_tpu.analysis import flow
+
+    fn = _ast.parse(textwrap.dedent(src)).body[0]
+    return flow.build_cfg(fn)
+
+
+def test_cfg_if_else_branches_and_join():
+    cfg = _cfg_of("""
+        def f(x):
+            if x:
+                a = 1
+            else:
+                a = 2
+            return a
+    """)
+    labels = {b.label for b in cfg.blocks}
+    assert {"if.then", "if.else", "if.after"} <= labels
+    # both branches reach the join; the join reaches exit via the return
+    after = next(b for b in cfg.blocks if b.label == "if.after")
+    preds = cfg.preds()
+    assert len(preds[after.id]) == 2
+    assert preds[cfg.exit.id]
+
+
+def test_cfg_while_has_back_edge_and_break_exit():
+    cfg = _cfg_of("""
+        def f(n):
+            while n:
+                n -= 1
+                if n == 3:
+                    break
+            return n
+    """)
+    head = next(b for b in cfg.blocks if b.label == "loop.head")
+    after = next(b for b in cfg.blocks if b.label == "loop.after")
+    preds = cfg.preds()
+    # back edge: some body block links to the head beyond the entry edge
+    assert len(preds[head.id]) >= 2
+    # break + normal exit both land on loop.after
+    assert len(preds[after.id]) >= 2
+
+
+def test_cfg_try_finally_routes_exceptional_and_normal_paths():
+    cfg = _cfg_of("""
+        def f(x):
+            try:
+                y = x()
+            except ValueError:
+                y = 0
+            finally:
+                done = True
+            return y
+    """)
+    labels = [b.label for b in cfg.blocks]
+    assert "finally" in labels and "except.0" in labels
+    fin = next(b for b in cfg.blocks if b.label == "finally")
+    preds = cfg.preds()
+    # both the protected body and the handler drain through finally
+    assert len(preds[fin.id]) >= 2
+    handler = next(b for b in cfg.blocks if b.label == "except.0")
+    assert preds[handler.id]  # conservative exception edge from the body
+
+
+def test_cfg_with_as_stays_straight_line():
+    cfg = _cfg_of("""
+        def f(p):
+            with open(p) as fh:
+                data = fh.read()
+            return data
+    """)
+    # no branching: everything lives in the entry block
+    assert [b for b in cfg.blocks if b.stmts] == [cfg.entry]
+    assert cfg.entry.succs == [cfg.exit]
+
+
+def test_cfg_nested_defs_and_comprehensions_are_opaque():
+    import ast as _ast
+
+    cfg = _cfg_of("""
+        def f(xs):
+            def helper(v):
+                while v:
+                    v -= 1
+                return v
+            ys = [helper(x) for x in xs if x]
+            return ys
+    """)
+    # the nested def's while-loop must NOT contribute blocks, and the
+    # comprehension must not branch: entry/exit plus nothing else
+    assert [b for b in cfg.blocks if b.stmts] == [cfg.entry]
+    assert any(isinstance(s, _ast.FunctionDef) for s in cfg.entry.stmts)
+
+
+def test_dataflow_joins_facts_at_merge_points():
+    import ast as _ast
+    import textwrap
+
+    from open_simulator_tpu.analysis import flow
+    from open_simulator_tpu.analysis.context import ModuleContext
+
+    src = textwrap.dedent("""
+        import time
+
+        def f(cond, clean):
+            if cond:
+                v = time.time()
+            else:
+                v = clean
+            return v
+    """)
+    ctx = ModuleContext("m.py", src)
+    fn = ctx.functions["f"][0]
+    eng = flow._TaintEngine(ctx, set())
+    cfg = flow.build_cfg(fn)
+    facts = flow.dataflow_forward(cfg, eng.transfer)
+    # at the join, the tainted branch wins (may-analysis: union)
+    after = next(b for b in cfg.blocks if b.label == "if.after")
+    assert "v" in facts[after.id]
+    assert facts[after.id]["v"][0] == "time.time"

@@ -57,8 +57,39 @@ def _bench_cold_warm() -> int:
     print(format_human(cold))
     print(f"simonlint warm pass: {warm.elapsed_s:.2f}s "
           f"({warm.cache_hits} hit(s), {warm.cache_misses} miss(es))")
+
+    # simonrace flow tier in isolation: the CFG/dataflow rules dominate the
+    # analyzer's cost growth, so their cold/warm seconds get their own bench
+    # row and budget. Separate scratch cache — select-restricted results must
+    # never seed the full-ruleset cache above.
+    flow_rules = ["race-unguarded-attr", "lock-order-cycle",
+                  "entropy-into-report", "thread-owner"]
+    flow_cache = os.path.join(REPO_ROOT, ".simonlint_flow_cache.json")
+    if os.path.exists(flow_cache):
+        os.remove(flow_cache)
+    flow_cold = analyze_paths([tree], select=flow_rules,
+                              cache=LintCache(flow_cache))
+    flow_warm = analyze_paths([tree], select=flow_rules,
+                              cache=LintCache(flow_cache))
+    if os.path.exists(flow_cache):
+        os.remove(flow_cache)  # scratch only; the real cache is above
+    flow_budget_s = 8.0
+    print(f"simonrace flow pass: cold {flow_cold.elapsed_s:.2f}s / warm "
+          f"{flow_warm.elapsed_s:.2f}s (budget {flow_budget_s:.0f}s)")
+
     write_bench(cold, os.path.join(REPO_ROOT, "BENCH_ANALYSIS.json"),
-                warm=warm)
+                warm=warm,
+                extra={"flow": {
+                    "rules": flow_rules,
+                    "elapsed_cold_s": round(flow_cold.elapsed_s, 4),
+                    "elapsed_warm_s": round(flow_warm.elapsed_s, 4),
+                    "budget_s": flow_budget_s,
+                    "within_budget": flow_cold.elapsed_s <= flow_budget_s,
+                }})
+    if flow_cold.elapsed_s > flow_budget_s:
+        print(f"simonrace flow pass over budget: {flow_cold.elapsed_s:.2f}s "
+              f"> {flow_budget_s:.0f}s", file=sys.stderr)
+        return 1
     return 1 if cold.active(Severity.WARNING) else 0
 
 
