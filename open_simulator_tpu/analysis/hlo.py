@@ -49,6 +49,7 @@ S_LANES = 8          # candidate lanes in every probe fan-out audit
 K_SEGS = 4           # wave-segment chain depth in the sweep fan-out audit
 DEFAULT_SHARDS = (1, 2, 8)
 CHAIN_TARGET = "schedule_wave_chain2"
+EPOCH_TARGET = "schedule_affinity_epoch"
 FIXTURE_TARGET = "fixture-extra-collective"  # CI negative control, opt-in
 
 _COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
@@ -233,6 +234,72 @@ def collective_census(hlo_text: str) -> Dict[str, Dict[str, int]]:
     return out
 
 
+def _split_computations(hlo_text: str) -> Dict[str, str]:
+    """{computation name: body text} over an optimized HLO module. Headers
+    are non-indented `%name (args) -> result {` lines (ENTRY included);
+    bodies run to the column-0 closing brace."""
+    comps: Dict[str, List[str]] = {}
+    cur: Optional[List[str]] = None
+    for line in hlo_text.splitlines():
+        if not line.startswith((" ", "\t")) and line.rstrip().endswith("{"):
+            m = re.match(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*\(", line)
+            if m:
+                cur = comps.setdefault(m.group(1), [])
+                continue
+        if line.startswith("}"):
+            cur = None
+        elif cur is not None:
+            cur.append(line)
+    return {k: "\n".join(v) for k, v in comps.items()}
+
+
+_CALLEE_RE = re.compile(
+    r"(?:to_apply|body|condition|calls)=%?([\w.\-]+)"
+    r"|branch_computations=\{([^}]*)\}")
+
+
+def while_body_census(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """{while-body computation: transitive collective census} for every
+    `while` op in the module — the PER-ITERATION collective cost of each
+    loop (nested to_apply/calls/branch computations included). This is what
+    the epoch-amortization contract pins: collective_census() counts a
+    collective inside a loop body once per textual occurrence, but only the
+    body attribution says whether the loop pays it every round."""
+    comps = _split_computations(hlo_text)
+    callees: Dict[str, set] = {}
+    for name, body in comps.items():
+        refs = set()
+        for m in _CALLEE_RE.finditer(body):
+            if m.group(1):
+                refs.add(m.group(1))
+            elif m.group(2):
+                refs.update(r.strip().lstrip("%")
+                            for r in m.group(2).split(",") if r.strip())
+        callees[name] = refs
+
+    def census_of(name: str, seen: set) -> Dict[str, int]:
+        if name in seen:
+            return {}
+        seen.add(name)
+        out: Dict[str, int] = {}
+        for m in _COLL_RE.finditer(comps.get(name, "")):
+            out[m.group(2)] = out.get(m.group(2), 0) + 1
+        for ref in callees.get(name, ()):
+            for k, v in census_of(ref, seen).items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    out: Dict[str, Dict[str, int]] = {}
+    for body in comps.values():
+        for line in body.splitlines():
+            if " while(" not in line:
+                continue
+            bm = re.search(r"\bbody=%?([\w.\-]+)", line)
+            if bm:
+                out[bm.group(1)] = census_of(bm.group(1), set())
+    return out
+
+
 def _alias_block(hlo_text: str) -> str:
     """The module header's input_output_alias block text (nested braces:
     balance by hand, regexes can't), or '' when absent."""
@@ -346,6 +413,8 @@ def _budget_for(cert: dict) -> dict:
         budget["require_donation"] = cert["donation"]["held"]
     if "boundary_collectives" in cert:
         budget["max_boundary_collectives"] = 0
+    if "epoch_contract_held" in cert:
+        budget["require_epoch_contract"] = True
     return budget
 
 
@@ -430,6 +499,9 @@ def audit_wave_chain(bucket_key: str, shards: int) -> dict:
     head_abs = _abstract_head(btp, False)
     dyn_abs = tuple(_dyn_abs(tok, 0) for tok in ("g", "m", "cap1"))
     statics = kernels.HOT_KERNELS["schedule_wave"].statics(int(btp.n_zones))
+    # trailing mesh static: the kernel-internal shard_map epoch loop (the
+    # same value ShardedKernels._wave_mesh passes on a node-sharding mesh)
+    statics = statics + (mesh if shards > 1 else None,)
     raw = _unwrap(kernels.schedule_wave)
 
     def single(tb, cry, g, m, cap1):
@@ -476,6 +548,82 @@ def audit_wave_chain(bucket_key: str, shards: int) -> dict:
                      "held": aliased >= declared,
                      "image_leaf_aliased": image_alias_count(
                          low2, len(kernels.Tables._fields))},
+        "carry_promotions": [],
+    }
+    cert["budget"] = _budget_for(cert)
+    return cert
+
+
+def audit_affinity_epoch(bucket_key: str, shards: int) -> dict:
+    """The epoch-amortization contract as a certificate: on a node-sharding
+    mesh, each wave kernel's epoch while-loop pays exactly ONE all-reduce
+    (every normalizer reduction batched into one stacked max-space operand)
+    plus ONE all-gather (the score-table payload — the cross-shard argmax at
+    the epoch boundary) per epoch, and NO other loop in either module
+    contains a collective. At one shard the loops contain no collectives at
+    all. collective_census() alone cannot pin this — a prologue collective
+    and a per-round collective count the same there; while_body_census()
+    attributes them to the loop that pays them every iteration."""
+    from ..ops import kernels
+    from ..parallel.mesh import ShardedKernels, pad_batch_tables
+
+    bt = _encode_bucket(bucket_key)
+    epoch: Dict[str, dict] = {}
+    total: Dict[str, Dict[str, int]] = {}
+    custom_u: set = set()
+    host_u: set = set()
+    held = True
+    digest_args: list = []
+    mesh_label = f"nodes{shards}"
+    for name in ("schedule_wave", "schedule_affinity_wave"):
+        spec = kernels.HOT_KERNELS[name]
+        mesh, mesh_label = _mesh_for(spec.fanout, shards)
+        btp = pad_batch_tables(bt, max(mesh.shape["nodes"], 1))
+        P = int(btp.pod_group.shape[0])
+        sk = ShardedKernels(mesh)
+        jfn, spec, meta = sk.lowerable(name, n_zones=int(btp.n_zones))
+        head_abs = _abstract_head(btp, spec.fanout)
+        dyn_abs = tuple(_dyn_abs(tok, P) for tok in spec.dyn)
+        text = jfn.lower(
+            *(head_abs + dyn_abs + meta["statics"])).compile().as_text()
+        bodies = {k: dict(sorted(v.items()))
+                  for k, v in while_body_census(text).items() if v}
+        # loop keys, not raw computation names: XLA pass pipelines rename
+        # computations freely, and a golden keyed on them would churn on
+        # every toolchain bump without any semantic change
+        epoch[name] = {f"loop{i}": v for i, (_, v)
+                       in enumerate(sorted(bodies.items()))}
+        if shards > 1:
+            held &= (len(bodies) == 1
+                     and next(iter(bodies.values()))
+                     == {"all-gather": 1, "all-reduce": 1})
+        else:
+            held &= not bodies
+        for k, rec in collective_census(text).items():
+            t = total.setdefault(k, {"count": 0, "bytes": 0})
+            t["count"] += rec["count"]
+            t["bytes"] += rec["bytes"]
+        custom, host = escape_census(text)
+        custom_u.update(custom)
+        host_u.update(host)
+        digest_args.append((meta["statics"], head_abs + dyn_abs))
+    cert = {
+        "schema": SCHEMA,
+        "kernel": EPOCH_TARGET,
+        "bucket": bucket_key,
+        "mesh": mesh_label,
+        "static_digest": _digest(
+            EPOCH_TARGET, tuple(repr(s) for s, _ in digest_args),
+            tuple(a for _, args in digest_args for a in args), mesh_label,
+            ()),
+        "collectives": {k: total[k] for k in sorted(total)},
+        "collective_count": sum(c["count"] for c in total.values()),
+        "collective_bytes": sum(c["bytes"] for c in total.values()),
+        "epoch_census": epoch,
+        "epoch_contract_held": bool(held),
+        "custom_calls": sorted(custom_u),
+        "host_callbacks": sorted(host_u),
+        "donation": {"declared": 0, "aliased": 0, "held": True},
         "carry_promotions": [],
     }
     cert["budget"] = _budget_for(cert)
@@ -532,7 +680,7 @@ def audit_fixture(shards: int = 8) -> dict:
 def target_names() -> List[str]:
     from ..ops import kernels
 
-    return list(kernels.HOT_KERNELS) + [CHAIN_TARGET]
+    return list(kernels.HOT_KERNELS) + [CHAIN_TARGET, EPOCH_TARGET]
 
 
 def run_targets(select: Optional[Sequence[str]], buckets: Sequence[str],
@@ -554,6 +702,12 @@ def run_targets(select: Optional[Sequence[str]], buckets: Sequence[str],
             if name == CHAIN_TARGET:
                 if multi:
                     certs.append(audit_wave_chain(bucket, max(multi)))
+                    if log:
+                        log(certs[-1])
+                continue
+            if name == EPOCH_TARGET:
+                for shards in shards_list:
+                    certs.append(audit_affinity_epoch(bucket, shards))
                     if log:
                         log(certs[-1])
                 continue
@@ -696,6 +850,11 @@ def check_cert(live: dict, golden: dict) -> List[str]:
     if mbc is not None and live.get("boundary_collectives", 0) > mbc:
         out.append(f"{where}: dispatch boundary inserted "
                    f"{live['boundary_collectives']} collectives (budget {mbc})")
+    if budget.get("require_epoch_contract") \
+            and not live.get("epoch_contract_held", True):
+        out.append(f"{where}: epoch collective contract broken — a loop "
+                   f"body strayed from one all-reduce + one all-gather per "
+                   f"epoch: {live.get('epoch_census')}")
     return out
 
 
@@ -731,7 +890,7 @@ def diff_cert(live: dict, golden: Optional[dict]) -> List[str]:
     for field in ("static_digest", "collectives", "collective_count",
                   "collective_bytes", "custom_calls", "host_callbacks",
                   "donation", "carry_promotions", "boundary_collectives",
-                  "budget"):
+                  "epoch_census", "epoch_contract_held", "budget"):
         if field in live or field in golden:
             a, b = golden.get(field), live.get(field)
             if a != b:

@@ -17,6 +17,9 @@ Rule ids (stable — they appear in suppression comments and CI output):
   suppression-reason a `simonlint: ignore[...]` waiver without its `-- reason`
   per-pod-host-loop  O(pods) Python `for` over a pod batch in a module that
                      adopted the columnar PodStore
+  collective-in-scan-body  cross-shard collective (psum/pmax/all_gather/...)
+                     inside a scan/while/fori body — per-iteration latency
+                     that should be batched to the loop boundary
 
 Every rule is a pure function ModuleContext -> List[Finding]; file IO,
 suppressions, and exit-code policy live in runner.py.
@@ -958,6 +961,72 @@ def rule_span_outside_guard(ctx: ModuleContext) -> List[Finding]:
                 f"hangs inside the span and the phase is never traced); "
                 f"supervise the dispatch",
             ))
+    return out
+
+
+# ---------------------------------------------------- collective-in-scan-body --
+
+# Cross-shard collectives: one launch per loop ITERATION when called from a
+# scan/while/fori body. Each costs a cross-device round trip, so a loop that
+# reduces per round pays latency x rounds where a stacked operand reduced once
+# per loop entry (or once per epoch) pays it once.
+_COLLECTIVE_NAMES = {
+    "jax.lax.psum", "jax.lax.pmax", "jax.lax.pmin", "jax.lax.pmean",
+    "jax.lax.all_gather", "jax.lax.all_to_all", "jax.lax.ppermute",
+    "jax.lax.psum_scatter", "jax.lax.pshuffle",
+}
+
+
+@register(
+    "collective-in-scan-body", Severity.WARNING,
+    "A cross-shard collective (psum / pmax / all_gather / ...) executes inside "
+    "a lax.scan / while_loop / fori_loop body, directly or through a locally "
+    "defined helper. The collective then launches once per ITERATION: its "
+    "cross-device latency multiplies by the trip count, which is exactly the "
+    "pattern that kept the sharded hard-predicate wave at 0.1x of serial. "
+    "Stack the per-round operands and reduce ONCE per loop entry (max-space "
+    "packing handles mins: -max(-x) == min(x) exactly in f32), or hoist the "
+    "collective to the epoch boundary. A deliberate epoch-amortized collective "
+    "— one reduction per outer-loop iteration over a stacked operand — is the "
+    "fix, not a violation; waive it with "
+    "`# simonlint: ignore[collective-in-scan-body] -- <why>`.",
+)
+def rule_collective_in_scan_body(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    seen_sites: Set[tuple] = set()
+
+    for site in ctx.scans:
+        if site.body is None:
+            continue
+        # Walk the body transitively through locally-called helpers: kernels
+        # factor loop bodies into `front(...)` / `tail(...)` functions, and the
+        # collective usually lives in the helper, not the body literal.
+        visited = {site.body}
+        frontier = [site.body]
+        while frontier:
+            fn = frontier.pop()
+            for sub in _walk_no_defs(fn.body):
+                if not isinstance(sub, ast.Call):
+                    continue
+                r = ctx.resolve(sub.func)
+                if r in _COLLECTIVE_NAMES:
+                    key = (sub.lineno, sub.col_offset)
+                    if key in seen_sites:
+                        continue
+                    seen_sites.add(key)
+                    out.append(Finding(
+                        "collective-in-scan-body", Severity.WARNING, ctx.path,
+                        sub.lineno, sub.col_offset,
+                        f"{r}(...) runs inside a {site.kind} body (via "
+                        f"`{site.body.name}`): one cross-shard launch per "
+                        f"iteration — stack the operands and reduce once per "
+                        f"loop entry, or hoist to the epoch boundary",
+                    ))
+                    continue
+                callee = ctx.lookup_function(sub.func)
+                if callee is not None and callee not in visited:
+                    visited.add(callee)
+                    frontier.append(callee)
     return out
 
 

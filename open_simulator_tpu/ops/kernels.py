@@ -27,11 +27,14 @@ Plugin parity notes (all semantics cross-checked against the vendored sources):
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec
 
 from .contracts import shaped
 from .resources import CPU_I, MEM_I
@@ -815,6 +818,20 @@ WAVE_BLOCK = 64  # B: max score-table depth = max copies per node per wave itera
 # shape untouched and caps the 100k/1M-node rows at a sort XLA can chew.
 _WAVE_TABLE_BUDGET = 1 << 21
 
+# Node-count ceiling for the epoch-amortized sharded wave path. Below it,
+# the epoch loop runs as ONE shard_map region paying exactly two collectives
+# per epoch (the stacked normalizer all-reduce + the table all-gather) with
+# the selection tail replicated on every shard — the right trade in the
+# collective-LATENCY regime the hard-predicate wave lives in (small node
+# axis, many epochs, each round otherwise paying a cross-device trip).
+# Above it, the replicated tail's O(N*B) redundancy outweighs any latency
+# saved, so the loop stays on the GSPMD per-round path where XLA shards the
+# tail's compute (the 100k/1M-node mesh rows regress ~50% if forced through
+# the amortized path). N is static at trace time, so this is a compile-time
+# branch — both forms stay bit-identical to serial either way.
+_EPOCH_AMORTIZE_MAX_N = int(os.environ.get(
+    "OPEN_SIMULATOR_EPOCH_AMORTIZE_MAX_NODES", "2048"))
+
 
 def wave_block_for(m: int, n: int) -> int:
     """Static score-table depth for an m-pod wave over n nodes: a pow2 in
@@ -884,18 +901,22 @@ def _wave_norms(st: dict, F):
     return (simon_hi, simon_lo, na_max, t_max, ip_max, ip_min)
 
 
-def _wave_score_table(tb: Tables, cry: Carry, st: dict, norms, g, j,
-                      w: ScoreWeights = DEFAULT_WEIGHTS, block: int = WAVE_BLOCK):
-    """[N, B+1] score table: entry (n, k) = score of placing the (j_n+k+1)-th copy
-    of group g on node n given current usage. Formulas mirror scores() term by
-    term; the constant-on-F plugins (SelectorSpread=100, PodTopologySpread=100,
-    OpenLocal=0) are dropped — a uniform shift never changes the ordering the
-    wave consumes."""
+def _wave_score_table_rows(alloc_cm, nonzero, grp_nz, st: dict, norms, j,
+                           w: ScoreWeights = DEFAULT_WEIGHTS,
+                           block: int = WAVE_BLOCK):
+    """[rows, B+1] score table from per-node rows: entry (n, k) = score of
+    placing the (j_n+k+1)-th copy of group g on node n given current usage.
+    Every op is per-node elementwise, so the rows may be the full [N] arrays
+    (the unsharded path) or ONE mesh shard's contiguous node block — the
+    floats are bit-identical either way, which is what lets the sharded
+    epoch loop build its table block-locally and all-gather the result.
+    Formulas mirror scores() term by term; the constant-on-F plugins
+    (SelectorSpread=100, PodTopologySpread=100, OpenLocal=0) are dropped —
+    a uniform shift never changes the ordering the wave consumes."""
     simon_hi, simon_lo, na_max, t_max, ip_max, ip_min = norms
     B = block + 1  # one extra column: the exact first-hidden-entry bound
     copies = j.astype(_F32)[:, None, None] + jnp.arange(1, B + 1, dtype=_F32)[None, :, None]
-    alloc_cm = tb.alloc[:, (CPU_I, MEM_I)]                            # [N, 2]
-    used = cry.nonzero[:, None, :] + tb.grp_nonzero[g][None, None, :] * copies  # [N,B,2]
+    used = nonzero[:, None, :] + grp_nz[None, None, :] * copies  # [n,B,2]
     least, balanced = least_balanced(
         used[:, :, 0], used[:, :, 1], alloc_cm[:, None, 0], alloc_cm[:, None, 1])
 
@@ -909,6 +930,15 @@ def _wave_score_table(tb: Tables, cry: Carry, st: dict, norms, g, j,
     static_n = ((w.simon + w.gpushare) * simon + w.nodeaff * nodeaff
                 + w.taint * taint + w.interpod * interpod + st["static"])
     return w.least * least + w.balanced * balanced + static_n[:, None]
+
+
+def _wave_score_table(tb: Tables, cry: Carry, st: dict, norms, g, j,
+                      w: ScoreWeights = DEFAULT_WEIGHTS, block: int = WAVE_BLOCK):
+    """[N, B+1] score table over the full node set (see
+    _wave_score_table_rows for the per-node formulas)."""
+    return _wave_score_table_rows(
+        tb.alloc[:, (CPU_I, MEM_I)], cry.nonzero, tb.grp_nonzero[g],
+        st, norms, j, w, block)
 
 
 @shaped(g="[] i32", cap1="[] bool", ret="[N] i32")
@@ -1012,22 +1042,30 @@ def wave_kmax(m: int, n: int, block: int) -> int:
     return min(k, cap)
 
 
-def _wave_candidates(tb: Tables, cry: Carry, st: dict, g, j, avail, F,
-                     w: ScoreWeights, B: int, iota_n, kmax: int):
-    """Shared wave-iteration front half: normalizers for the current feasible
-    set, the [N, B+1] score table, the usable-entry mask (capacity, monotone
-    prefix, hidden-continuation guard — see schedule_wave's body comments for
-    the exactness argument), and the top-kmax candidates in serial's exact
-    pick order (score desc, node asc, copy asc — lax.top_k breaks ties by
+def _mesh_axis_shards(mesh):
+    """(axis_name, shard_count) of a 1-D node mesh, or (None, 1) for any
+    mesh the wave kernels treat as unsharded (None, scenario, single-shard).
+    The kernels take `mesh` as a STATIC arg, so this resolves at trace time
+    and the unsharded path compiles byte-identically to the mesh=None form."""
+    if mesh is None or len(mesh.axis_names) != 1:
+        return None, 1
+    ax = mesh.axis_names[0]
+    return ax, int(mesh.shape[ax])
+
+
+def _wave_candidates_from(table_ext, avail, F, B: int, iota_n, kmax: int):
+    """Shared wave-iteration candidate half, given the epoch's [N, B+1]
+    score table: the usable-entry mask (capacity, monotone prefix,
+    hidden-continuation guard — see schedule_wave's body comments for the
+    exactness argument) and the top-kmax candidates in serial's exact pick
+    order (score desc, node asc, copy asc — lax.top_k breaks ties by
     ascending flat index, which IS that order on the n-major table). Entries
     beyond kmax rank strictly worse than every visible candidate, so
     truncation only caps one iteration's take — the next iteration (or the
-    head fallback) sees them with identical state. Single source for
-    schedule_wave and schedule_affinity_wave. Returns
-    (norms, table, idx_srt, ex_srt, vals) with the last three [kmax]-wide."""
-    N = tb.alloc.shape[0]
-    norms = _wave_norms(st, F)
-    table_ext = _wave_score_table(tb, cry, st, norms, g, j, w, B)  # [N, B+1]
+    head fallback) sees them with identical state. Runs on full arrays in
+    both the unsharded and the sharded epoch path (post-gather). Returns
+    (table, idx_srt, ex_srt, vals) with the last three [kmax]-wide."""
+    N = table_ext.shape[0]
     table = table_ext[:, :B]
     ks = jnp.arange(B, dtype=jnp.int32)[None, :]
     in_cap = ks < avail[:, None]
@@ -1066,15 +1104,16 @@ def _wave_candidates(tb: Tables, cry: Carry, st: dict, g, j, avail, F,
     vals, flat_pos = jax.lax.top_k(flat_s, kmax)
     idx_srt = (flat_pos // B).astype(jnp.int32)
     ex_srt = exhaust.reshape(-1)[flat_pos].astype(jnp.int32)
-    return norms, table, idx_srt, ex_srt, vals
+    return table, idx_srt, ex_srt, vals
 
 
-@partial(jax.jit, static_argnames=("gpu_live", "w", "filters", "block", "kmax"))
+@partial(jax.jit, static_argnames=("gpu_live", "w", "filters", "block", "kmax",
+                                   "mesh"))
 @shaped(g="[] i32", m="[] i32", cap1="[] bool")
 def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
                   w: ScoreWeights = DEFAULT_WEIGHTS,
                   filters: FilterFlags = DEFAULT_FILTERS,
-                  block: int = WAVE_BLOCK, kmax: int = 0):
+                  block: int = WAVE_BLOCK, kmax: int = 0, mesh=None):
     """Place up to m pods of wave-eligible group g, exactly reproducing m serial
     _step placements. Returns (new carry, per-node counts [N] i32, placed i32).
 
@@ -1092,7 +1131,17 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
     hidden-continuation guard defers to later iterations — only the
     table/sort size vs iteration-count trade-off does. kmax (static, 0 =
     full table): top-k truncation width (wave_kmax); also purely a
-    performance knob (tail entries defer to later iterations)."""
+    performance knob (tail entries defer to later iterations).
+
+    mesh (static): a 1-D node mesh routes the epoch loop through an explicit
+    shard_map region with exactly ONE all-gather per epoch (the score-table
+    block merge): each shard builds its own [N/shards, B+1] table block and
+    the selection phase runs replicated on the gathered table — placements
+    bit-identical to mesh=None because the per-node table arithmetic and the
+    post-gather selection are the same floats in the same order. Under GSPMD
+    propagation the same loop paid O(10) collectives per EPOCH-internal
+    reduction; see schedule_affinity_wave for the all-reduce variant and the
+    simonaudit `schedule_affinity_epoch` certificate that pins the census."""
     N = tb.alloc.shape[0]
     B = block
     K = kmax if kmax else N * B
@@ -1110,15 +1159,16 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
     if gpu_live:
         capacity = _gpu_capacity(tb, cry, g, capacity)
 
-    def body(state):
-        j, placed, _ = state
-        avail = capacity - j                                   # copies left per node
-        F = base_feas & (avail > 0)
-        norms, table, idx_srt, ex_srt, vals = _wave_candidates(
-            tb, cry, st, g, j, avail, F, w, B, iota_n, K)
+    def body_tail(j, placed, m_, norms, table_ext, F, avail, st_full):
+        """Selection back half of one epoch, on full-width arrays (the
+        sharded path enters here post-gather, replicated on every shard).
+        avail may arrive clamped to B+1: every comparison against it in this
+        phase has a left side <= B, so the clamp never changes a branch."""
+        table, idx_srt, ex_srt, vals = _wave_candidates_from(
+            table_ext, avail, F, B, iota_n, K)
         pos = jnp.arange(K, dtype=jnp.int32)
         n_finite = jnp.sum(jnp.isfinite(vals).astype(jnp.int32))
-        m_rem = (m - placed).astype(jnp.int32)
+        m_rem = (m_ - placed).astype(jnp.int32)
         m_cand = jnp.minimum(m_rem, n_finite)
 
         # exhausted nodes within the candidate range; fine to keep them mid-wave
@@ -1126,7 +1176,7 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
         counts0 = jnp.zeros(N, jnp.int32).at[idx_srt].add((pos < m_cand).astype(jnp.int32))
         leaves = counts0 >= jnp.maximum(avail, 1)
         F_end = F & ~leaves
-        norms_end = _wave_norms(st, F_end)
+        norms_end = _wave_norms(st_full, F_end)
         same = jnp.array(True)
         for a, b in zip(norms, norms_end):
             same &= a == b  # ±inf compare equal to themselves; no NaN can arise
@@ -1152,7 +1202,85 @@ def schedule_wave(tb: Tables, cry: Carry, g, m, cap1, gpu_live: bool = False,
         return (last_w > 0) & (placed < m)
 
     j0 = jnp.zeros(N, jnp.int32)
-    j, placed, _ = jax.lax.while_loop(cond, body, (j0, jnp.int32(0), jnp.int32(1)))
+    ax, shards = _mesh_axis_shards(mesh)
+    if (ax is not None and shards > 1 and N % shards == 0
+            and N <= _EPOCH_AMORTIZE_MAX_N):
+        # ---- epoch-amortized sharded path: the whole loop is ONE shard_map
+        # region; each epoch pays exactly one all-reduce (the stacked
+        # normalizer pmax) and one all-gather (the table-block merge).
+        NL = N // shards
+        alloc_cm = tb.alloc[:, (CPU_I, MEM_I)]
+        st_norm = {k: st[k] for k in ("max_stack", "min_stack")}
+
+        def loop_sharded(cap_l, feas_l, alloc_l, nz_l, st_l, st_f, grp_nz, m_):
+            def body(state):
+                j, placed, _ = state
+                shard = jax.lax.axis_index(ax)
+                j_l = jax.lax.dynamic_slice_in_dim(j, shard * NL, NL)
+                avail_l = cap_l - j_l
+                F_l = feas_l & (avail_l > 0)
+                # one stacked masked reduction in max space (mins ride
+                # negated: -max(-x) == min(x) exactly, ±inf included), so the
+                # six per-epoch normalizers cost ONE cross-shard all-reduce
+                mx = jnp.max(
+                    jnp.where(F_l[None, :], st_l["max_stack"], -jnp.inf), axis=1)
+                mn = jnp.max(
+                    jnp.where(F_l[None, :], -st_l["min_stack"], -jnp.inf), axis=1)
+                # simonlint: ignore[collective-in-scan-body] -- epoch-hoisted:
+                # the one amortized all-reduce the schedule_affinity_epoch
+                # audit certificate pins per epoch body
+                red = jax.lax.pmax(jnp.concatenate([mx, mn]), ax)
+                norms = (red[0], -red[4], jnp.maximum(red[1], 0.0),
+                         jnp.maximum(red[2], 0.0), jnp.maximum(red[3], 0.0),
+                         jnp.minimum(-red[5], 0.0))
+                table_l = _wave_score_table_rows(
+                    alloc_l, nz_l, grp_nz, st_l, norms, j_l, w, B)
+                # candidate-merge payload: the table block plus the per-node
+                # rows the replicated selection phase reads. avail is clamped
+                # to B+1 so it packs exactly into the f32 payload (every
+                # comparison against it caps at B).
+                pay = jnp.concatenate(
+                    [table_l.T, F_l[None].astype(_F32),
+                     jnp.minimum(avail_l, B + 1)[None].astype(_F32)], axis=0)
+                # simonlint: ignore[collective-in-scan-body] -- epoch-hoisted:
+                # the one cross-shard candidate merge per epoch (the
+                # "argmax at epoch boundaries" collective)
+                full = jax.lax.all_gather(pay, ax, axis=1, tiled=True)
+                table_ext = full[:B + 1].T
+                F = full[B + 1] > 0
+                avail = full[B + 2].astype(jnp.int32)
+                return body_tail(j, placed, m_, norms, table_ext, F, avail,
+                                 st_f)
+
+            def cond_s(state):
+                _, placed, last_w = state
+                return (last_w > 0) & (placed < m_)
+
+            return jax.lax.while_loop(
+                cond_s, body, (j0, jnp.int32(0), jnp.int32(1)))
+
+        Pn = PartitionSpec(ax)
+        j, placed, _ = shard_map(
+            loop_sharded, mesh=mesh,
+            in_specs=(Pn, Pn, PartitionSpec(ax, None), PartitionSpec(ax, None),
+                      {k: (PartitionSpec(None, ax) if v.ndim == 2 else Pn)
+                       for k, v in st.items()},
+                      {k: PartitionSpec() for k in st_norm},
+                      PartitionSpec(), PartitionSpec()),
+            out_specs=(PartitionSpec(),) * 3, check_rep=False,
+        )(capacity, base_feas, alloc_cm, cry.nonzero, st, st_norm,
+          tb.grp_nonzero[g], m)
+    else:
+        def body(state):
+            j, placed, _ = state
+            avail = capacity - j                           # copies left per node
+            F = base_feas & (avail > 0)
+            norms = _wave_norms(st, F)
+            table_ext = _wave_score_table(tb, cry, st, norms, g, j, w, B)
+            return body_tail(j, placed, m, norms, table_ext, F, avail, st)
+
+        j, placed, _ = jax.lax.while_loop(
+            cond, body, (j0, jnp.int32(0), jnp.int32(1)))
     return _aggregate_commit(tb, cry, g, j, gpu_live), j, placed
 
 
@@ -1178,14 +1306,14 @@ class AffinityWaveState(NamedTuple):
 
 @partial(jax.jit,
          static_argnames=("ss_live", "w", "filters", "block", "n_zones",
-                          "stats"))
+                          "stats", "mesh"))
 @shaped(g="[] i32", m="[] i32", cap1="[] bool")
 def schedule_affinity_wave(tb: Tables, cry: Carry, g, m, cap1,
                            ss_live: bool = False,
                            w: ScoreWeights = DEFAULT_WEIGHTS,
                            filters: FilterFlags = DEFAULT_FILTERS,
                            block: int = WAVE_BLOCK, n_zones: int = 2,
-                           stats: bool = False):
+                           stats: bool = False, mesh=None):
     """Epoch-batched wave for groups whose hard predicates read their OWN
     running placements: self-matching DoNotSchedule spread at ANY topology
     cardinality (zone-level included), required InterPodAffinity (incl. the
@@ -1383,11 +1511,11 @@ def schedule_affinity_wave(tb: Tables, cry: Carry, g, m, cap1,
     # live DNS terms demand the topology key (static per node)
     dns_key_live_ok = jnp.all(dns_key | ~live_dns[:, None], axis=0)
 
-    def norm_stacks(ip_raw, pernode0):
-        rows = [st0["simon_s"], st0["na_raw"], st0["t_raw"], ip_raw]
+    def norm_stacks(nd, ip_raw, pernode0):
+        rows = [nd["simon_s"], nd["na_raw"], nd["t_raw"], ip_raw]
         if ss_live:
             rows.append(pernode0)
-        return jnp.stack(rows), jnp.stack([st0["simon_s"], ip_raw])
+        return jnp.stack(rows), jnp.stack([nd["simon_s"], ip_raw])
 
     def norm_vals(max_stack, min_stack, F):
         maxes = jnp.max(jnp.where(F[None, :], max_stack, -jnp.inf), axis=1)
@@ -1400,11 +1528,61 @@ def schedule_affinity_wave(tb: Tables, cry: Carry, g, m, cap1,
             same &= jnp.all(x == y)  # ±inf compare equal; no NaN can arise
         return same
 
-    def body(state: AffinityWaveState):
-        (j, cnt_dns, cnt_aff, cnt_anti, cnt_car, cnt_cw, cnt_ss, placed, _,
-         ep_stats) = state
-        avail = capacity - j
-        m_rem = (m - placed).astype(jnp.int32)
+    aff_self = tb.grp_aff_self[g]
+    # node-axis inputs of the per-epoch front half. The sharded path feeds
+    # one contiguous shard block of each into epoch_head — every op there is
+    # per-node elementwise or a gather from replicated [slots, D+1] rows, so
+    # a block computes exactly the full-width slice of the same floats.
+    nd_full = {
+        "feas": base_feas, "cap": capacity,
+        "alloc_cm": tb.alloc[:, (CPU_I, MEM_I)], "nonzero": cry.nonzero,
+        "simon_s": st0["simon_s"], "na_raw": st0["na_raw"],
+        "t_raw": st0["t_raw"], "static": st0["static"], "ip_pref": ip_pref,
+        "dom_dns": dom_dns, "dom_aff": dom_aff, "dom_anti": dom_anti,
+        "dom_car": dom_car, "dom_cw": dom_cw, "dom_ss": dom_ss,
+    }
+    # replicated prologue values, threaded as explicit arguments because the
+    # sharded loop lives inside a shard_map region (which cannot close over
+    # traced values); the serial path reads the same dict so both fronts and
+    # the shared tail consume one source of truth.
+    repl = {
+        "m": m, "grp_nz": tb.grp_nonzero[g], "aff_self": aff_self,
+        "edom": edom, "dself": dself, "dskew": dskew, "dvalid": dvalid,
+        "avalid": avalid, "bvalid": bvalid, "cavalid": cavalid,
+        "cwvalid": cwvalid, "cw_w": cw_w, "live_dns": live_dns,
+        "live_anti": live_anti, "live_car": live_car, "live_cw": live_cw,
+        "inc_dns": inc_dns, "inc_aff": inc_aff, "inc_anti": inc_anti,
+        "inc_car": inc_car, "inc_cw": inc_cw, "ss_match": ss_match,
+        "dom_live": dom_live, "edom_live": edom_live,
+        "skew_live": skew_live, "self_live": self_live,
+        "is_dns_live": is_dns_live, "has_budget": has_budget,
+        "inc_live": inc_live, "budget_composes": budget_composes,
+        "dom_dns": dom_dns, "dom_aff": dom_aff, "dom_anti": dom_anti,
+        "dom_car": dom_car, "dom_cw": dom_cw, "dom_ss": dom_ss,
+        "st_simon": st0["simon_s"], "st_na": st0["na_raw"],
+        "st_t": st0["t_raw"],
+    }
+    if ss_live:
+        repl["zones"] = zones
+
+    def epoch_head(j_w, cnts, nd, rp):
+        """Width-agnostic epoch front half: live gates, feasible sets and
+        live-score stacks from the epoch-start counter rows. `nd` may hold
+        the full [N] node arrays or one mesh shard's contiguous block —
+        identical floats either way (see nd_full)."""
+        cnt_dns, cnt_aff, cnt_anti, cnt_car, cnt_cw, cnt_ss = cnts
+        dom_dns = nd["dom_dns"]; dom_aff = nd["dom_aff"]
+        dom_anti = nd["dom_anti"]; dom_car = nd["dom_car"]
+        dom_cw = nd["dom_cw"]; dom_ss = nd["dom_ss"]
+        base_feas = nd["feas"]; ip_pref = nd["ip_pref"]
+        edom = rp["edom"]; dself = rp["dself"]; dskew = rp["dskew"]
+        dvalid = rp["dvalid"]; avalid = rp["avalid"]; bvalid = rp["bvalid"]
+        cavalid = rp["cavalid"]; cwvalid = rp["cwvalid"]; cw_w = rp["cw_w"]
+        live_dns = rp["live_dns"]; live_anti = rp["live_anti"]
+        live_car = rp["live_car"]
+        dns_key = dom_dns < D
+        dns_key_live_ok = jnp.all(dns_key | ~live_dns[:, None], axis=0)
+        avail = nd["cap"] - j_w
 
         # ---- live gates from epoch-start rows (feasibility() term for term)
         cnt_at_d = jnp.take_along_axis(cnt_dns, dom_dns, axis=1)     # [Sd, N]
@@ -1422,7 +1600,7 @@ def schedule_affinity_wave(tb: Tables, cry: Carry, g, m, cap1,
         has_aff = jnp.any(avalid)
         totals_a = jnp.sum(cnt_aff[:, :D], axis=1)
         total_aff = jnp.sum(jnp.where(avalid, totals_a, 0.0))
-        bootstrap = has_aff & (total_aff == 0.0) & tb.grp_aff_self[g]
+        bootstrap = has_aff & (total_aff == 0.0) & rp["aff_self"]
         aff_ok = jnp.where(bootstrap, jnp.ones_like(aff_all), aff_all)
 
         at_b = jnp.take_along_axis(cnt_anti, dom_anti, axis=1)       # [Ba, N]
@@ -1447,7 +1625,16 @@ def schedule_affinity_wave(tb: Tables, cry: Carry, g, m, cap1,
         ip_raw = ip_pref + jnp.sum(
             jnp.where(cwvalid[:, None], cw_w[:, None] * cw_at, 0.0), axis=0)
         pernode0 = jnp.take_along_axis(cnt_ss, dom_ss, axis=1)[0]    # [N]
-        max_stack, min_stack = norm_stacks(ip_raw, pernode0)
+        max_stack, min_stack = norm_stacks(nd, ip_raw, pernode0)
+        return (avail, F_start, F_hi, bootstrap, ip_raw, pernode0,
+                max_stack, min_stack)
+
+    def front_full(j, cnts):
+        """Serial epoch front: epoch_head on the full node set plus direct
+        normalizer reductions and the full-width table build — byte-for-byte
+        the ops of the pre-mesh kernel."""
+        (avail, F_start, F_hi, bootstrap, ip_raw, pernode0, max_stack,
+         min_stack) = epoch_head(j, cnts, nd_full, repl)
         maxes_s, mins_s = norm_vals(max_stack, min_stack, F_start)
         maxes_h, mins_h = norm_vals(max_stack, min_stack, F_hi)
         norms6 = (maxes_s[0], mins_s[0], jnp.maximum(maxes_s[1], 0.0),
@@ -1483,6 +1670,19 @@ def schedule_affinity_wave(tb: Tables, cry: Carry, g, m, cap1,
         st_ep = dict(st0)
         st_ep["ip_raw"] = ip_raw
         table_ext = _wave_score_table(tb, cry, st_ep, norms6, g, j, w, B)
+        table_ext, k_cap, ss_multi_ok = apply_zone(
+            table_ext, maxes_s, pernode0, F_start,
+            zones if ss_live else None)
+        return (avail, F_start, F_hi, table_ext, k_cap, ss_multi_ok,
+                max_stack, min_stack, maxes_s, mins_s, maxes_h, mins_h,
+                uniform_base, bootstrap, ip_safe)
+
+    def apply_zone(table_ext, maxes_s, pernode0, F_start, zones_f):
+        """Replicated full-width zone blend + depth caps (ss_live). On the
+        sharded path this runs POST-gather: zone sums are cross-node
+        scatters, and doing them replicated keeps the scatter order — and
+        therefore the floats — identical to serial, with no extra
+        collective."""
         if ss_live:
             # live SelectorSpread, selector_spread_score term for term with
             # maxN/zone sums frozen at epoch start; column c = c prior takes
@@ -1492,13 +1692,13 @@ def schedule_affinity_wave(tb: Tables, cry: Carry, g, m, cap1,
             node_score = jnp.where(maxN > 0, 100.0 * (maxN - pernode_k) / maxN,
                                    100.0)
             nz_count = jnp.where(F_start, pernode0, 0.0)
-            zone_sums = jnp.zeros((Z,), _F32).at[zones].add(nz_count)
+            zone_sums = jnp.zeros((Z,), _F32).at[zones_f].add(nz_count)
             maxZ = jnp.max(zone_sums.at[0].set(0.0))
-            have_zones = jnp.any(F_start & (zones > 0))
-            zscore = jnp.where(maxZ > 0, 100.0 * (maxZ - zone_sums[zones]) / maxZ,
+            have_zones = jnp.any(F_start & (zones_f > 0))
+            zscore = jnp.where(maxZ > 0, 100.0 * (maxZ - zone_sums[zones_f]) / maxZ,
                                100.0)
             blended = jnp.where(
-                (have_zones & (zones > 0))[:, None],
+                (have_zones & (zones_f > 0))[:, None],
                 node_score * (1.0 / 3.0) + zscore[:, None] * (2.0 / 3.0),
                 node_score)
             table_ext = table_ext + w.ss * _flr(blended)
@@ -1509,6 +1709,31 @@ def schedule_affinity_wave(tb: Tables, cry: Carry, g, m, cap1,
         else:
             k_cap = jnp.full(N, B, jnp.int32)
             ss_multi_ok = jnp.array(True)
+        return table_ext, k_cap, ss_multi_ok
+
+    def epoch_tail(state, fo, rp):
+        """Selection / multi-round / commit back half of one epoch, shared
+        verbatim by both paths: the sharded front enters here post-gather
+        with every input replicated full-width, so the two paths run the
+        same floats by construction."""
+        (j, cnt_dns, cnt_aff, cnt_anti, cnt_car, cnt_cw, cnt_ss, placed, _,
+         ep_stats) = state
+        (avail, F_start, F_hi, table_ext, k_cap, ss_multi_ok, max_stack,
+         min_stack, maxes_s, mins_s, maxes_h, mins_h, uniform_base,
+         bootstrap, ip_safe) = fo
+        dom_live = rp["dom_live"]; edom_live = rp["edom_live"]
+        skew_live = rp["skew_live"]; self_live = rp["self_live"]
+        is_dns_live = rp["is_dns_live"]; has_budget = rp["has_budget"]
+        inc_live = rp["inc_live"]; budget_composes = rp["budget_composes"]
+        live_dns = rp["live_dns"]; live_anti = rp["live_anti"]
+        live_car = rp["live_car"]; ss_match = rp["ss_match"]
+        dom_dns = rp["dom_dns"]; dom_aff = rp["dom_aff"]
+        dom_anti = rp["dom_anti"]; dom_car = rp["dom_car"]
+        dom_cw = rp["dom_cw"]; dom_ss = rp["dom_ss"]
+        inc_dns = rp["inc_dns"]; inc_aff = rp["inc_aff"]
+        inc_anti = rp["inc_anti"]; inc_car = rp["inc_car"]
+        inc_cw = rp["inc_cw"]
+        m_rem = (rp["m"] - placed).astype(jnp.int32)
         table = table_ext[:, :B]
 
         # ---- candidates: capacity, monotone prefix, hidden-continuation ---
@@ -1740,10 +1965,135 @@ def schedule_affinity_wave(tb: Tables, cry: Carry, g, m, cap1,
     def cond(state: AffinityWaveState):
         return (state.last > 0) & (state.placed < m)
 
-    final = jax.lax.while_loop(cond, body, AffinityWaveState(
+    def body(state: AffinityWaveState):
+        cnts = (state.cnt_dns, state.cnt_aff, state.cnt_anti, state.cnt_car,
+                state.cnt_cw, state.cnt_ss)
+        return epoch_tail(state, front_full(state.j, cnts), repl)
+
+    init = AffinityWaveState(
         jnp.zeros(N, jnp.int32), cnt_dns0, cnt_aff0, cnt_anti0, cnt_car0,
         cnt_cw0, cnt_ss0, jnp.int32(0), jnp.int32(1),
-        jnp.zeros(3, jnp.int32)))
+        jnp.zeros(3, jnp.int32))
+    ax, shards = _mesh_axis_shards(mesh)
+    if (ax is not None and shards > 1 and N % shards == 0
+            and N <= _EPOCH_AMORTIZE_MAX_N):
+        NL = N // shards
+
+        def front_sharded(j, cnts, ndl, rp):
+            """Sharded epoch front: epoch_head on this shard's node block,
+            then exactly TWO collectives for the whole epoch — one pmax
+            carrying every normalizer reduction in max space (mins ride
+            negated: -max(-x) == min(x) exactly, ±inf included) and one
+            all_gather of the score-table block + per-node epoch rows. The
+            selection tail then runs replicated on the gathered full-width
+            arrays, i.e. the serial floats."""
+            shard = jax.lax.axis_index(ax)
+            j_l = jax.lax.dynamic_slice_in_dim(j, shard * NL, NL)
+            (avail_l, F_start_l, F_hi_l, bootstrap, ip_raw_l, pernode0_l,
+             max_stack_l, min_stack_l) = epoch_head(j_l, cnts, ndl, rp)
+
+            def mred(stack, Fm):
+                return jnp.max(jnp.where(Fm[None, :], stack, -jnp.inf),
+                               axis=1)
+
+            dom_cw_f = ndl["dom_cw"].astype(_F32)  # exact: doms < 2**24
+            parts = jnp.concatenate([
+                mred(max_stack_l, F_start_l), mred(-min_stack_l, F_start_l),
+                mred(max_stack_l, F_hi_l), mred(-min_stack_l, F_hi_l),
+                mred(-max_stack_l[:4], F_hi_l),
+                jnp.max(jnp.where(F_hi_l[None, :], dom_cw_f, -1.0), axis=1),
+                jnp.max(jnp.where(F_hi_l[None, :], -dom_cw_f,
+                                  -float(D + 2)), axis=1),
+            ])
+            # ONE all-reduce per epoch: every reduction the old lowering paid
+            # per round, batched into a single stacked max-space operand
+            red = jax.lax.pmax(parts, ax)  # simonlint: ignore[collective-in-scan-body] -- the epoch-amortized collective itself
+            ns = 5 if ss_live else 4
+            o = 0
+            maxes_s = red[o:o + ns]; o += ns
+            mins_s = -red[o:o + 2]; o += 2
+            maxes_h = red[o:o + ns]; o += ns
+            mins_h = -red[o:o + 2]; o += 2
+            base_hi_min = -red[o:o + 4]; o += 4
+            dmax = red[o:o + Cw]; o += Cw
+            dmin = -red[o:o + Cw]
+            norms6 = (maxes_s[0], mins_s[0], jnp.maximum(maxes_s[1], 0.0),
+                      jnp.maximum(maxes_s[2], 0.0),
+                      jnp.maximum(maxes_s[3], 0.0),
+                      jnp.minimum(mins_s[1], 0.0))
+            st_ep_l = {"simon_s": ndl["simon_s"], "na_raw": ndl["na_raw"],
+                       "t_raw": ndl["t_raw"], "static": ndl["static"],
+                       "ip_raw": ip_raw_l}
+            table_l = _wave_score_table_rows(
+                ndl["alloc_cm"], ndl["nonzero"], rp["grp_nz"], st_ep_l,
+                norms6, j_l, w, B)
+            rows = [table_l.T, F_start_l[None].astype(_F32),
+                    F_hi_l[None].astype(_F32),
+                    # avail clamps to B+1: packs exactly in f32, and every
+                    # tail comparison has a left side <= B so order is kept
+                    jnp.minimum(avail_l, B + 1)[None].astype(_F32),
+                    ip_raw_l[None]]
+            if ss_live:
+                rows.append(pernode0_l[None])
+            pay = jnp.concatenate(rows, axis=0)
+            # ONE all-gather per epoch: the cross-shard argmax at the epoch
+            # boundary, generalized — gathering the [B+2+k, NL] payload
+            # replicates the table so the tail's argmax/top_k tie-breaks run
+            # bit-identical to serial instead of via a lossy packed argmax
+            full = jax.lax.all_gather(pay, ax, axis=1, tiled=True)  # simonlint: ignore[collective-in-scan-body] -- the epoch-amortized collective itself
+            table_ext = full[:B + 1].T
+            F_start = full[B + 1] > 0
+            F_hi = full[B + 2] > 0
+            avail = full[B + 3].astype(jnp.int32)
+            ip_raw_f = full[B + 4]
+            pernode0_f = full[B + 5] if ss_live else None
+            srows = [rp["st_simon"], rp["st_na"], rp["st_t"], ip_raw_f]
+            if ss_live:
+                srows.append(pernode0_f)
+            max_stack_f = jnp.stack(srows)
+            min_stack_f = jnp.stack([rp["st_simon"], ip_raw_f])
+            uniform_base = jnp.all(maxes_h[:4] == base_hi_min) & jnp.any(F_hi)
+            dom_same = jnp.all(~rp["live_cw"] | (dmax == dmin))
+            # ip_hi/ip_lo ARE maxes_h[3]/mins_h[1] (the same reduction of the
+            # same row — serial merely computes them twice)
+            ip_safe = (~jnp.any(rp["live_cw"]) | ~jnp.any(F_hi)
+                       | (dom_same & (maxes_h[3] == mins_h[1])))
+            table_ext, k_cap, ss_multi_ok = apply_zone(
+                table_ext, maxes_s, pernode0_f, F_start,
+                rp["zones"] if ss_live else None)
+            return (avail, F_start, F_hi, table_ext, k_cap, ss_multi_ok,
+                    max_stack_f, min_stack_f, maxes_s, mins_s, maxes_h,
+                    mins_h, uniform_base, bootstrap, ip_safe)
+
+        def loop_sharded(ndl, rp, state0):
+            def body_s(state):
+                cnts = (state.cnt_dns, state.cnt_aff, state.cnt_anti,
+                        state.cnt_car, state.cnt_cw, state.cnt_ss)
+                return epoch_tail(
+                    state, front_sharded(state.j, cnts, ndl, rp), rp)
+
+            def cond_s(state):
+                return (state.last > 0) & (state.placed < rp["m"])
+
+            return jax.lax.while_loop(cond_s, body_s, state0)
+
+        _row2 = ("alloc_cm", "nonzero")  # [N, 2]: node axis FIRST
+
+        def nd_spec(k, v):
+            if v.ndim == 1:
+                return PartitionSpec(ax)
+            return (PartitionSpec(ax, None) if k in _row2
+                    else PartitionSpec(None, ax))
+
+        state_specs = AffinityWaveState(*((PartitionSpec(),) * 10))
+        final = shard_map(
+            loop_sharded, mesh=mesh,
+            in_specs=({k: nd_spec(k, v) for k, v in nd_full.items()},
+                      {k: PartitionSpec() for k in repl}, state_specs),
+            out_specs=state_specs, check_rep=False,
+        )(nd_full, repl, init)
+    else:
+        final = jax.lax.while_loop(cond, body, init)
     out = (_aggregate_commit(tb, cry, g, final.j, False), final.j,
            final.placed)
     return out + (final.ep_stats,) if stats else out

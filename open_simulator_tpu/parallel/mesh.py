@@ -32,6 +32,11 @@ from ..ops import kernels
 from ..simulator.encode import BatchTables, pad_batch_tables as _pad_batch_tables, plugin_flags
 
 NODE_AXIS = "nodes"
+
+# wave kernels that take a trailing `mesh` static: on a node-sharding mesh
+# they run their epoch loop inside one shard_map region with exactly one
+# all-reduce + one all-gather per epoch (see ops/kernels.py)
+_MESH_STATIC_KERNELS = ("schedule_wave", "schedule_affinity_wave")
 SCENARIO_AXIS = "scenarios"
 
 
@@ -443,6 +448,8 @@ class ShardedKernels:
             raise ValueError(f"{name} has no stats variant")
         spec = kernels.HOT_KERNELS[name]
         n_static = len(spec.statics(2))
+        if name in _MESH_STATIC_KERNELS:
+            n_static += 1  # trailing static: kernel-internal shard_map mesh
         if spec.out is None:  # diagnostics: never donated, no out_shardings
             return self._jit(name, lambda: self._sched_jit(
                 name, 3, n_static, None, donate_ok=False), shared=True)
@@ -464,6 +471,8 @@ class ShardedKernels:
         statics = spec.statics(n_zones)
         if name == "schedule_affinity_wave":
             statics = statics[:-1] + (bool(stats),)
+        if name in _MESH_STATIC_KERNELS:
+            statics = statics + (self._wave_mesh(),)
         donated = (1,) if (spec.out is not None and self.donate) else ()
         meta = {"head": 3 if spec.fanout else 2, "statics": statics,
                 "donate_argnums": donated}
@@ -471,11 +480,23 @@ class ShardedKernels:
 
     # ------------------------------------------------- engine dispatches ----
 
+    def _wave_mesh(self):
+        """The kernel-internal shard_map mesh for the wave kernels: this
+        mesh itself when its single node axis actually shards (>1 device) —
+        the epoch-amortized collective path in ops/kernels.py — else None
+        (serial lowering). Scenario fan-out meshes stay None: their node
+        axis replicates, so there is nothing to amortize."""
+        if (self.mesh.axis_names == (NODE_AXIS,)
+                and self.mesh.shape[NODE_AXIS] > 1):
+            return self.mesh
+        return None
+
     def schedule_wave(self, tb, cry, g, m, cap1, *, gpu_live=False,
                       w=kernels.DEFAULT_WEIGHTS, filters=kernels.DEFAULT_FILTERS,
                       block=kernels.WAVE_BLOCK, kmax=0):
         fn = self._kernel_jit("schedule_wave")
-        return fn(tb, cry, g, m, cap1, gpu_live, w, filters, block, kmax)
+        return fn(tb, cry, g, m, cap1, gpu_live, w, filters, block, kmax,
+                  self._wave_mesh())
 
     def schedule_affinity_wave(self, tb, cry, g, m, cap1, *, ss_live=False,
                                w=kernels.DEFAULT_WEIGHTS,
@@ -484,7 +505,7 @@ class ShardedKernels:
                                stats=False):
         fn = self._kernel_jit("schedule_affinity_wave", stats=stats)
         return fn(tb, cry, g, m, cap1, ss_live, w, filters, block, n_zones,
-                  stats)
+                  stats, self._wave_mesh())
 
     def schedule_group_serial(self, tb, cry, g, valid, cap1, *,
                               w=kernels.DEFAULT_WEIGHTS,

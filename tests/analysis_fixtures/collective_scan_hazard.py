@@ -1,0 +1,85 @@
+"""Fixture for the collective-in-scan-body rule: a cross-shard collective
+inside a scan/while/fori body — directly or through a locally defined helper
+— must fire (one cross-device launch per iteration, the pattern that kept
+the sharded hard-predicate wave at 0.1x of serial); collectives hoisted to
+the loop boundary, collectives outside any loop, and the waived
+epoch-amortized form must not."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+state = xs0 = None
+AX = "nodes"
+
+
+def per_round_reduce(carry):
+    # findings x2: called FROM the loop body, so transitively per-iteration
+    hi = lax.pmax(carry, AX)
+    lo = -lax.pmax(-carry, AX)
+    return hi + lo
+
+
+def round_body(c):
+    j, acc = c
+    acc = acc + per_round_reduce(acc)
+    # finding: gather directly in the while body — per-round payload traffic
+    rows = jax.lax.all_gather(acc, AX, axis=0, tiled=True)
+    return (j + 1, rows.sum())
+
+
+def hard_wave_rounds(n):
+    # the old per-round shape: one reduce + one gather per candidate ROUND
+    return lax.while_loop(lambda c: c[0] < n, round_body, (0, state))
+
+
+def scan_body(c, x):  # simonlint: ignore[carry-contract] -- scalar toy carry, this fixture exercises the collective rule
+    # finding: per-step psum in a lax.scan body
+    return c + jax.lax.psum(x, AX), x
+
+
+def scan_reduce(xs):
+    return lax.scan(scan_body, 0.0, xs)
+
+
+def fori_body(i, c):
+    # finding: per-step pmean in a fori_loop body
+    return c + lax.pmean(c, AX)
+
+
+def fori_reduce(n):
+    return lax.fori_loop(0, n, fori_body, 0.0)
+
+
+def ok_hoisted_stacked_reduce(n):
+    # clean: stack the operands and reduce ONCE before entering the loop —
+    # max-space packing covers the mins (-max(-x) == min(x) exactly in f32)
+    stacked = jnp.stack([state, -state])
+    red = lax.pmax(stacked, AX)
+    return lax.while_loop(lambda c: c[0] < n,
+                          lambda c: (c[0] + 1, c[1] + red.sum()), (0, 0.0))
+
+
+def ok_collective_outside_any_loop():
+    # clean: a top-level collective is the normal SPMD idiom
+    return jax.lax.all_gather(state, AX, axis=0, tiled=True)
+
+
+def ok_helper_not_called_from_loop(v):
+    # clean: the helper reduces, but no scan/while/fori body reaches it
+    return per_epoch_summary(v)
+
+
+def per_epoch_summary(v):
+    return lax.psum(v, AX)
+
+
+def epoch_body_waived(c):
+    # the deliberate epoch-amortized form: ONE stacked reduce per epoch IS
+    # the fix for the per-round pattern above; waived with a reason
+    red = lax.pmax(c[1], AX)  # simonlint: ignore[collective-in-scan-body] -- one stacked reduce per epoch is the amortized design
+    return (c[0] + 1, red)
+
+
+def epoch_loop(n):
+    return lax.while_loop(lambda c: c[0] < n, epoch_body_waived, (0, state))

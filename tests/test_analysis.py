@@ -218,6 +218,27 @@ def test_fetch_in_wave_loop_spares_spill_points_and_plain_loops():
                    for f in fr.findings if f.rule == "fetch-in-wave-loop")
 
 
+def test_collective_in_scan_body_rule_fires():
+    # the per-round helper (pmax x2) + a direct all_gather in the while
+    # body + a scan psum + a fori pmean fire; the epoch-amortized waiver
+    # reports suppressed, not active
+    assert _counts("collective_scan_hazard.py", "collective-in-scan-body") == 5
+    assert _counts("collective_scan_hazard.py", "collective-in-scan-body",
+                   suppressed=True) == 1
+
+
+def test_collective_in_scan_body_spares_hoisted_and_top_level():
+    # a stacked reduce hoisted BEFORE the loop, a top-level collective, and
+    # a reducing helper no loop body reaches are the sanctioned patterns
+    fr = analyze_file(str(FIXTURES / "collective_scan_hazard.py"))
+    src = (FIXTURES / "collective_scan_hazard.py").read_text().splitlines()
+    ok_start = next(i for i, l in enumerate(src, 1)
+                    if "def ok_hoisted_stacked_reduce" in l)
+    assert not any(f.line >= ok_start and not f.suppressed
+                   for f in fr.findings
+                   if f.rule == "collective-in-scan-body")
+
+
 def test_fixture_tree_reports_all_families_and_fails():
     report = analyze_paths([str(FIXTURES)])
     fired = {f.rule for f in report.findings if not f.suppressed}

@@ -84,6 +84,59 @@ def test_placements_bit_identical_across_shard_counts(shards):
     assert {"wave", "affinity", "serial"} <= kinds
 
 
+def _hard_affinity_workload():
+    """Hard-predicate affinity batch: required self-anti-affinity on
+    hostname (one per node, the overflow must FAIL), required self-affinity
+    (the bootstrap-then-pack path), and DoNotSchedule spread — the gates
+    the epoch-amortized sharded affinity kernel folds into its stacked
+    per-epoch all-reduce and must reproduce bit-for-bit."""
+    nodes = [make_node(f"h{i}", cpu="16", memory="32Gi", pods="24")
+             for i in range(26)]  # 26: not divisible by 8 → phantom padding
+    pods = []
+    for i in range(30):  # 26 can place, 4 must fail identically
+        p = make_pod(f"anti-{i}", cpu="100m", memory="64Mi",
+                     labels={"app": "anti"})
+        p["spec"]["affinity"] = {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "topologyKey": "kubernetes.io/hostname",
+                "labelSelector": {"matchLabels": {"app": "anti"}}}]}}
+        pods.append(p)
+    for i in range(12):  # required self-affinity: bootstrap a node, pack it
+        p = make_pod(f"pack-{i}", cpu="100m", memory="64Mi",
+                     labels={"app": "pack"})
+        p["spec"]["affinity"] = {"podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "topologyKey": "kubernetes.io/hostname",
+                "labelSelector": {"matchLabels": {"app": "pack"}}}]}}
+        pods.append(p)
+    for i in range(20):  # hard spread: DoNotSchedule at maxSkew 1
+        p = make_pod(f"hs-{i}", cpu="100m", memory="64Mi",
+                     labels={"app": "hs"})
+        p["spec"]["topologySpreadConstraints"] = [{
+            "maxSkew": 1, "topologyKey": "kubernetes.io/hostname",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "hs"}}}]
+        pods.append(p)
+    return nodes, pods
+
+
+@pytest.mark.parametrize("shards", [2, 8])
+def test_hard_predicate_affinity_bit_identical_across_shards(shards):
+    """The epoch-amortized collective path (ONE stacked all-reduce + ONE
+    payload all-gather per epoch, selection replicated post-gather) must
+    not perturb a single placement on the hard-predicate wave: required
+    anti-affinity overflow fails identically, the self-affinity bootstrap
+    picks the same node, and hard spread balances identically."""
+    nodes, pods = _hard_affinity_workload()
+    _, want, want_failed = _run(nodes, pods, mesh=None)
+    assert want_failed == 4  # the hard predicate really bites
+    sim, got, got_failed = _run(nodes, pods, mesh=make_node_mesh(shards))
+    kinds = {s[0] for s in sim._segments(sim._last_tables,
+                                         len(sim._last_tables.valid))}
+    assert "affinity" in kinds  # the batch really drove the affinity kernel
+    assert got == want and got_failed == want_failed
+
+
 def test_zero_recompiles_on_warm_second_dispatch():
     """Two Simulators over EQUAL meshes share one sharded-executable set:
     the second run must not trigger a single XLA backend compile

@@ -9,7 +9,18 @@ compile-cache accounting starts from zero exactly like the baseline run):
    a scoped window);
 2. **sweep** — the committed zone-outage example sweep with full parity
    fuzzing;
-3. **host_1m RSS gate** — the 1M-pod columnar host-path workload
+3. **hard** — a fixed single-device hard-predicate batch (taints +
+   required anti-affinity + zone spread, the affinity-wave route): its
+   registry families (segment counts per kind, compile-cache misses for
+   the hard shapes, commit totals) join the baseline diff, so shape churn
+   or a route regression on the hard path fails CI like any other
+   bad-direction drift;
+4. **mesh8_hard** — the sharded hard-predicate wave on an 8-virtual-device
+   CPU mesh, in its own interpreter (the epoch-amortized collective path):
+   placements must be bit-identical to the single-device engine on the
+   same workload, reshard_bytes must be 0, and the rate must clear a
+   generous floor (MESH8_HARD_FLOOR; see the constant's comment);
+5. **host_1m RSS gate** — the 1M-pod columnar host-path workload
    (PodStore/NodeStore, streaming encode forced on) in its own interpreter,
    with a hard peak-RSS budget: the struct-of-arrays store must CUT host
    memory vs the dict path, and streaming must cap per-run buffers
@@ -72,6 +83,17 @@ MUST_BE_ZERO = (
 # module docstring).
 VERSION_DEPENDENT = ("simon_xla_backend_compile",)
 
+# Rate floor for the sharded hard-predicate gate workload (pods/s). This is
+# a CORRECTNESS-adjacent floor, not a perf target: on the 1-core CI host the
+# 8 virtual devices serialize the replicated selection tail, so the rate
+# mostly measures host contention. Measured ~5.3k pods/s warm at the full
+# 10k/1k bench shape; the scaled-down gate shape runs hotter per pod. The
+# floor sits far below both so only a pathological regression (e.g. the
+# epoch loop re-growing per-round collectives, or an accidental fall back
+# to serial per-pod scheduling) trips it — bit-identity and reshard_bytes
+# are the strict gates.
+MESH8_HARD_FLOOR = 500
+
 # Peak-RSS budget for the 1M-pod columnar host-path workload (PR 15): the
 # struct-of-arrays store + streaming encode must CUT host memory, not grow
 # it. Measured: ~300MB peak (store + jax runtime + streamed chunks) vs
@@ -127,7 +149,7 @@ def run_rss_gate() -> dict:
 
 def run_workloads() -> dict:
     """The fixed gate workloads; returns the fresh serve row (the sweep's
-    effect lands in the shared registry)."""
+    and hard batch's effects land in the shared registry)."""
     from loadgen import run_loadgen
 
     from open_simulator_tpu.sweep import SweepRunner, load_spec
@@ -143,6 +165,47 @@ def run_workloads() -> dict:
                                   "zone-outage.yaml"))
     runner = SweepRunner(spec, parity="full")
     runner.run()
+    run_hard_workload()
+    return row
+
+
+def run_hard_workload() -> None:
+    """The fixed single-device hard-predicate batch (the affinity-wave
+    route). Runs in THIS process so its registry families enter the
+    baseline diff: a new compile-cache shape on the hard path, a segment
+    routed off the wave kernels, or any parity/guard family moving shows
+    up as bad-direction drift against the committed golden."""
+    from open_simulator_tpu.simulator.engine import Simulator
+    from open_simulator_tpu.utils.synth import synth_cluster
+
+    nodes, pods = synth_cluster(500, 5_000, hard_predicates=True)
+    sim = Simulator(nodes, use_mesh=False)
+    failed = sim.schedule_pods(pods)
+    placed = sum(len(p) for p in sim.pods_on_node)
+    if failed or placed != 5_000:
+        raise SystemExit(f"gate hard workload mis-scheduled: "
+                         f"placed={placed}, failed={len(failed)}")
+
+
+def run_mesh8_hard_gate() -> dict:
+    """The sharded hard-predicate wave (epoch-amortized collectives) on an
+    8-virtual-device CPU mesh, via bench.bench_mesh_cpu's own fresh
+    interpreter (this process' jax is already initialized single-device).
+    The strict gates are bit-identity against the single-device engine and
+    reshard_bytes == 0; the rate floor only catches pathologies (see
+    MESH8_HARD_FLOOR)."""
+    from bench import bench_mesh_cpu
+
+    rate, wall, placed, total, match, reshard, _transfer, err = \
+        bench_mesh_cpu(n_nodes=256, n_pods=2_000, shards=8, hard=True,
+                       repeats=1, timeout=600, check_single=True)
+    row = {"rate": round(rate, 1), "wall_s": round(wall, 3),
+           "placed": placed, "total": total, "match": match,
+           "reshard_bytes": reshard}
+    if err:
+        raise SystemExit(f"gate mesh8_hard workload errored: {err}")
+    if placed != total or total != 2_000:
+        raise SystemExit(f"gate mesh8_hard workload mis-scheduled: {row}")
     return row
 
 
@@ -170,6 +233,25 @@ def main(argv=None) -> int:
     snap = fresh_snapshot()
     print(f"gate serve row: {row['value']} req/s, "
           f"{row['requests']} requests, parity_ok={row['parity_ok']}")
+
+    mesh = run_mesh8_hard_gate()
+    print(f"gate mesh8_hard row: {mesh['rate']} pods/s "
+          f"(floor {MESH8_HARD_FLOOR}), {mesh['wall_s']}s, "
+          f"match={mesh['match']}, reshard_bytes={mesh['reshard_bytes']}")
+    mesh_failures = []
+    if mesh["match"] is not True:
+        mesh_failures.append(
+            "mesh8_hard placements diverged from the single-device engine "
+            "— the epoch-amortized collective path broke bit-identity")
+    if mesh["reshard_bytes"] != 0:
+        mesh_failures.append(
+            f"mesh8_hard resharded {mesh['reshard_bytes']} bytes — a "
+            f"dispatch-boundary or shard_map layout regression")
+    if mesh["rate"] < MESH8_HARD_FLOOR:
+        mesh_failures.append(
+            f"mesh8_hard rate {mesh['rate']} pods/s under the "
+            f"{MESH8_HARD_FLOOR} floor — per-round collectives (or a "
+            f"serial fallback) are back in the epoch loop")
 
     rss = run_rss_gate()
     print(f"gate 1M-row rss: {rss['rss_mb']}MB peak "
@@ -211,7 +293,7 @@ def main(argv=None) -> int:
     from open_simulator_tpu.cli.main import _diff_metrics
 
     changed, regressions = _diff_metrics(base, snap, sys.stdout)
-    for msg in hard_failures:
+    for msg in hard_failures + mesh_failures:
         print(f"GATE FAILURE: {msg}", file=sys.stderr)
     if rss_failure:
         print(f"GATE FAILURE: {rss_failure}", file=sys.stderr)
@@ -220,7 +302,7 @@ def main(argv=None) -> int:
               f"grew vs {os.path.relpath(BASELINE, REPO)} (re-baseline "
               f"with --update ONLY if the growth is intended)",
               file=sys.stderr)
-    if hard_failures or regressions or rss_failure:
+    if hard_failures or regressions or rss_failure or mesh_failures:
         return 1
     print(f"bench gate: OK ({changed} metric(s) changed, 0 regressions)")
     return 0
