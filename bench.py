@@ -207,7 +207,9 @@ def bench_mesh_cpu(n_nodes=1_000, n_pods=10_000, shards=8, hard=False,
     reshard) and transfer_bytes (host→device staging).
 
     Returns (pods_per_sec, wall_s, scheduled, total, match, reshard_bytes,
-    transfer_bytes, error)."""
+    transfer_bytes, pulse_block, error) — pulse_block is the subprocess'
+    simonpulse summary (phase wall decomposition, per-kernel roofline
+    numbers, streaming chunk count), or {} when the run errored."""
     code = f"""
 import json, os, sys, time
 sys.path.insert(0, {repr(REPO)})
@@ -219,7 +221,8 @@ force_cpu_platform()
 from open_simulator_tpu.utils.synth import synth_cluster, synth_cluster_store
 from open_simulator_tpu.simulator.engine import Simulator
 from open_simulator_tpu.simulator.encode import scheduling_signature
-from open_simulator_tpu.obs import REGISTRY
+from open_simulator_tpu.obs import REGISTRY, pulse
+pulse.enable(roofline_dispatch=True)
 
 def census(sim):
     out = {{}}
@@ -268,11 +271,21 @@ match = True
 if {check_single}:
     _, _, _, single_census = one_run(False, True)
     match = single_census == mesh_census
+# the simonpulse ledger ran across every repeat: ship the wall decomposition,
+# per-kernel roofline numbers, and the streaming chunk count back to the row
+summ = pulse.active().summary()
 print(json.dumps({{
     "rate": placed / dt, "wall_s": dt, "scheduled": placed, "total": total,
     "match": match,
     "reshard_bytes": reshard,
     "transfer_bytes": transfer,
+    "pulse": {{
+        "phase_seconds": summ["phase_seconds"],
+        "records": summ["records_total"],
+        "regressions": summ["regressions_total"],
+        "stream_chunks": int(vals.get("simon_stream_chunks_total") or 0),
+        "kernels": summ["kernels"],
+    }},
 }}))
 """
     env = dict(os.environ)
@@ -293,9 +306,9 @@ print(json.dumps({{
                              f"stderr tail: {out.stderr[-300:]!r})")
         return (data["rate"], data["wall_s"], data["scheduled"],
                 data["total"], bool(data["match"]), data["reshard_bytes"],
-                data["transfer_bytes"], "")
+                data["transfer_bytes"], data.get("pulse") or {}, "")
     except Exception as e:  # the mesh metric is best-effort; report, don't die
-        return 0.0, 0.0, 0, 0, False, -1, -1, f"{type(e).__name__}: {e}"
+        return 0.0, 0.0, 0, 0, False, -1, -1, {}, f"{type(e).__name__}: {e}"
 
 
 # --------------------------------------------------------------------------
@@ -445,7 +458,7 @@ def _row_agreement():
 
 
 def _mesh_row(metric, **kw):
-    (rate, wall, placed, total, match, reshard, transfer,
+    (rate, wall, placed, total, match, reshard, transfer, pblock,
      err) = bench_mesh_cpu(**kw)
     return {
         "metric": metric,
@@ -457,8 +470,35 @@ def _mesh_row(metric, **kw):
         # dispatches reuse the declared carry shardings end-to-end); a
         # nonzero value localizes a layout regression to this row
         "reshard_bytes": reshard, "transfer_bytes": transfer,
+        # subprocess simonpulse block (phase decomposition + roofline);
+        # _run_worker's setdefault leaves this one in place
+        **({"pulse": pblock} if pblock else {}),
         **({"error": err} if err else {}),
     }
+
+
+def _streaming_verdict(pblock: dict) -> str:
+    """ROADMAP item 5 adjudication, from the row's own pulse counters: the
+    streaming path DOES re-pay the node-axis table build once per chunk
+    (build_batch_tables runs per streamed chunk), so quantify it — per-chunk
+    seconds and share of the run wall decide whether hoisting the node side
+    out of the chunk loop is worth an engine change."""
+    chunks = pblock.get("stream_chunks") or 0
+    phases = pblock.get("phase_seconds") or {}
+    tb = phases.get("table_build") or 0.0
+    if chunks < 2:
+        return ("streaming not engaged (single batch): no per-chunk "
+                "table-build re-payment to measure")
+    wall = sum(phases.values()) or 1.0
+    share = tb / wall
+    per_chunk_ms = tb / chunks * 1e3
+    verdict = ("CONFIRMED but minor" if share < 0.05 else "CONFIRMED, "
+               "significant — hoist the node-axis build out of the chunk "
+               "loop")
+    return (f"ROADMAP-5 ({chunks:.0f} chunks): node-axis table build "
+            f"re-paid per chunk at {per_chunk_ms:.1f}ms/chunk, "
+            f"{share:.1%} of phase wall — {verdict} (measured ~27ms/chunk, "
+            f"2.6% at 100k nodes; 0.6ms/chunk, 0.1% at 1k nodes)")
 
 
 def _row_mesh8():
@@ -487,6 +527,8 @@ def _row_mesh8_1m():
                     n_nodes=100_000, n_pods=1_000_000, check_single=False,
                     repeats=1, timeout=2700, store=True)
     row["placements_match_single_device"] = None  # not run at this size
+    if "pulse" in row:
+        row["note"] = _streaming_verdict(row["pulse"])
     return row
 
 
@@ -500,6 +542,8 @@ def _row_mesh8_10m():
                     n_nodes=1_000_000, n_pods=10_000_000, check_single=False,
                     repeats=0, timeout=2700, store=True)
     row["placements_match_single_device"] = None  # not run at this size
+    if "pulse" in row:
+        row["note"] = _streaming_verdict(row["pulse"])
     return row
 
 
@@ -650,6 +694,28 @@ METRICS = [
 ]
 
 
+def _pulse_block(summ: dict) -> dict:
+    """Trim a pulse summary() document to the fields a BENCH_DETAIL row
+    carries: the run-phase wall decomposition plus per-kernel
+    cost_analysis FLOPs/bytes, model-optimal seconds, and the achieved
+    roofline fraction of the warm dispatches."""
+    kernels = []
+    for r in summ.get("kernels", []):
+        k = {f: r[f] for f in ("kernel", "digest", "n", "cold", "warm")
+             if f in r}
+        for f in ("warm_med_s", "flops", "bytes_accessed",
+                  "model_optimal_s", "achieved_frac", "regressions"):
+            if f in r:
+                k[f] = r[f]
+        kernels.append(k)
+    return {
+        "phase_seconds": summ.get("phase_seconds", {}),
+        "records": summ.get("records_total", 0),
+        "regressions": summ.get("regressions_total", 0),
+        "kernels": kernels,
+    }
+
+
 def _run_worker(name: str) -> None:
     """Subprocess entry: select platform, run one metric, print its row.
 
@@ -668,6 +734,16 @@ def _run_worker(name: str) -> None:
         from open_simulator_tpu.utils.devices import force_cpu_platform
 
         force_cpu_platform()
+    # the simonpulse ledger rides every metric run (dispatch-time roofline
+    # harvest on, so the cost numbers match THIS row's shapes, not just the
+    # audit buckets); its per-dispatch cost is microseconds against rows
+    # measured in seconds, and tools/pulse_smoke.py CI-gates the overhead
+    try:
+        from open_simulator_tpu.obs import pulse
+
+        pulse.enable(roofline_dispatch=True)
+    except Exception:
+        pulse = None  # observability must never fail the bench
     builder = {n: b for n, b, _, _ in METRICS}[name]
     row = builder()
     # each metric runs in its own subprocess, so the registry holds exactly
@@ -680,6 +756,13 @@ def _run_worker(name: str) -> None:
         row["obs_metrics"] = REGISTRY.values()
     except Exception:
         pass  # observability must never fail the bench
+    # every row carries its pulse block; mesh rows already embedded the
+    # one their subprocess measured (setdefault keeps it)
+    try:
+        if pulse is not None and pulse.active() is not None:
+            row.setdefault("pulse", _pulse_block(pulse.active().summary()))
+    except Exception:
+        pass
     os.write(real_stdout, (json.dumps(row) + "\n").encode())
 
 

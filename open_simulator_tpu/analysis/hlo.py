@@ -377,6 +377,51 @@ def _digest(name: str, statics, abs_args, mesh_label: str,
         json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]
 
 
+def dispatch_digest(kernel: str, dims) -> str:
+    """The runtime sibling of `_digest`: the stable identity of one live
+    dispatch from its `obs.record_dispatch` signature (kernel + the static
+    shape/config dims the compile cache keys on). simonpulse keys its
+    performance ledger on this — two records sharing a digest ran the same
+    executable, so a wall-time delta between them is environmental; a digest
+    change means the executable itself changed. Same construction as
+    `_digest` (sha256 over a sorted-json payload, 16 hex chars) so ledger
+    keys and audit certificates read as one digest family. No jax: dims are
+    host scalars by the record_dispatch contract."""
+    payload = {
+        "kernel": kernel,
+        "dims": {str(k): repr(v) for k, v in dims.items()},
+    }
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def cost_census(compiled) -> dict:
+    """FLOPs / bytes-accessed of one compiled executable, normalized across
+    jax versions (dict vs one-element list; 'bytes accessed' vs per-operand
+    keys). The roofline source: simonaudit embeds this as the certificate's
+    `cost` field, simonpulse turns it into model-optimal seconds. Returns
+    zeros when the backend offers no cost model — the field stays present so
+    goldens keep a stable shape (check_cert never inspects it; drift here is
+    informational, printed by --update only)."""
+    try:
+        raw = compiled.cost_analysis()
+    # simonlint: ignore[swallowed-exception] -- diagnostics-only harvest: a
+    # backend without a cost model must not fail certification of the
+    # artifact's real contracts (collectives/donation/escapes)
+    except Exception:
+        raw = None
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else None
+    if not isinstance(raw, dict):
+        return {"flops": 0.0, "bytes_accessed": 0.0}
+    flops = float(raw.get("flops", 0.0) or 0.0)
+    by = raw.get("bytes accessed", raw.get("bytes_accessed"))
+    if by is None:
+        by = sum(float(v) for k, v in raw.items()
+                 if isinstance(k, str) and k.startswith("bytes accessed"))
+    return {"flops": flops, "bytes_accessed": float(by or 0.0)}
+
+
 def _carry_promotions(name: str, spec, statics, head_abs, dyn_abs):
     """Output-carry leaves whose dtype left the input contract."""
     import jax
@@ -474,6 +519,9 @@ def audit_kernel(name: str, bucket_key: str, shards: int) -> dict:
         },
         "carry_promotions": _carry_promotions(
             name, spec, statics, head_abs, dyn_abs),
+        # roofline source (simonpulse): model-optimal seconds derive from
+        # these at the configured peak rates; never checked by check_cert
+        "cost": cost_census(compiled),
     }
     cert["budget"] = _budget_for(cert)
     return cert

@@ -20,6 +20,9 @@ Rule ids (stable — they appear in suppression comments and CI output):
   collective-in-scan-body  cross-shard collective (psum/pmax/all_gather/...)
                      inside a scan/while/fori body — per-iteration latency
                      that should be batched to the loop boundary
+  unattributed-dispatch  hot-kernel dispatch under guard.supervised with no
+                     obs.record_dispatch in its attribution path — invisible
+                     to the compile-cache census and the simonpulse ledger
 
 Every rule is a pure function ModuleContext -> List[Finding]; file IO,
 suppressions, and exit-code policy live in runner.py.
@@ -609,6 +612,126 @@ def rule_naked_dispatch(ctx: ModuleContext) -> List[Finding]:
                 f"quarantine, or failover (wrap the dispatch, or whitelist "
                 f"non-hot-path harness code)",
             ))
+    return out
+
+
+# ----------------------------------------------------- unattributed-dispatch --
+
+
+def _wrapped_dispatch_targets(
+        ctx: ModuleContext, call: ast.Call,
+        encl: Optional[ast.AST]) -> tuple:
+    """(function_nodes, kernel_name) for a specific supervised(...) call —
+    the per-call-site companion of _supervised_functions. Resolves the first
+    argument through a direct name, a functools.partial wrapper, a method
+    attribute, or one level of local assignment in the enclosing function
+    (`call = functools.partial(...); supervised(call, ...)`). kernel_name is
+    set when the wrapped callable IS a dispatch kernel (partial-of-kernel,
+    the engine's hottest form), independent of function_nodes."""
+    fns: List[ast.AST] = []
+    kernel: List[Optional[str]] = [None]
+
+    def add(expr: Optional[ast.expr], depth: int = 0) -> None:
+        if expr is None or depth > 4:
+            return
+        if isinstance(expr, ast.Lambda):
+            fns.append(expr)
+            return
+        r = ctx.resolve(expr)
+        if r is not None and r.split(".")[-1] in _DISPATCH_KERNELS:
+            kernel[0] = r.split(".")[-1]
+            return
+        fn = ctx.lookup_function(expr)
+        if fn is not None:
+            fns.append(fn)
+            return
+        if isinstance(expr, ast.Call):
+            cr = ctx.resolve(expr.func) or ""
+            if cr in PARTIAL_NAMES or cr.endswith(".partial"):
+                add(expr.args[0] if expr.args else None, depth + 1)
+            return
+        if isinstance(expr, ast.Name) and encl is not None:
+            for node in ast.walk(encl):
+                if (isinstance(node, ast.Assign)
+                        and any(isinstance(t, ast.Name) and t.id == expr.id
+                                for t in node.targets)):
+                    add(node.value, depth + 1)
+            return
+        if isinstance(expr, ast.Attribute):
+            fns.extend(ctx.functions.get(expr.attr, []))
+
+    add(call.args[0] if call.args else None)
+    return fns, kernel[0]
+
+
+def _has_record_dispatch(ctx: ModuleContext,
+                         scope: Optional[ast.AST]) -> bool:
+    if scope is None:
+        return False
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Call):
+            r = ctx.resolve(n.func) or ""
+            if r == "record_dispatch" or r.endswith(".record_dispatch"):
+                return True
+    return False
+
+
+@register(
+    "unattributed-dispatch", Severity.WARNING,
+    "A hot kernel is dispatched under guard.supervised with no "
+    "obs.record_dispatch(...) in its attribution path. record_dispatch is "
+    "the single definition of 'one dispatch happened': it keys the "
+    "compile-cache hit/miss census AND parks the simonpulse ledger note "
+    "that guard.supervised commits after the unit returns — without it the "
+    "dispatch is invisible to both. Call obs.record_dispatch(kernel, "
+    "**dims) at the supervised call site (engine pattern) or inside the "
+    "wrapped function body (probe pattern), or whitelist deliberate "
+    "harness/offline code with "
+    "`# simonlint: ignore[unattributed-dispatch] -- <why>`.",
+)
+def rule_unattributed_dispatch(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        r = ctx.resolve(node.func) or ""
+        if not (r == "supervised" or r.endswith(".supervised")):
+            continue
+        encl: Optional[ast.AST] = ctx.parents.get(node)
+        while encl is not None and not isinstance(
+                encl, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            encl = ctx.parents.get(encl)
+        wrapped, kernel = _wrapped_dispatch_targets(ctx, node, encl)
+        if kernel is None:
+            for fn in wrapped:
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Call):
+                        k = _is_kernel_dispatch(ctx, sub)
+                        if k is not None:
+                            kernel = k
+                            break
+                if kernel is not None:
+                    break
+        if kernel is None:
+            continue  # supervised fetch/host work — not a kernel dispatch
+        # attribution path 1 (probe pattern): record_dispatch runs inside
+        # the wrapped body, so the note lands in the worker's context
+        if any(_has_record_dispatch(ctx, fn) for fn in wrapped):
+            continue
+        # attribution path 2 (engine pattern): record_dispatch at the
+        # supervised call site, before the unit is handed to the watchdog
+        if _has_record_dispatch(ctx, encl if encl is not None else ctx.tree):
+            continue
+        out.append(Finding(
+            "unattributed-dispatch", Severity.WARNING, ctx.path,
+            node.lineno, node.col_offset,
+            f"kernels.{kernel}(...) runs under guard.supervised with no "
+            f"record_dispatch in its attribution path — the dispatch is "
+            f"invisible to the compile-cache census and lands in the "
+            f"simonpulse ledger with no kernel/bucket attribution (call "
+            f"obs.record_dispatch at the call site or inside the wrapped "
+            f"body, or whitelist offline harness code)",
+        ))
     return out
 
 

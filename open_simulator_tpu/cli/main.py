@@ -263,6 +263,25 @@ def build_parser() -> argparse.ArgumentParser:
                        help="append frames instead of clearing the screen "
                             "(logs / CI)")
 
+    p_pulse = sub.add_parser(
+        "pulse", help="Render the simonpulse performance ledger: per-"
+                      "dispatch wall decomposition, warm-wall MAD baselines "
+                      "and flagged regressions, and the static roofline "
+                      "cost table (cost_analysis FLOPs/bytes at the audit "
+                      "buckets)")
+    p_pulse.add_argument("--url", default="", metavar="URL",
+                         help="fetch GET {URL}/v1/pulse from a running "
+                              "server instead of reading locally")
+    p_pulse.add_argument("--jsonl", default="", metavar="FILE",
+                         help="summarize a spilled ledger file "
+                              "(OPEN_SIMULATOR_PULSE_JSONL) offline")
+    p_pulse.add_argument("--roofline", action="store_true",
+                         help="print the static roofline table from the "
+                              "audit goldens' cost census (every "
+                              "HOT_KERNELS entry x bucket x mesh)")
+    p_pulse.add_argument("--json", action="store_true",
+                         help="emit the raw summary document as JSON")
+
     p_sweep = sub.add_parser(
         "sweep", help="Run a batched scenario sweep (simonsweep): N "
                       "independent what-if futures — drains, zone outages, "
@@ -602,6 +621,10 @@ _BAD_WHEN_UP = (
     "simon_sweep_parity_mismatches_total",
     "simon_scope_trace_dropped_total",
     "simon_scope_sampler_errors_total",
+    # simonpulse (PR 18): a flagged warm-wall regression is a performance
+    # defect by definition; evicted ledger records are observability loss
+    "simon_pulse_regressions_total",
+    "simon_pulse_records_dropped_total",
 )
 
 
@@ -818,6 +841,75 @@ def cmd_top(args) -> int:
         return 0  # `simon top | head` closing the pipe early is fine
 
 
+def cmd_pulse(args) -> int:
+    """`simon pulse`: render the performance ledger — from a running server
+    (--url), a spilled JSONL file (--jsonl), or this process's Pulse (mostly
+    useful under --roofline, which needs no live ledger at all)."""
+    from ..obs import pulse
+
+    if args.roofline:
+        rows = pulse.roofline_table()
+        if not rows:
+            print("pulse error: no cost data in the audit goldens — run "
+                  "`simon audit --update` to (re)generate certificates "
+                  "with a cost census", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(rows, indent=1, sort_keys=True))
+        else:
+            print(pulse.format_roofline(rows))
+        return 0
+    if args.url:
+        import urllib.error
+        import urllib.request
+
+        base = args.url.rstrip("/")
+        if "://" not in base:
+            base = "http://" + base
+        target = base + "/v1/pulse"
+        try:
+            with urllib.request.urlopen(target, timeout=10) as resp:
+                doc = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            body = e.read().decode(errors="replace")
+            print(f"pulse error: {target} -> HTTP {e.code}: {body}",
+                  file=sys.stderr)
+            return 1
+        except (urllib.error.URLError, OSError) as e:
+            print(f"pulse error: {target}: {e}", file=sys.stderr)
+            return 1
+    elif args.jsonl:
+        recs = []
+        try:
+            with open(args.jsonl, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        recs.append(json.loads(line))
+        except (OSError, ValueError) as e:
+            print(f"pulse error: {args.jsonl}: {e}", file=sys.stderr)
+            return 1
+        doc = pulse.summarize_records(recs)
+    else:
+        p = pulse.active()
+        if p is None:
+            print("pulse error: simonpulse is off in this process; use "
+                  "--url against a server started with "
+                  "OPEN_SIMULATOR_PULSE=1, --jsonl on a spilled ledger, "
+                  "or --roofline for the static cost table",
+                  file=sys.stderr)
+            return 1
+        doc = p.summary()
+    try:
+        if args.json:
+            print(json.dumps(doc, indent=1, sort_keys=True))
+        else:
+            print(pulse.format_summary(doc))
+    except BrokenPipeError:
+        return 0  # `simon pulse | head` closing the pipe early is fine
+    return 0
+
+
 def cmd_version(_args) -> int:
     print(f"Version: {__version__}")
     print(f"Commit: {COMMIT_ID}")
@@ -877,6 +969,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "version": cmd_version,
         "gen-doc": cmd_gen_doc,
         "parity": cmd_parity,
+        "pulse": cmd_pulse,
     }
     if not args.command:
         parser.print_help()

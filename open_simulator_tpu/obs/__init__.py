@@ -23,6 +23,18 @@
   compile-cache deltas, transfer rate). Surfaced on `simon slo`,
   `simon top`, `GET /v1/serve/stats`, and `GET /v1/serve/trace`. Imported
   lazily by consumers for the same reason as xray.
+- `obs.pulse` — simonpulse, the continuous per-dispatch performance ledger
+  (OPEN_SIMULATOR_PULSE=1): every guard.supervised kernel dispatch lands
+  one bounded-ring record (kernel, shape-bucket digest, mesh, pods,
+  cold/warm, wall) with optional JSONL spill; scheduling-run records carry
+  the encode/table_build/to_device/dispatch/fetch/commit wall
+  decomposition; warm walls are checked against rolling per-(kernel,
+  digest) MAD baselines (`simon_pulse_regressions_total`); and a roofline
+  cost model built from `compiled.cost_analysis()` (harvested into every
+  audit certificate's `cost` field) turns warm walls into achieved-of-
+  optimal fractions. Surfaced on `simon pulse`, `GET /v1/pulse`, and as
+  perfetto counter tracks in the scope trace. Imported lazily by
+  consumers for the same reason as xray.
 
 Instrumentation lives on the HOST side of the device boundary by contract:
 the `metric-in-jit` simonlint rule rejects registry mutations or wall-clock

@@ -387,6 +387,45 @@ SCOPE_SAMPLER_ERRORS = counter(
     "failed). The sampler keeps running; failures are counted, not silent.",
     ("kind",))
 
+# ------------------------------------------------------------------- pulse ----
+# simonpulse (obs/pulse.py): roofline cost accounting + the per-dispatch
+# performance ledger. Every family here is LABELED on purpose (the xray/scope
+# contract): an untouched labeled family renders no samples, so a pulse-off
+# run's /metrics and --metrics-out output stays byte-identical to pre-pulse
+# builds.
+
+PULSE_RECORDS = counter(
+    "simon_pulse_records_total",
+    "Performance-ledger records appended, by kind (dispatch / run). Zero "
+    "unless pulse is on (OPEN_SIMULATOR_PULSE=1 or pulse.enable()).",
+    ("kind",))
+PULSE_DROPPED = counter(
+    "simon_pulse_records_dropped_total",
+    "Ledger records evicted from the bounded ring buffer, by kind "
+    "(OPEN_SIMULATOR_PULSE_CAP; the JSONL spill, when configured, keeps "
+    "every record). Never silent: every eviction is counted here.",
+    ("kind",))
+PULSE_REGRESSIONS = counter(
+    "simon_pulse_regressions_total",
+    "Warm dispatches flagged as MAD outliers against their rolling "
+    "per-(kernel, dispatch-digest) warm-wall baseline — 'same executable, "
+    "slower environment' drift (OPEN_SIMULATOR_PULSE_MAD_K).",
+    ("kernel", "bucket"))
+PULSE_PHASE_SECONDS = counter(
+    "simon_pulse_phase_seconds_total",
+    "Scheduling-run wall seconds by phase (encode / table_build / to_device "
+    "/ dispatch / fetch / commit) — the per-run decomposition of "
+    "simon_e2e_scheduling_duration_seconds the ledger's run records carry. "
+    "table_build is the node-axis [*, N] table construction inside encode, "
+    "counted per chunk on the streaming path (ROADMAP item 5).",
+    ("phase",))
+PULSE_ACHIEVED = gauge(
+    "simon_pulse_achieved_fraction",
+    "Most recent achieved fraction of the roofline model-optimal time per "
+    "warm dispatch: model_optimal_s / measured wall, from cost_analysis "
+    "FLOPs/bytes at OPEN_SIMULATOR_PEAK_GFLOPS / OPEN_SIMULATOR_PEAK_GBS.",
+    ("kernel", "bucket"))
+
 # ---------------------------------------------------------- capacity search ---
 
 CAPACITY_SEARCHES = counter(
@@ -401,6 +440,13 @@ CAPACITY_ROUNDS = counter(
 
 _SEEN_SHAPES: Set[Tuple] = set()
 _SEEN_LOCK = threading.Lock()
+
+# simonpulse attribution hook: pulse.enable() installs its note_dispatch here
+# so every record_dispatch call (THE definition of "one kernel dispatch")
+# also lands in the performance ledger; None keeps pulse-off dispatches at
+# exactly one extra global read. instruments never imports pulse — the hook
+# direction keeps the catalog import-light.
+_DISPATCH_HOOK = None
 
 
 def record_dispatch(kernel: str, **dims) -> bool:
@@ -419,6 +465,9 @@ def record_dispatch(kernel: str, **dims) -> bool:
         COMPILE_MISSES.labels(kernel=kernel, shape=shape).inc()
     else:
         COMPILE_HITS.labels(kernel=kernel).inc()
+    hook = _DISPATCH_HOOK
+    if hook is not None:
+        hook(kernel, dims, miss)
     return miss
 
 

@@ -51,6 +51,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple, TypeVar
 
 from ..obs import instruments as obs
+from ..obs import pulse
 from . import faults
 from .policy import check_deadline, deadline_remaining
 
@@ -376,8 +377,27 @@ def supervised(fn: Callable[[], T], *, site: str, pods: int = 0) -> T:
         faults.maybe_fail("watchdog_wedge")
     except faults.FaultInjected as e:
         raise _declare_wedged(site, injected=True) from e
+    # simonpulse ledger: the window must exist in THIS context before
+    # copy_context below — the pending-list object crosses into the worker
+    # by reference, so dispatch notes made inside fn (probe rounds) land in
+    # the list this caller drains at commit_unit. One global read when off.
+    pl = pulse.active()
+    if pl is not None:
+        pulse.ensure_window()
+        t_pulse = time.perf_counter()
     if not watchdog_enabled():
-        return fn()
+        if pl is None:
+            return fn()
+        try:
+            result = fn()
+        except BaseException:
+            pl.commit_unit(site=site, pods=pods,
+                           wall_s=time.perf_counter() - t_pulse, ok=False,
+                           fn=fn)
+            raise
+        pl.commit_unit(site=site, pods=pods,
+                       wall_s=time.perf_counter() - t_pulse, fn=fn)
+        return result
     budget = watchdog_budget(pods)
     if deadline_remaining() is not None:
         check_deadline(site)
@@ -404,7 +424,15 @@ def supervised(fn: Callable[[], T], *, site: str, pods: int = 0) -> T:
     t.start()
     if not done.wait(budget):
         check_deadline(site)  # the caller's budget expired, not the device
+        if pl is not None:
+            pl.commit_unit(site=site, pods=pods,
+                           wall_s=time.perf_counter() - t_pulse, ok=False,
+                           fn=fn)
         raise _declare_wedged(site, injected=False)
+    if pl is not None:
+        pl.commit_unit(site=site, pods=pods,
+                       wall_s=time.perf_counter() - t_pulse,
+                       ok="error" not in box, fn=fn)
     if "error" in box:
         raise box["error"]
     return box["result"]

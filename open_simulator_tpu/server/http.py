@@ -221,6 +221,13 @@ class Server:
         self._scope_owned = bool(scope) and scope_mod.active() is None
         if scope:
             scope_mod.enable(sampler=True)
+        # simonpulse boots from the env here too: the serve path stages a
+        # ResidentImage without ever constructing a Simulator (whose ctor is
+        # the other maybe_enable_from_env site), so OPEN_SIMULATOR_PULSE=1
+        # must take effect before the first supervised dispatch
+        from ..obs import pulse as pulse_mod
+
+        pulse_mod.maybe_enable_from_env()
         self._whatif_svc = None
         self._whatif_declined = False
         self._whatif_lock = threading.Lock()
@@ -527,7 +534,8 @@ class Server:
             # children and window histograms), so paths normalize to these
             # families and everything else buckets to "other".
             _SCOPE_ROUTES = ("/v1/whatif", "/v1/ingest", "/v1/serve/stats",
-                             "/v1/serve/trace", "/api/deploy-apps",
+                             "/v1/serve/trace", "/v1/pulse",
+                             "/api/deploy-apps",
                              "/api/scale-apps", "/explain/", "/debug/vars",
                              "/debug/pprof/profile", "/debug/fault-plan")
 
@@ -730,6 +738,20 @@ class Server:
                         return
                     self._send(200, sc.chrome_trace(
                         metrics=REGISTRY.snapshot()))
+                elif self.path == "/v1/pulse":
+                    # simonpulse: the performance-ledger summary — per-
+                    # (kernel, digest) warm-wall baselines, regression
+                    # counts, achieved-roofline fractions, and the run-phase
+                    # wall decomposition (what `simon pulse --url` renders)
+                    from ..obs import pulse as pulse_mod
+
+                    pl = pulse_mod.active()
+                    if pl is None:
+                        self._send_err(
+                            404, "simonpulse is off (set "
+                            "OPEN_SIMULATOR_PULSE=1)", "pulse")
+                        return
+                    self._send(200, pl.summary())
                 elif self.path == "/test":
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")

@@ -1638,8 +1638,20 @@ def build_batch_tables(
     search by the incremental prober), build_node_axis_tables carries every
     [*, N] table and the seeds. The pod-axis half runs first — it interns the
     batch's host ports, which sizes the node-side seed port table."""
+    from ..obs import pulse
+
     pod_side = build_pod_axis_tables(enc, batch, pad_to=pad_to)
-    node_side = build_node_axis_tables(enc, placed, match_cache)
+    if pulse.active() is not None:
+        # the ROADMAP-5 instrument: streaming chunks re-enter here once per
+        # chunk, so per-chunk node-axis table-build cost shows up directly
+        # as the table_build slice of the encode phase
+        import time
+
+        t0 = time.perf_counter()
+        node_side = build_node_axis_tables(enc, placed, match_cache)
+        pulse.phase("table_build", time.perf_counter() - t0)
+    else:
+        node_side = build_node_axis_tables(enc, placed, match_cache)
     return BatchTables(**pod_side, **node_side)
 
 

@@ -34,7 +34,7 @@ import numpy as np
 
 from ..core import constants as C
 from ..obs import instruments as obs
-from ..obs import xray
+from ..obs import pulse, xray
 from ..resilience import faults
 from ..resilience import guard
 from ..core.types import AppResource, NodeStatus, ResourceTypes, SimulateResult, UnscheduledPod
@@ -190,6 +190,8 @@ class Simulator:
         # ground-truth XLA compile counting (obs/instruments.py, idempotent);
         # this constructor has already committed to importing jax
         obs.install_jax_monitoring()
+        # simonpulse per-dispatch ledger (obs/pulse.py): OPEN_SIMULATOR_PULSE=1
+        pulse.maybe_enable_from_env()
 
         self.sched_config = sched_config or DEFAULT_SCHEDULER_CONFIG
         self.score_w = kernels.ScoreWeights(**self.sched_config.weight_kwargs())
@@ -1318,14 +1320,23 @@ class Simulator:
     def _schedule_run_once(self, to_schedule: List[dict]) -> List[UnscheduledPod]:
         from ..utils.trace import Span
 
-        with Span("schedule_run", log_if_longer=30.0) as span:
+        # simonpulse run window: dispatch records inside carry this run's id;
+        # the run record closes with the LIVE pod count (supervised sees
+        # padded counts — useless for attempts reconciliation) and the
+        # encode/to_device/dispatch/fetch/commit wall decomposition.
+        with pulse.run_window(len(to_schedule)), \
+                Span("schedule_run", log_if_longer=30.0) as span:
             t_enc = time.perf_counter()
             bt = self.encode_batch(to_schedule)
-            obs.ENCODE_SECONDS.observe(time.perf_counter() - t_enc)
+            dt_enc = time.perf_counter() - t_enc
+            obs.ENCODE_SECONDS.observe(dt_enc)
             obs.ENCODE_BYTES.inc(batch_tables_nbytes(bt))
             obs.BATCH_PODS.observe(len(to_schedule))
+            pulse.phase("encode", dt_enc)
             span.step("encode")
+            t_dev = time.perf_counter()
             tables, carry = self._to_device(bt)
+            pulse.phase("to_device", time.perf_counter() - t_dev)
             span.step("to_device")
             failed = self._dispatch_and_commit(to_schedule, bt, tables, carry,
                                                span)
@@ -1373,6 +1384,7 @@ class Simulator:
         # waiting on ~35ms of actual device work. `placed` is recovered on the
         # host as sum(counts), never fetched separately.
         outs: List[tuple] = []  # (seg, device array, carry AFTER the segment)
+        t_disp = time.perf_counter()
         for seg in segs:
             faults.maybe_fail("dispatch")
             faults.maybe_fail("oom_dispatch")
@@ -1472,6 +1484,8 @@ class Simulator:
                 _jax_mod.block_until_ready(outs[-1][1])
                 obs.SEGMENT_WALL.labels(kind=seg[0]).inc(
                     time.perf_counter() - t_seg)
+        t_fetch = time.perf_counter()
+        pulse.phase("dispatch", t_fetch - t_disp)
         span.step("dispatch")
         final_carry = carry
         seg_of = np.zeros(P, np.int32)
@@ -1548,6 +1562,7 @@ class Simulator:
             seg_start_carry = {}
         outs = None  # drop the per-segment carry references
         self._last_tables, self._last_carry = bt, final_carry
+        pulse.phase("fetch", time.perf_counter() - t_fetch)
         span.step("fetch")
 
         progress = getattr(self, "_progress", None)
@@ -1622,7 +1637,9 @@ class Simulator:
                         xb.add_pod(xray.pod_key(pod), xray.UNSCHEDULABLE, -1,
                                    key[2], sid, group=key[0], reason=reason)
                     failed.append(UnscheduledPod(pod, reason))
-        obs.HOST_COMMIT_SECONDS.observe(time.perf_counter() - t_commit)
+        dt_commit = time.perf_counter() - t_commit
+        obs.HOST_COMMIT_SECONDS.observe(dt_commit)
+        pulse.phase("commit", dt_commit)
         placed_n = P - len(failed)
         obs.SCHED_ATTEMPTS.labels(result="scheduled").inc(placed_n)
         if failed:
@@ -1947,6 +1964,11 @@ class Simulator:
 
         enable_gpu, enable_storage = getattr(self, "_last_flags", (True, True))
         kns, _ = self._kernel_ns(donate=False)  # diagnostics never donate
+        bt = getattr(self, "_last_tables", None)
+        obs.record_dispatch("feasibility_jit", gpu=enable_gpu,
+                            storage=enable_storage,
+                            **(self._dispatch_dims(bt) if bt is not None
+                               else {"cfg": self._cfg_digest()}))
         feasible, stages = guard.supervised(functools.partial(
             kns.feasibility_jit,
             tables, carry, jnp.int32(g), jnp.int32(forced), jnp.asarray(True),

@@ -266,6 +266,8 @@ def _fits(sim, g: int, node_i: int, placed2) -> bool:
     tables, carry = sim._to_device(bt)
     enable_gpu, enable_storage = plugin_flags(bt)
     kns, _ns = sim._kernel_ns(donate=False)  # diagnostics never donate
+    obs.record_dispatch("feasibility_jit", gpu=enable_gpu,
+                        storage=enable_storage, **sim._dispatch_dims(bt))
     feasible, _ = guard.supervised(functools.partial(
         kns.feasibility_jit,
         tables, carry, jnp.int32(g), jnp.int32(-1), jnp.asarray(True),
@@ -334,6 +336,8 @@ def try_preempt(sim, pod: dict) -> Tuple[int, List[dict], Dict[str, int]]:
     enable_gpu, enable_storage = plugin_flags(bt)
     g, forced = int(bt.pod_group[0]), int(bt.forced_node[0])
     kns, _ns = sim._kernel_ns(donate=False)  # diagnostics never donate
+    obs.record_dispatch("feasibility_jit", gpu=enable_gpu,
+                        storage=enable_storage, **sim._dispatch_dims(bt))
     feasible, stages = guard.supervised(functools.partial(
         kns.feasibility_jit,
         tables, carry, jnp.int32(g), jnp.int32(forced), jnp.asarray(True),

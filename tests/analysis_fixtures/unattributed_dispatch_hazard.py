@@ -1,0 +1,75 @@
+"""Fixture for the unattributed-dispatch rule: a hot-kernel dispatch under
+guard.supervised whose attribution path has no obs.record_dispatch must fire
+(the dispatch is invisible to the compile-cache census and lands in the
+simonpulse ledger with no kernel/bucket attribution); the engine pattern
+(record_dispatch at the call site), the probe pattern (record_dispatch
+inside the wrapped body), supervised host work with no kernel dispatch, and
+suppressed sites must not.
+
+Every supervised() call here also carries a naked-dispatch-free form on
+purpose — this rule's beat starts where naked-dispatch's ends (the dispatch
+IS supervised; what's missing is the ledger note)."""
+
+import functools
+
+from open_simulator_tpu import obs
+from open_simulator_tpu.ops import kernels
+from open_simulator_tpu.resilience import guard
+
+tables = carry = active = pg = fn = vd = None
+
+
+def unattributed_lambda():
+    # finding: supervised, but no record_dispatch anywhere on the path
+    return guard.supervised(
+        lambda: kernels.schedule_batch(tables, carry, pg, fn, vd),
+        site="dispatch", pods=8)
+
+
+def unattributed_partial():
+    # finding: partial resolution matches guard.supervised's, still no note
+    call = functools.partial(kernels.schedule_group_serial, tables, carry)
+    return guard.supervised(call, site="dispatch", pods=8)
+
+
+def _bare_round():
+    return kernels.probe_wave_fanout(tables, carry, active, 0, 8, False)
+
+
+def unattributed_named_function():
+    # finding: the wrapped body dispatches and neither scope has the note
+    return guard.supervised(_bare_round, site="dispatch", pods=8)
+
+
+def attributed_call_site():
+    # clean (engine pattern): record_dispatch at the supervised call site
+    obs.record_dispatch("schedule_batch", P=8, N=4)
+    return guard.supervised(
+        lambda: kernels.schedule_batch(tables, carry, pg, fn, vd),
+        site="dispatch", pods=8)
+
+
+def _noted_round():
+    # clean (probe pattern): the note is parked from inside the worker, so
+    # it crosses into the watchdog thread with the copied context
+    obs.record_dispatch("probe_wave_fanout", K=8, N=4)
+    return kernels.probe_wave_fanout(tables, carry, active, 0, 8, False)
+
+
+def attributed_wrapped_body():
+    return guard.supervised(_noted_round, site="dispatch", pods=8)
+
+
+def supervised_fetch_is_fine():
+    # clean: supervised host work (a fetch) dispatches no kernel — there is
+    # nothing to attribute
+    import numpy as np
+
+    return guard.supervised(lambda: np.asarray(carry), site="fetch", pods=8)
+
+
+def suppressed_unattributed():
+    # simonlint: ignore[unattributed-dispatch] -- offline harness, ledger attribution not needed
+    return guard.supervised(
+        lambda: kernels.schedule_wave(tables, carry, 0, 8, False),
+        site="dispatch", pods=8)

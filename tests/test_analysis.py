@@ -181,6 +181,30 @@ def test_naked_dispatch_spares_supervised_forms():
                    if f.rule == "naked-dispatch")
 
 
+def test_unattributed_dispatch_rule_fires():
+    # three supervised hot-kernel dispatches with no record_dispatch on the
+    # attribution path fire (lambda, partial-through-variable, named
+    # function); the offline-harness waiver reports suppressed
+    assert _counts("unattributed_dispatch_hazard.py",
+                   "unattributed-dispatch") == 3
+    assert _counts("unattributed_dispatch_hazard.py",
+                   "unattributed-dispatch", suppressed=True) == 1
+
+
+def test_unattributed_dispatch_spares_attributed_forms():
+    # the engine pattern (record_dispatch at the call site), the probe
+    # pattern (record_dispatch inside the wrapped body), and supervised
+    # host work with no kernel dispatch are all clean
+    fr = analyze_file(str(FIXTURES / "unattributed_dispatch_hazard.py"))
+    src = (FIXTURES / "unattributed_dispatch_hazard.py").read_text(
+        ).splitlines()
+    ok_start = next(i for i, l in enumerate(src, 1)
+                    if "def attributed_call_site" in l)
+    assert not any(f.line >= ok_start and not f.suppressed
+                   for f in fr.findings
+                   if f.rule == "unattributed-dispatch")
+
+
 def test_span_outside_guard_rule_fires():
     # three spans (utils/trace.Span x2, scope .span()) around unsupervised
     # kernel dispatches fire; the offline-harness waiver reports suppressed

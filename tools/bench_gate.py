@@ -77,6 +77,14 @@ MUST_BE_ZERO = (
     "simon_commit_rollbacks_total",
     "simon_scope_trace_dropped_total",
     "simon_scope_sampler_errors_total",
+    # simonpulse (PR 18): the gate workloads run with the ledger OFF, so any
+    # pulse sample moving means pulse self-enabled on the default path (the
+    # pulse-off byte-identity contract); regressions/drops are additionally
+    # _BAD_WHEN_UP in the shared diff machinery for runs that enable it
+    "simon_pulse_records_total",
+    "simon_pulse_records_dropped_total",
+    "simon_pulse_regressions_total",
+    "simon_pulse_phase_seconds_total",
 )
 
 # jax-version-dependent families excluded from the baseline diff (see
@@ -196,7 +204,7 @@ def run_mesh8_hard_gate() -> dict:
     MESH8_HARD_FLOOR)."""
     from bench import bench_mesh_cpu
 
-    rate, wall, placed, total, match, reshard, _transfer, err = \
+    rate, wall, placed, total, match, reshard, _transfer, _pulse, err = \
         bench_mesh_cpu(n_nodes=256, n_pods=2_000, shards=8, hard=True,
                        repeats=1, timeout=600, check_single=True)
     row = {"rate": round(rate, 1), "wall_s": round(wall, 3),
