@@ -1257,3 +1257,72 @@ def rule_per_pod_host_loop(ctx: ModuleContext) -> List[Finding]:
             f"fallback with its reason",
         ))
     return out
+
+
+# ------------------------------------------------------------ unbounded-queue --
+
+# The serving tier's memory-safety discipline (simonha, serve/ha.py): every
+# producer/consumer channel in a long-lived process is a memory hazard unless
+# its depth is bounded — a stalled consumer turns an unbounded queue into an
+# OOM kill with no 429 ever sent. stdlib spellings of "unbounded":
+# queue.Queue/LifoQueue/PriorityQueue with no maxsize (or an explicit
+# maxsize=0), SimpleQueue (never bounded), and collections.deque with no
+# maxlen.
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue"}
+
+
+def _is_zero(node: Optional[ast.AST]) -> bool:
+    return (isinstance(node, ast.Constant) and isinstance(node.value, int)
+            and not isinstance(node.value, bool) and node.value == 0)
+
+
+@register(
+    "unbounded-queue", Severity.WARNING,
+    "A queue.Queue()/LifoQueue/PriorityQueue without a positive maxsize, a "
+    "SimpleQueue (unboundable by construction), or a collections.deque() "
+    "without maxlen. In a long-lived serving process an unbounded channel is "
+    "deferred OOM: a stalled or slow consumer absorbs the backlog into heap "
+    "instead of shedding it at admission (simonha's bounded-queue + 429 "
+    "discipline). Pass maxsize=/maxlen=, or waive a deliberately unbounded "
+    "channel with `# simonlint: ignore[unbounded-queue] -- <why it is "
+    "bounded elsewhere>`.",
+)
+def rule_unbounded_queue(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            name = func.attr
+        elif isinstance(func, ast.Name):
+            name = func.id
+        else:
+            continue
+        hazard: Optional[str] = None
+        if name == "SimpleQueue":
+            hazard = ("SimpleQueue has no maxsize at all — use "
+                      "queue.Queue(maxsize=N)")
+        elif name in _QUEUE_CTORS:
+            maxsize = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "maxsize"),
+                None)
+            if maxsize is None or _is_zero(maxsize):
+                hazard = (f"{name}() without a positive maxsize accepts an "
+                          f"unbounded backlog")
+        elif name == "deque":
+            # deque(iterable, maxlen): a second positional IS the bound
+            maxlen = node.args[1] if len(node.args) > 1 else next(
+                (kw.value for kw in node.keywords if kw.arg == "maxlen"),
+                None)
+            if maxlen is None:
+                hazard = "deque() without maxlen grows with its producer"
+        if hazard is None:
+            continue
+        out.append(Finding(
+            "unbounded-queue", Severity.WARNING, ctx.path,
+            node.lineno, node.col_offset,
+            f"{hazard} — bound the channel and shed at admission, or waive "
+            f"with the reason the depth is bounded elsewhere",
+        ))
+    return out

@@ -238,6 +238,34 @@ def build_parser() -> argparse.ArgumentParser:
              "telemetry sampler) — it is ON by default in serve mode; "
              "tracing-off serving reproduces bit-identical placements and "
              "byte-identical metrics")
+    p_serve.add_argument(
+        "--state-dir", default=None, metavar="DIR",
+        help="simonha crash consistency: fsync every /v1/ingest delta to an "
+             "epoch-numbered WAL in DIR before it mutates the image, "
+             "checkpoint periodically, and on restart restore checkpoint + "
+             "WAL tail to a bit-identical image (default: off, in-memory "
+             "only)")
+    p_serve.add_argument(
+        "--staleness-ceiling", type=float, default=None, metavar="SECONDS",
+        help="degraded mode serves the last consistent epoch at most this "
+             "stale before /healthz flips 503 (default 120)")
+    p_serve.add_argument(
+        "--checkpoint-every", type=int, default=None, metavar="N",
+        help="compact the WAL into a checkpoint every N ingest records "
+             "(default 64)")
+    p_serve.add_argument(
+        "--max-queue", type=int, default=None, metavar="N",
+        help="admission control: shed /v1/whatif with 429 once N requests "
+             "are queued (default 256 in serve mode; deadline-aware "
+             "shedding rides the same controller)")
+    p_serve.add_argument(
+        "--tenant-rate", type=float, default=None, metavar="RPS",
+        help="per-(tenant, route) token-bucket rate limit in requests/s "
+             "(0 = unlimited, the default)")
+    p_serve.add_argument(
+        "--ingest-max-bytes", type=int, default=None, metavar="BYTES",
+        help="shed any /v1/ingest payload over BYTES with 413 before "
+             "reading it (default 8 MiB; in-flight total bounded at 4x)")
 
     p_slo = sub.add_parser(
         "slo", help="Render a running serve instance's SLO snapshot "
@@ -508,7 +536,21 @@ def cmd_serve(args) -> int:
                         debug_faults=True if args.debug_faults else None,
                         xray=True if getattr(args, "xray", False) else None,
                         whatif=True, whatif_window_ms=args.window_ms,
-                        whatif_fanout=args.fanout, scope=scope_on)
+                        whatif_fanout=args.fanout, scope=scope_on,
+                        state_dir=getattr(args, "state_dir", None),
+                        staleness_ceiling_s=getattr(
+                            args, "staleness_ceiling", None),
+                        checkpoint_every=getattr(
+                            args, "checkpoint_every", None),
+                        # serve mode bounds its queue by default: an
+                        # unbounded admission queue is the exact hazard
+                        # simonha closes (simonlint: unbounded-queue)
+                        max_queue=(args.max_queue
+                                   if getattr(args, "max_queue", None)
+                                   is not None else 256),
+                        tenant_rate=getattr(args, "tenant_rate", None),
+                        ingest_max_bytes=getattr(
+                            args, "ingest_max_bytes", None))
         if args.grpc_port:
             from ..server.grpcbridge import GrpcBridge
 
@@ -625,6 +667,10 @@ _BAD_WHEN_UP = (
     # defect by definition; evicted ledger records are observability loss
     "simon_pulse_regressions_total",
     "simon_pulse_records_dropped_total",
+    # simonha (PR 19): a wrong-epoch answer or a WAL/checkpoint lineage
+    # mismatch is a crash-consistency correctness failure
+    "simon_serve_wrong_epoch_answers_total",
+    "simon_serve_wal_parity_mismatches_total",
 )
 
 

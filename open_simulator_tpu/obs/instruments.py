@@ -187,6 +187,66 @@ SERVE_STALE_SESSIONS = counter(
     "What-if sessions detected stale (the image generation moved under "
     "them) and transparently re-encoded before dispatch.")
 
+# simonha (serve/ha.py): crash-consistent serving — ingest WAL +
+# checkpoint/restore, overload admission control, bounded-staleness
+# degraded mode. Labeled families render no samples until touched (the
+# byte-identity contract for a serve that never enables --state-dir); the
+# two tripwire counters below are deliberately UNLABELED so they always
+# render 0 and the bench gate can pin them to zero.
+
+SERVE_WAL_OPS = counter(
+    "simon_serve_wal_ops_total",
+    "Ingest write-ahead-log operations, by op: 'append' (one fsync'd "
+    "record written BEFORE the image mutates), 'replay' (one record "
+    "re-applied on restart), 'skip' (replay record at-or-below the "
+    "checkpoint seq — the idempotence path), 'truncate' (a torn tail "
+    "dropped on open), 'rotate' (the WAL reset after a compaction "
+    "checkpoint sealed its records).",
+    ("op",))
+SERVE_CHECKPOINTS = counter(
+    "simon_serve_checkpoints_total",
+    "Resident-image checkpoint operations, by op: 'write' (compaction "
+    "snapshot sealed via tmp-file + atomic rename), 'restore' (a restart "
+    "rebuilt the image from the checkpoint + WAL tail).",
+    ("op",))
+SERVE_SHEDS = counter(
+    "simon_serve_sheds_total",
+    "Requests shed by admission control before any queue/device work, by "
+    "reason: 'queue_full' (bounded admission queue at capacity), "
+    "'deadline' (remaining Deadline cannot cover the observed p95 "
+    "queue+dispatch wall), 'rate_limit' (per-tenant-route token bucket "
+    "empty), 'payload' (in-flight ingest payload byte cap). Every shed is "
+    "a structured 429/413 with Retry-After, never a downstream timeout.",
+    ("reason",))
+SERVE_BACKPRESSURE = counter(
+    "simon_serve_backpressure_total",
+    "Micro-batch window adaptations under load, by action: 'shrink' "
+    "(sustained queue growth halved the batching window), 'recover' (the "
+    "queue drained and the window grew back toward its configured width).",
+    ("action",))
+SERVE_DEGRADED = gauge(
+    "simon_serve_degraded",
+    "1 while serving in bounded-staleness degraded mode (ingest stalled, "
+    "WAL append failing, or backend quarantined mid-rebuild): answers "
+    "keep flowing against the last consistent epoch with staleness_s "
+    "stamped on each; 0 when ingest is healthy.")
+SERVE_STALENESS = gauge(
+    "simon_serve_staleness_seconds",
+    "Seconds since the last consistent ingest while degraded (0 when "
+    "healthy). Crossing the configured ceiling flips /healthz to 503.")
+SERVE_WRONG_EPOCH = counter(
+    "simon_serve_wrong_epoch_answers_total",
+    "Answers that would have been stamped with an epoch other than the "
+    "serving image's consistent epoch. Never nonzero: the HA layer fails "
+    "the request loudly instead of lying about its epoch (bench-gate "
+    "MUST_BE_ZERO pin).")
+SERVE_WAL_MISMATCHES = counter(
+    "simon_serve_wal_parity_mismatches_total",
+    "WAL/checkpoint lineage-digest mismatches or replay parity failures "
+    "detected on restore. Never nonzero: a mismatch refuses the state dir "
+    "loudly rather than serving from doubted state (bench-gate "
+    "MUST_BE_ZERO pin).")
+
 # ------------------------------------------------------------------- sweep ----
 # simonsweep (sweep/): batched scenario sweeps — Monte-Carlo what-if fleets
 # coalesced onto the scenario axis of the sweep_*_fanout kernels.

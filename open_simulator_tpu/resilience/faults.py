@@ -30,6 +30,18 @@ converge, not die:
                   so the journal's valid prefix survives — the crash-resume
                   smoke's injection point)
 
+simonha crash-consistent-serving sites (serve/ha.py) — the ingest WAL,
+checkpoint, and degraded-mode paths; like journal_write they fire BEFORE
+the durable write so the on-disk valid prefix survives the failure:
+
+  wal_write       an ingest WAL record append (before the write syscall)
+  wal_fsync       the fsync sealing an appended WAL record (the record is
+                  written but not yet durable — the torn-tail window)
+  checkpoint_write  a compaction checkpoint write (the previous checkpoint
+                  stays valid: writes go tmp-file + atomic rename)
+  ingest_stall    the ingest admission edge (models an apiserver/watch
+                  stall: serving flips to bounded-staleness degraded mode)
+
 Activation is process-global (`install_plan` / `clear_plan`): tests use the
 context manager form, the CLI wires `simon apply --fault-plan`, and the
 server exposes POST /debug/fault-plan. The no-plan fast path is a single
@@ -52,6 +64,8 @@ SITES: Tuple[str, ...] = (
     "preempt_evict",
     # simonguard containment sites (resilience/guard.py)
     "watchdog_wedge", "oom_to_device", "oom_dispatch", "journal_write",
+    # simonha crash-consistent-serving sites (serve/ha.py)
+    "wal_write", "wal_fsync", "checkpoint_write", "ingest_stall",
 )
 
 ERROR_CLASSES: Tuple[str, ...] = ("runtime", "transient", "auth", "protocol")

@@ -7,6 +7,10 @@ cross-request micro-batching dispatcher that coalesces concurrent requests
 onto the scenario axis of one serve_whatif_fanout dispatch (serve/batch.py).
 Served over HTTP/gRPC as /v1/whatif (server/http.py, server/grpcbridge.py)
 and from the `simon serve` CLI; benchmarked by tools/loadgen.py.
+
+simonha (serve/ha.py) makes it crash-consistent: a write-ahead ingest log +
+checkpoint/restore (`simon serve --state-dir`), bounded-queue admission
+control with deadline-aware shedding, and a bounded-staleness degraded mode.
 """
 
 from .image import (  # noqa: F401
@@ -14,5 +18,17 @@ from .image import (  # noqa: F401
     ResidentImage,
     StaleImageError,
     WhatIfSession,
+)
+from .ha import (  # noqa: F401
+    AdmissionController,
+    HAState,
+    IngestWAL,
+    ShedError,
+    WalMismatch,
+    WrongEpochError,
+    lineage_digest,
+    load_checkpoint,
+    restore_image,
+    save_checkpoint,
 )
 from .batch import MAX_BATCHED_PODS, WhatIfService  # noqa: F401

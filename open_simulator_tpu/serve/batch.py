@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Sequence
 from ..obs import instruments as obs
 from ..obs import scope
 from ..resilience import guard
+from .ha import AdmissionController
 from .image import ResidentImage, WhatIfSession
 
 # requests larger than this ride the fresh path: big batches want the
@@ -59,10 +60,22 @@ class WhatIfService:
     formation; handler threads only enqueue and wait."""
 
     def __init__(self, image: ResidentImage, window_ms: float = 2.0,
-                 fanout: int = 8) -> None:
+                 fanout: int = 8,
+                 admission: Optional[AdmissionController] = None) -> None:
         self.image = image
         self.window_s = max(0.0, float(window_ms)) / 1000.0
         self.fanout = max(1, int(fanout))
+        # simonha admission control (None = the historical unbounded-admit
+        # behavior; `simon serve` always wires a controller). The queue
+        # list itself stays a list — the BOUND lives in admission.admit,
+        # checked before any enqueue.
+        self.admission = admission
+        # backpressure: sustained queue growth halves the batching window
+        # (drain faster, coalesce less) down to this floor; a drained queue
+        # grows it back — see _take_batch
+        self._window_scale = 1.0
+        self._window_floor = 0.125
+        self._growth_rounds = 0
         self._queue: List[_Pending] = []
         self._cv = threading.Condition()
         self._stopped = False
@@ -72,9 +85,13 @@ class WhatIfService:
 
     # ------------------------------------------------------------- client -----
 
-    def submit(self, pods: List[dict], drains: Sequence[str] = ()) -> dict:
+    def submit(self, pods: List[dict], drains: Sequence[str] = (),
+               tenant: str = "default",
+               deadline_s: Optional[float] = None) -> dict:
         """Serve one what-if request: {"scheduled", "total", "unscheduled",
-        "utilization", "epoch", "lanes", "path"}."""
+        "utilization", "epoch", "lanes", "path"}. May raise ha.ShedError
+        BEFORE any queue/device work when admission control is wired
+        (bounded queue, per-tenant-route buckets, deadline-aware shed)."""
         if not pods:
             raise ValueError("what-if request has no pods")
         # simonlint: ignore[race-unguarded-attr] -- racy fast-fail: _submit
@@ -82,6 +99,14 @@ class WhatIfService:
         # defers the rejection to that locked check
         if self._stopped:
             raise RuntimeError("serve dispatcher is stopped")
+        if self.admission is not None:
+            # simonlint: ignore[race-unguarded-attr] -- shed BEFORE the
+            # encode: a rejected request must cost nothing downstream.
+            # len() is GIL-atomic and the queue bound tolerates one
+            # in-flight enqueue of slack, so the off-lock read only ever
+            # shifts the shed boundary by a single request
+            self.admission.admit("whatif", tenant, len(self._queue),
+                                 deadline_s)
         sc = scope.active()
         if sc is None:  # the zero-cost contract: one None-check, old path
             return self._submit(pods, drains, None)
@@ -113,6 +138,7 @@ class WhatIfService:
                 tm["gate"] = gate
             return self._fresh(pods, drains, tm)
         item = _Pending(session, tm)
+        t_enq = time.monotonic()
         with self._cv:
             # re-check UNDER the lock: a stop() racing the encode above must
             # not let this item enqueue after the dispatcher exited — nothing
@@ -124,6 +150,10 @@ class WhatIfService:
             self._queue.append(item)
             self._cv.notify_all()
         item.done.wait()
+        if self.admission is not None:
+            # the observed queue+dispatch wall the deadline shed compares
+            # remaining Deadlines against
+            self.admission.observe_wall(time.monotonic() - t_enq)
         if item.error is not None:
             raise item.error
         obs.SERVE_REQUESTS.labels(path=item.response["path"]).inc()
@@ -203,7 +233,7 @@ class WhatIfService:
                 if self._stopped:
                     return None
                 self._cv.wait()
-            deadline = time.monotonic() + self.window_s
+            deadline = time.monotonic() + self.window_s * self._window_scale
             while (len(self._queue) < self.fanout and not self._stopped):
                 left = deadline - time.monotonic()
                 if left <= 0:
@@ -211,6 +241,23 @@ class WhatIfService:
                 self._cv.wait(timeout=left)
             batch = self._queue[:self.fanout]
             del self._queue[:self.fanout]
+            # backpressure: a full fanout leaving a full fanout still
+            # waiting, twice running, means arrivals outpace dispatch —
+            # shrink the batching window (drain faster, coalesce less);
+            # recover once the queue fully drains
+            if len(self._queue) >= self.fanout:
+                self._growth_rounds += 1
+                if (self._growth_rounds >= 2
+                        and self._window_scale > self._window_floor):
+                    self._window_scale = max(self._window_floor,
+                                             self._window_scale * 0.5)
+                    self._growth_rounds = 0
+                    obs.SERVE_BACKPRESSURE.labels(action="shrink").inc()
+            else:
+                self._growth_rounds = 0
+                if not self._queue and self._window_scale < 1.0:
+                    self._window_scale = min(1.0, self._window_scale * 2.0)
+                    obs.SERVE_BACKPRESSURE.labels(action="recover").inc()
             return batch
 
     def _dispatch(self, batch: List[_Pending]) -> None:
@@ -296,6 +343,9 @@ class WhatIfService:
             "nodes": img.n_nodes,
             "drained": sorted(img.drained),
             "window_ms": self.window_s * 1000.0,
+            # simonlint: ignore[race-unguarded-attr] -- monitoring snapshot
+            "window_scale": self._window_scale,
+            "sheds": self.admission.sheds if self.admission else 0,
             "fanout": self.fanout,
             "mesh": img._mesh is not None,
             # simonlint: ignore[race-unguarded-attr] -- monitoring snapshot:

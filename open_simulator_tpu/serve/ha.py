@@ -1,0 +1,715 @@
+"""simonha: crash-consistent serving — ingest WAL + checkpoint/restore,
+overload admission control, and bounded-staleness degraded mode.
+
+The reference gets durability and resync for free from the apiserver: an
+informer relist after a crash or a 410 Gone rebuilds the watcher's world
+from server truth (PARITY.md). A first-party resident image has no such
+backing store — `simon serve` owned the only copy of its ingested deltas,
+so a SIGKILL lost them and a restart paid a full from-scratch rebuild.
+This module makes the serve process its own apiserver:
+
+- **Write-ahead ingest.** Every `/v1/ingest` delta batch is fsync'd to an
+  epoch-numbered WAL record BEFORE it mutates the image (`IngestWAL`, the
+  SearchJournal machinery from resilience/guard.py: digest header, byte-
+  offset torn-tail truncate, write→flush→fsync appends). The record carries
+  the `seq` the batch will produce, and `apply_events` bumps seq exactly
+  once per batch — even on a mid-batch failure — so replay is idempotent
+  keyed on `generation.seq`: a record at-or-below the image's seq is
+  skipped, the record at seq+1 is applied, a gap is refused loudly.
+- **Checkpoint/restore.** Periodic compaction snapshots the image's host
+  truth (live nodes — the columnar NodeStore rides whole when it is still
+  exactly the cluster — committed pods in commit order, cluster objects,
+  generation.seq) to `checkpoint.bin` via tmp-file + fsync + atomic rename,
+  then rotates the WAL: its sealed records now live in the checkpoint.
+  Restart = load checkpoint + replay the WAL tail; the PR 10 delta-ingest
+  property tests prove a from-scratch build over exactly (current_nodes,
+  cluster_pods) answers bit-identically, so the restored image is
+  bit-identical to the never-crashed process.
+- **Admission control.** A bounded queue (`max_queue`), per-tenant-route
+  token buckets, and deadline-aware shedding: a request whose remaining
+  Deadline cannot cover the observed p95 queue+dispatch wall is rejected
+  429 + Retry-After immediately instead of timing out downstream (the
+  Clipper discipline). Shed decisions consume a seeded PRNG and an
+  injectable clock, so a replayed run sheds identically.
+- **Bounded-staleness degraded mode.** When ingest stalls (WAL append
+  failing, apply failing, `ingest_stall` injected, backend quarantined),
+  serving continues against the last consistent epoch with
+  `X-Simon-Epoch` / `staleness_s` stamped on every answer; crossing the
+  configured staleness ceiling flips `/healthz` to 503. Recovery is the
+  next successful ingest (or an explicit `resync()` generation-bumping
+  rebuild) — never a wrong answer: an answer stamped with an epoch the
+  image has not reached is structurally impossible, and the
+  `simon_serve_wrong_epoch_answers_total` tripwire (bench-gate
+  MUST_BE_ZERO) fails the request loudly if it ever were.
+
+Fault sites `wal_write` / `wal_fsync` / `checkpoint_write` / `ingest_stall`
+thread through FaultPlan (resilience/faults.py) so every failure mode here
+is injectable and replay-equal, like every other stage of the engine.
+
+The checkpoint payload is a pickle of this process's own prior state read
+back from an operator-owned --state-dir (the same trust domain as the
+process itself); a sha256 over the payload bytes in the JSON header line
+detects torn or doctored files and refuses them loudly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import random
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..obs import instruments as obs
+from ..obs import scope
+from ..resilience import faults, guard
+from ..resilience.policy import deadline_remaining
+from .image import ResidentImage
+
+WAL_NAME = "ingest.wal"
+CHECKPOINT_NAME = "checkpoint.bin"
+
+
+class WalMismatch(RuntimeError):
+    """The WAL/checkpoint lineage digest does not match, a replay record's
+    seq leaves a gap, or a checkpoint payload fails its integrity hash —
+    the state dir belongs to a different (or doubted) serving lineage and
+    is refused loudly rather than replayed into wrong answers."""
+
+
+class WrongEpochError(RuntimeError):
+    """An answer was about to be stamped with an epoch AHEAD of the serving
+    image — structurally impossible unless the HA layer is broken; the
+    request fails loudly instead of lying (the MUST_BE_ZERO tripwire)."""
+
+
+class ShedError(RuntimeError):
+    """A request shed by admission control before any queue/device work.
+    `reason` is the SERVE_SHEDS label; `retry_after` seeds the HTTP 429's
+    Retry-After header (seconds, deterministic under a seeded controller)."""
+
+    def __init__(self, reason: str, retry_after: float) -> None:
+        super().__init__(f"request shed ({reason}); retry after "
+                         f"{retry_after:.3f}s")
+        self.reason = reason
+        self.retry_after = retry_after
+
+
+def lineage_digest(nodes: Sequence[dict], pods: Sequence[dict]) -> str:
+    """Content digest of the boot cluster state — the WAL/checkpoint lineage
+    id. Full canonical JSON, not just names: replaying deltas onto a
+    same-named but different-shaped cluster would be silently wrong."""
+    doc = json.dumps({"nodes": list(nodes), "pods": list(pods)},
+                     sort_keys=True, default=str)
+    return hashlib.sha256(doc.encode()).hexdigest()
+
+
+# ------------------------------------------------------------- ingest WAL ----
+
+
+class IngestWAL:
+    """Fsync'd JSONL write-ahead log of ingest delta batches.
+
+    Line 1 is a header carrying the serving lineage digest; every later line
+    is one record ``{"seq": ..., "events": [...]}`` — the seq the batch WILL
+    produce, appended write→flush→fsync BEFORE apply_events mutates the
+    image. Open follows SearchJournal's recovery contract byte for byte: a
+    torn trailing line (SIGKILL mid-write) is truncated away and the valid
+    prefix IS the log; a digest mismatch is refused untouched. The
+    `wal_write` fault site fires before the write and `wal_fsync` between
+    flush and fsync — the torn-tail window, deterministically injectable."""
+
+    KIND = "simon-ingest-wal"
+    VERSION = 1
+
+    def __init__(self, path: str, digest: str) -> None:
+        self.path = path
+        self.digest = digest
+        self.records: List[Tuple[int, list]] = []  # valid prefix, in order
+        self.truncated = False
+        self._f = None
+
+    @classmethod
+    def open(cls, path: str, digest: str) -> "IngestWAL":
+        self = cls(path, digest)
+        raw = b""
+        if os.path.exists(path) and os.path.getsize(path) > 0:
+            with open(path, "rb") as f:
+                raw = f.read()
+        if raw:
+            # BYTE offsets throughout: a torn tail can hold invalid utf-8,
+            # and a replace-decoded round trip would mis-place the truncate.
+            nl = raw.find(b"\n")
+            if nl < 0:
+                # Unterminated first line: rewrite ONLY our own torn header
+                # (a byte-prefix of the exact header THIS lineage writes);
+                # anything else is refused untouched.
+                expected = (json.dumps(
+                    {"kind": cls.KIND, "v": cls.VERSION, "digest": digest},
+                    sort_keys=True) + "\n").encode()
+                if expected.startswith(raw):
+                    self._start_fresh(path, digest)
+                    return self
+                obs.SERVE_WAL_MISMATCHES.inc()
+                raise WalMismatch(
+                    f"{path} is not an ingest WAL (unparsable header)")
+            try:
+                head = json.loads(raw[:nl])
+            except ValueError:
+                obs.SERVE_WAL_MISMATCHES.inc()
+                raise WalMismatch(
+                    f"{path} is not an ingest WAL (unparsable header)"
+                ) from None
+            if not isinstance(head, dict) or head.get("kind") != cls.KIND:
+                obs.SERVE_WAL_MISMATCHES.inc()
+                raise WalMismatch(f"{path} is not an ingest WAL")
+            if head.get("digest") != digest:
+                obs.SERVE_WAL_MISMATCHES.inc()
+                raise WalMismatch(
+                    f"WAL {path} belongs to a different serving lineage "
+                    f"(WAL digest {head.get('digest')!r} != current "
+                    f"{digest!r}); refusing to replay — delete the state "
+                    f"dir or point --state-dir elsewhere")
+            valid_bytes = pos = nl + 1
+            while True:
+                nl = raw.find(b"\n", pos)
+                if nl < 0:
+                    # an unterminated record is not durable even if it
+                    # happens to parse: neither replayed nor kept on disk
+                    break
+                body = raw[pos:nl].strip()
+                try:
+                    if body:
+                        rec = json.loads(body)
+                        self.records.append(
+                            (int(rec["seq"]), list(rec["events"])))
+                except (ValueError, KeyError, TypeError):
+                    break  # torn tail from a crash: the valid prefix ends here
+                valid_bytes = pos = nl + 1
+            self._f = open(path, "a")
+            if valid_bytes < len(raw):
+                self._f.truncate(valid_bytes)
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                self.truncated = True
+                obs.SERVE_WAL_OPS.labels(op="truncate").inc()
+        else:
+            self._start_fresh(path, digest)
+        return self
+
+    def _start_fresh(self, path: str, digest: str) -> None:
+        self._f = open(path, "w")
+        self._append({"kind": self.KIND, "v": self.VERSION, "digest": digest})
+
+    def _append(self, doc: dict) -> None:
+        self._f.write(json.dumps(doc, sort_keys=True) + "\n")
+        self._f.flush()
+        faults.maybe_fail("wal_fsync")
+        os.fsync(self._f.fileno())
+
+    def append(self, seq: int, events: Sequence[dict]) -> None:
+        """One fsync'd record, BEFORE the image mutates. A failure here
+        (injected or real) leaves the on-disk valid prefix intact and the
+        image untouched — the caller degrades, never half-applies."""
+        faults.maybe_fail("wal_write")
+        if self._f is None:
+            self._f = open(self.path, "a")
+        self._append({"seq": int(seq), "events": list(events)})
+        self.records.append((int(seq), list(events)))
+        obs.SERVE_WAL_OPS.labels(op="append").inc()
+
+    def rotate(self) -> None:
+        """Reset to header-only after a checkpoint sealed every record at
+        or below its seq. Crash between the checkpoint rename and this
+        rotate is safe: the stale records replay as seq <= image.seq skips."""
+        if self._f is not None:
+            self._f.close()
+        self._start_fresh(self.path, self.digest)
+        self.records = []
+        obs.SERVE_WAL_OPS.labels(op="rotate").inc()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+# ------------------------------------------------------------- checkpoint ----
+
+
+def save_checkpoint(path: str, image: ResidentImage, digest: str) -> dict:
+    """Snapshot the image's host truth to `path` (tmp + fsync + atomic
+    rename — the previous checkpoint stays valid until the rename lands).
+    Returns the captured header. The `checkpoint_write` fault site fires
+    before any byte is written."""
+    from ..core.types import ResourceTypes
+
+    faults.maybe_fail("checkpoint_write")
+    with image._lock:
+        model = image._sim.model
+        rt = ResourceTypes(
+            services=list(model.services),
+            replication_controllers=list(model.replication_controllers),
+            replica_sets=list(model.replica_sets),
+            stateful_sets=list(model.stateful_sets),
+            storage_classes=list(model.storage_classes),
+            config_maps=list(model.config_maps),
+            pod_disruption_budgets=list(model.pdbs),
+            persistent_volume_claims=list(model.pvcs),
+        )
+        # the columnar fast path: when the store still IS the live cluster
+        # (no delta node-adds, no drains), it rides whole — template blocks,
+        # not N dicts — and restore hands the engine its columns back
+        # instead of re-parsing N node dicts. Materializing the dict list
+        # alongside it would make both the write and the restore pay the
+        # per-node cost anyway, so the dict form is saved ONLY as the slow-
+        # path fallback — the restart-to-ready ≥5x the bench gate pins.
+        lazy = image._sim.na.nodes
+        fast = (getattr(lazy, "store", None) is not None
+                and not lazy._extra and not image.drained)
+        state = {
+            "nodes": None if fast else image.current_nodes(),
+            "pods": image.cluster_pods(),
+            "objects": rt,
+            "sched_config": image._sim.sched_config,
+            "generation": image.generation,
+            "seq": image.seq,
+        }
+        if fast:
+            state["store"] = lazy.store
+    payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+    head = {"kind": "simon-image-checkpoint", "v": 1, "digest": digest,
+            "sha256": hashlib.sha256(payload).hexdigest(),
+            "n_bytes": len(payload),
+            "generation": state["generation"], "seq": state["seq"]}
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write((json.dumps(head, sort_keys=True) + "\n").encode())
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    obs.SERVE_CHECKPOINTS.labels(op="write").inc()
+    return head
+
+
+def load_checkpoint(path: str) -> Tuple[dict, dict]:
+    """(header, state) — refuses loudly (WalMismatch + the parity-mismatch
+    counter) on a torn, truncated, or doctored file: serving from doubted
+    state is strictly worse than a from-scratch rebuild."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    nl = raw.find(b"\n")
+    bad = None
+    head = None
+    if nl < 0:
+        bad = "no header line"
+    else:
+        try:
+            head = json.loads(raw[:nl])
+        except ValueError:
+            bad = "unparsable header"
+    if bad is None and (not isinstance(head, dict)
+                        or head.get("kind") != "simon-image-checkpoint"):
+        bad = "not an image checkpoint"
+    if bad is None:
+        payload = raw[nl + 1:]
+        if len(payload) != head.get("n_bytes"):
+            bad = (f"payload is {len(payload)} bytes, header says "
+                   f"{head.get('n_bytes')}")
+        elif hashlib.sha256(payload).hexdigest() != head.get("sha256"):
+            bad = "payload sha256 mismatch"
+    if bad is not None:
+        obs.SERVE_WAL_MISMATCHES.inc()
+        raise WalMismatch(f"checkpoint {path} refused: {bad}")
+    return head, pickle.loads(payload)
+
+
+def restore_image(state: dict, mesh=None) -> ResidentImage:
+    """Rebuild a ResidentImage from a checkpoint state dict, restoring its
+    generation.seq so replayed WAL records key onto the same epochs the
+    crashed process stamped."""
+    store = state.get("store")
+    nodes = store if store is not None else state["nodes"]
+    image = ResidentImage.try_build(
+        nodes, cluster_objects=state["objects"], pods=state["pods"],
+        sched_config=state["sched_config"], mesh=mesh)
+    if image is None:
+        raise WalMismatch(
+            "checkpoint restore declined by the image equivalence gates "
+            "(backend quarantined at boot, or the checkpointed cluster "
+            "grew state the resident path cannot serve)")
+    with image._lock:
+        image.generation = state["generation"]
+        image.seq = state["seq"]
+    obs.SERVE_CHECKPOINTS.labels(op="restore").inc()
+    return image
+
+
+# ------------------------------------------------------ admission control ----
+
+
+class _TokenBucket:
+    """One (tenant, route) bucket: `rate` tokens/s refill up to `burst`,
+    advanced by the controller's injectable clock — pure state, no wall
+    reads of its own, so a replayed request sequence drains identically."""
+
+    __slots__ = ("rate", "burst", "tokens", "t")
+
+    def __init__(self, rate: float, burst: float, now: float) -> None:
+        self.rate = rate
+        self.burst = burst
+        self.tokens = burst
+        self.t = now
+
+    def try_take(self, now: float) -> bool:
+        self.tokens = min(self.burst,
+                          self.tokens + max(0.0, now - self.t) * self.rate)
+        self.t = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+    def refill_wait(self) -> float:
+        return (1.0 - self.tokens) / self.rate if self.rate > 0 else 1.0
+
+
+class AdmissionController:
+    """Shed-before-queue admission: bounded queue, per-tenant-route token
+    buckets, and deadline-aware rejection against the observed p95
+    queue+dispatch wall. Every decision reads the injectable `clock` and a
+    seeded PRNG (the Retry-After jitter), so a replayed request sequence
+    sheds identically — the determinism contract tests/test_ha.py asserts."""
+
+    def __init__(self, max_queue: int = 256, tenant_rate: float = 0.0,
+                 tenant_burst: float = 8.0, seed: int = 0,
+                 clock: Callable[[], float] = time.monotonic,
+                 max_tenants: int = 1024) -> None:
+        self.max_queue = max(1, int(max_queue))
+        self.tenant_rate = float(tenant_rate)
+        self.tenant_burst = max(1.0, float(tenant_burst))
+        self.clock = clock
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._walls: deque = deque(maxlen=128)  # recent queue+dispatch walls
+        # LRU-bounded: an open tenant header must not grow memory without
+        # bound (the exact hazard this layer exists to close)
+        self._buckets: "OrderedDict[Tuple[str, str], _TokenBucket]" = \
+            OrderedDict()
+        self._max_tenants = max(1, int(max_tenants))
+        self.sheds = 0
+
+    # ------------------------------------------------------------ observe ----
+
+    def observe_wall(self, seconds: float) -> None:
+        with self._lock:
+            self._walls.append(float(seconds))
+
+    def p95(self) -> float:
+        """p95 of the recent queue+dispatch walls; 0.0 before any sample
+        (a cold controller never deadline-sheds — it has no evidence)."""
+        with self._lock:
+            if not self._walls:
+                return 0.0
+            ordered = sorted(self._walls)
+            return ordered[min(len(ordered) - 1,
+                               int(0.95 * len(ordered)))]
+
+    # -------------------------------------------------------------- admit ----
+
+    def admit(self, route: str, tenant: str, queued: int,
+              deadline_s: Optional[float] = None) -> None:
+        """Admit or raise ShedError. Checked in hazard order: queue bound
+        (protects this process), token bucket (protects fairness), deadline
+        (protects the client from a doomed wait)."""
+        p95 = self.p95()
+        if queued >= self.max_queue:
+            self._shed("queue_full", max(0.05, p95))
+        if self.tenant_rate > 0:
+            with self._lock:
+                key = (str(tenant), str(route))
+                bucket = self._buckets.get(key)
+                if bucket is None:
+                    bucket = _TokenBucket(self.tenant_rate,
+                                          self.tenant_burst, self.clock())
+                    self._buckets[key] = bucket
+                    while len(self._buckets) > self._max_tenants:
+                        self._buckets.popitem(last=False)
+                else:
+                    self._buckets.move_to_end(key)
+                ok = bucket.try_take(self.clock())
+            if not ok:
+                self._shed("rate_limit", bucket.refill_wait())
+        remaining = deadline_s
+        if remaining is None:
+            remaining = deadline_remaining(self.clock)
+        if remaining is not None and p95 > 0.0 and remaining < p95:
+            self._shed("deadline", max(0.05, p95 - max(0.0, remaining)))
+
+    def _shed(self, reason: str, retry_after: float) -> None:
+        # seeded jitter de-synchronizes retry herds; deterministic because
+        # the PRNG is seeded and decisions are made in request order
+        retry_after *= 1.0 + 0.25 * self._rng.random()
+        with self._lock:
+            self.sheds += 1
+        obs.SERVE_SHEDS.labels(reason=reason).inc()
+        raise ShedError(reason, retry_after)
+
+
+# ------------------------------------------------------------ HA coordinator --
+
+
+class HAState:
+    """The crash-consistency coordinator: WAL-ahead ingest, periodic
+    compaction checkpoints, restore-or-build boot, and the bounded-staleness
+    degraded-mode contract. One instance owns one --state-dir."""
+
+    def __init__(self, state_dir: str, image: ResidentImage, wal: IngestWAL,
+                 digest: str, checkpoint_every: int = 64,
+                 staleness_ceiling_s: float = 120.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.state_dir = state_dir
+        self.image = image
+        self.wal = wal
+        self.digest = digest
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        self.staleness_ceiling_s = float(staleness_ceiling_s)
+        self.clock = clock
+        # reentrant: ingest holds it across its own compaction call, and a
+        # background checkpoint() takes it fresh — either way WAL append
+        # order == apply order == capture order
+        self._mu = threading.RLock()
+        self._degraded: Optional[str] = None
+        self._last_ok = clock()
+        self._consistent_epoch = image.epoch
+        self.replayed = 0
+        self.skipped = 0
+
+    # --------------------------------------------------------------- boot ----
+
+    @classmethod
+    def open(cls, state_dir: str,
+             build_image: Callable[[], Optional[ResidentImage]],
+             checkpoint_every: int = 64, staleness_ceiling_s: float = 120.0,
+             mesh=None,
+             clock: Callable[[], float] = time.monotonic
+             ) -> Optional["HAState"]:
+        """Restore-or-build: load the checkpoint if one exists (its digest
+        names the lineage), else build from live truth and mint the lineage
+        digest from the boot state — a crashed-before-first-checkpoint WAL
+        written from the same boot state carries the same digest and
+        replays; any other WAL is refused. Returns None when the image
+        build itself declines (serve then runs fresh-path only, exactly as
+        without --state-dir)."""
+        os.makedirs(state_dir, exist_ok=True)
+        ckpt_path = os.path.join(state_dir, CHECKPOINT_NAME)
+        if os.path.exists(ckpt_path):
+            head, state = load_checkpoint(ckpt_path)
+            image = restore_image(state, mesh=mesh)
+            digest = head["digest"]
+        else:
+            image = build_image()
+            if image is None:
+                return None
+            with image._lock:
+                digest = lineage_digest(image.current_nodes(),
+                                        image.cluster_pods())
+        wal = IngestWAL.open(os.path.join(state_dir, WAL_NAME), digest)
+        self = cls(state_dir, image, wal, digest,
+                   checkpoint_every=checkpoint_every,
+                   staleness_ceiling_s=staleness_ceiling_s, clock=clock)
+        self._replay()
+        return self
+
+    def _replay(self) -> None:
+        """Apply the WAL tail: records at-or-below the image's seq are the
+        checkpoint's (or a duplicate's) — skipped; the record at seq+1
+        applies; a gap means lost records and is refused loudly."""
+        with self._mu:
+            for seq, events in self.wal.records:
+                if seq <= self.image.seq:
+                    self.skipped += 1
+                    obs.SERVE_WAL_OPS.labels(op="skip").inc()
+                    continue
+                if seq != self.image.seq + 1:
+                    obs.SERVE_WAL_MISMATCHES.inc()
+                    raise WalMismatch(
+                        f"WAL replay gap: next record seq {seq}, image at "
+                        f"{self.image.epoch} — records are missing; "
+                        f"refusing to serve from doubted state")
+                self.image.apply_events(events)
+                self.replayed += 1
+                obs.SERVE_WAL_OPS.labels(op="replay").inc()
+            self._consistent_epoch = self.image.epoch
+
+    # ------------------------------------------------------------- ingest ----
+
+    def ingest(self, events: Sequence[dict]) -> dict:
+        """WAL-ahead apply: fsync the record, then mutate the image. Any
+        failure flips degraded mode; the image is never left half-applied
+        (apply_events' own exception path rebuilds to consistency, and the
+        follow-up checkpoint seals that truth so a later crash cannot
+        replay the batch onto it twice)."""
+        with self._mu:
+            sc = scope.active()
+            try:
+                faults.maybe_fail("ingest_stall")
+            except BaseException:
+                self._enter_degraded("ingest_stall")
+                raise
+            seq = self.image.seq + 1
+            try:
+                if sc is not None:
+                    with sc.span("ha_wal_append", cat="serve", seq=seq):
+                        self.wal.append(seq, events)
+                else:
+                    self.wal.append(seq, events)
+            except BaseException:
+                self._enter_degraded("wal")
+                raise
+            try:
+                resp = self.image.apply_events(events)
+            except BaseException:
+                # seq bumped, image rebuilt to consistency: seal that truth
+                # so the WAL record (whose events only partially landed)
+                # can never replay on top of it
+                self._enter_degraded("ingest")
+                try:
+                    self.checkpoint()
+                except BaseException:
+                    # already degraded; count it, the ceiling flips healthz
+                    obs.SERVE_CHECKPOINTS.labels(op="error").inc()
+                raise
+            self._mark_healthy()
+            if len(self.wal.records) >= self.checkpoint_every:
+                try:
+                    self.checkpoint()
+                except BaseException:
+                    # the batch IS durable (WAL) and applied — failing the
+                    # request here would make the client retry a landed
+                    # delta as a NEW seq (double-apply). Report success,
+                    # count the failure, flip degraded: the staleness
+                    # ceiling bounds how long compaction may keep failing
+                    # before /healthz says so.
+                    obs.SERVE_CHECKPOINTS.labels(op="error").inc()
+                    self._enter_degraded("checkpoint")
+            return resp
+
+    def checkpoint(self) -> None:
+        """One compaction: snapshot the image, rotate the WAL. Callable from
+        a background thread — takes the same locks in the same order as
+        ingest, so a checkpoint racing a concurrent ingest serializes and
+        can never capture a half-applied image (tests/test_ha.py races
+        them)."""
+        sc = scope.active()
+        path = os.path.join(self.state_dir, CHECKPOINT_NAME)
+        with self._mu:
+            if sc is not None:
+                with sc.span("ha_checkpoint", cat="serve"):
+                    save_checkpoint(path, self.image, self.digest)
+            else:
+                save_checkpoint(path, self.image, self.digest)
+            self.wal.rotate()
+
+    def resync(self) -> None:
+        """Explicit recovery: generation-bumping rebuild from current host
+        truth (the image's own consistency escape hatch), then mark
+        healthy. For operators whose ingest source came back after a long
+        degraded stretch."""
+        with self._mu:
+            with self.image._lock:
+                self.image._rebuild()
+            self._mark_healthy()
+
+    # ----------------------------------------------------- degraded mode -----
+
+    def _enter_degraded(self, reason: str) -> None:
+        with self._mu:
+            if self._degraded is None:
+                self._degraded = reason
+                obs.SERVE_DEGRADED.set(1.0)
+
+    def _mark_healthy(self) -> None:
+        # reentrant _mu: ingest/resync already hold it; the quarantine-clear
+        # path (degraded_reason via a healthz probe) takes it fresh here
+        with self._mu:
+            self._degraded = None
+            self._last_ok = self.clock()
+            self._consistent_epoch = self.image.epoch
+            obs.SERVE_DEGRADED.set(0.0)
+            obs.SERVE_STALENESS.set(0.0)
+
+    def degraded_reason(self) -> Optional[str]:
+        """Current reason, folding in live backend quarantine (the image is
+        stranded mid-rebuild: serving continues on the fresh/CPU path at
+        the last consistent epoch)."""
+        with self._mu:
+            if self._degraded is None and guard.default_quarantined():
+                self._enter_degraded("quarantine")
+            elif (self._degraded == "quarantine"
+                    and not guard.default_quarantined()):
+                self._mark_healthy()
+            return self._degraded
+
+    def staleness_s(self) -> float:
+        """Seconds serving at the last consistent epoch; 0.0 while healthy."""
+        with self._mu:
+            if self.degraded_reason() is None:
+                return 0.0
+            s = max(0.0, self.clock() - self._last_ok)
+            obs.SERVE_STALENESS.set(s)
+            return s
+
+    def healthy(self) -> bool:
+        """False once degraded staleness crosses the hard ceiling — the
+        /healthz 503 flip: bounded staleness, not unbounded lying."""
+        return self.staleness_s() <= self.staleness_ceiling_s
+
+    def stamp(self, resp: dict) -> Dict[str, str]:
+        """Stamp one answer with the staleness contract; returns the extra
+        response headers. An epoch AHEAD of the image is impossible —
+        counted (the MUST_BE_ZERO tripwire) and failed loudly rather than
+        returned."""
+        epoch = resp.get("epoch")
+        if epoch is not None and self._epoch_ahead(str(epoch)):
+            obs.SERVE_WRONG_EPOCH.inc()
+            raise WrongEpochError(
+                f"answer stamped epoch {epoch} but the image is at "
+                f"{self.image.epoch}")
+        resp["staleness_s"] = round(self.staleness_s(), 6)
+        return {"X-Simon-Epoch": str(epoch if epoch is not None
+                                     else self.image.epoch)}
+
+    def _epoch_ahead(self, epoch: str) -> bool:
+        try:
+            gen, _, seq = epoch.partition(".")
+            gen_i, seq_i = int(gen), int(seq)
+        except ValueError:
+            return True  # unparsable stamp: fail loudly, never guess
+        img_gen, img_seq = self.image.generation, self.image.seq
+        return gen_i > img_gen or (gen_i == img_gen and seq_i > img_seq)
+
+    # -------------------------------------------------------------- stats ----
+
+    def stats(self) -> Dict[str, object]:
+        with self._mu:
+            return {
+                "epoch": self.image.epoch,
+                "consistent_epoch": self._consistent_epoch,
+                "degraded": self.degraded_reason(),
+                "staleness_s": round(self.staleness_s(), 6),
+                "staleness_ceiling_s": self.staleness_ceiling_s,
+                "wal_records": len(self.wal.records),
+                "replayed": self.replayed,
+                "skipped": self.skipped,
+                "state_dir": self.state_dir,
+            }
+
+    def close(self) -> None:
+        with self._mu:
+            self.wal.close()

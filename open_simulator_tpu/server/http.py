@@ -163,6 +163,13 @@ class Server:
         whatif_window_ms: Optional[float] = None,
         whatif_fanout: Optional[int] = None,
         scope: Optional[bool] = None,
+        state_dir: Optional[str] = None,
+        staleness_ceiling_s: Optional[float] = None,
+        checkpoint_every: Optional[int] = None,
+        max_queue: Optional[int] = None,
+        tenant_rate: Optional[float] = None,
+        ingest_max_bytes: Optional[int] = None,
+        shed_seed: int = 0,
     ) -> None:
         # /debug/fault-plan is a process-global WRITE endpoint (testing/CI):
         # never enabled by default on a production server. Opt in explicitly
@@ -228,6 +235,34 @@ class Server:
         from ..obs import pulse as pulse_mod
 
         pulse_mod.maybe_enable_from_env()
+        # simonha (serve/ha.py): crash-consistent serving. --state-dir turns
+        # on the ingest WAL + checkpoint/restore; the admission knobs guard
+        # the micro-batch queue whether or not state is durable. All off by
+        # default so a plain Server() behaves exactly as before.
+        if state_dir is None:
+            state_dir = os.environ.get("OPEN_SIMULATOR_STATE_DIR") or None
+        self.state_dir = state_dir
+        self.staleness_ceiling_s = (
+            staleness_ceiling_s if staleness_ceiling_s is not None
+            else float(os.environ.get(
+                "OPEN_SIMULATOR_STALENESS_CEILING_S", "120")))
+        self.checkpoint_every = (
+            checkpoint_every if checkpoint_every is not None
+            else int(os.environ.get("OPEN_SIMULATOR_CHECKPOINT_EVERY", "64")))
+        env_q = os.environ.get("OPEN_SIMULATOR_MAX_QUEUE", "")
+        self.max_queue = (max_queue if max_queue is not None
+                          else (int(env_q) if env_q else None))
+        self.tenant_rate = (
+            tenant_rate if tenant_rate is not None
+            else float(os.environ.get("OPEN_SIMULATOR_TENANT_RPS", "0")))
+        self.ingest_max_bytes = (
+            ingest_max_bytes if ingest_max_bytes is not None
+            else int(os.environ.get("OPEN_SIMULATOR_INGEST_MAX_BYTES",
+                                    str(8 << 20))))
+        self.shed_seed = shed_seed
+        self._ha = None
+        self._ingest_bytes = 0  # in-flight /v1/ingest payload bytes
+        self._ingest_bytes_lock = threading.Lock()
         self._whatif_svc = None
         self._whatif_declined = False
         self._whatif_lock = threading.Lock()
@@ -335,22 +370,48 @@ class Server:
             return None
         with self._whatif_lock:
             if self._whatif_svc is None and not self._whatif_declined:
-                from ..serve import ResidentImage, WhatIfService
+                from ..serve import (AdmissionController, HAState,
+                                     ResidentImage, WhatIfService)
 
-                snap = self.snapshot_fn()
-                image = ResidentImage.try_build(
-                    snap.resource.nodes,
-                    cluster_objects=snap.resource,
-                    pods=list(snap.resource.pods) + list(snap.pending_pods))
+                def build_image():
+                    snap = self.snapshot_fn()
+                    return ResidentImage.try_build(
+                        snap.resource.nodes,
+                        cluster_objects=snap.resource,
+                        pods=list(snap.resource.pods)
+                        + list(snap.pending_pods))
+
+                if self.state_dir:
+                    # simonha restore-or-build: load the checkpoint + replay
+                    # the WAL tail when state exists; a lineage mismatch
+                    # raises out of the first request loudly (500) rather
+                    # than serving from doubted state
+                    ha = HAState.open(
+                        self.state_dir, build_image,
+                        checkpoint_every=self.checkpoint_every,
+                        staleness_ceiling_s=self.staleness_ceiling_s)
+                    if ha is None:
+                        self._whatif_declined = True
+                        return None
+                    self._ha = ha
+                    image = ha.image
+                else:
+                    image = build_image()
                 if image is None:
                     # cache the decline: try_build walks the whole cluster,
                     # and repeating that per request would turn the cheap
                     # 501 path into a serialized full re-encode per request
                     self._whatif_declined = True
                     return None
+                admission = None
+                if self.max_queue is not None:
+                    admission = AdmissionController(
+                        max_queue=self.max_queue,
+                        tenant_rate=self.tenant_rate,
+                        seed=self.shed_seed)
                 self._whatif_svc = WhatIfService(
                     image, window_ms=self.whatif_window_ms,
-                    fanout=self.whatif_fanout)
+                    fanout=self.whatif_fanout, admission=admission)
             return self._whatif_svc
 
     def handle_whatif(self, req: dict) -> Tuple[int, object]:
@@ -362,12 +423,16 @@ class Server:
         mutating the shared image. Response: scheduled/total/unscheduled
         counts, cluster utilization, the image epoch the answer is consistent
         at, the micro-batch lane width, and the route taken
-        (batched | fresh)."""
+        (batched | fresh). With admission control on, a shed request gets a
+        structured 429 carrying `retry_after_s`; with --state-dir, answers
+        carry `staleness_s` (and the HTTP layer adds X-Simon-Epoch)."""
         if not self.whatif:
             count_http_error("whatif", 404)
             return 404, error_body(
                 404, "resident what-if serving is off (start with "
                 "`simon serve` / OPEN_SIMULATOR_WHATIF=1)")
+        from ..serve.ha import ShedError
+
         try:
             svc = self.whatif_service()
             if svc is None:
@@ -390,7 +455,26 @@ class Server:
                 count_http_error("whatif", 400)
                 return 400, error_body(400, "what-if request has no pods")
             drains = [str(d) for d in (req.get("drains") or [])]
-            return 200, svc.submit(pods, drains)
+            deadline_s = req.get("deadline_s")
+            resp = svc.submit(
+                pods, drains, tenant=str(req.get("tenant") or "default"),
+                deadline_s=float(deadline_s) if deadline_s is not None
+                else None)
+            if self._ha is not None:
+                # mutates resp: staleness_s stamp + the wrong-epoch tripwire.
+                # simonlint: ignore[race-unguarded-attr] -- _ha is written
+                # exactly once, under _whatif_lock, BEFORE _whatif_svc is
+                # published; this runs only after whatif_service() returned
+                # non-None through that same lock, so the write
+                # happens-before this read
+                self._ha.stamp(resp)
+            return 200, resp
+        except ShedError as e:
+            count_http_error("whatif", 429)
+            body = error_body(429, str(e))
+            body["reason"] = e.reason
+            body["retry_after_s"] = round(e.retry_after, 3)
+            return 429, body
         except Exception as e:
             count_http_error("whatif", 500)
             return 500, error_body(500, str(e))
@@ -414,10 +498,44 @@ class Server:
             if not isinstance(events, list):
                 count_http_error("ingest", 400)
                 return 400, error_body(400, "'events' must be a list")
+            if self._ha is not None:
+                # WAL-ahead path: fsync'd record, then apply; any failure
+                # (WalMismatch, injected fault) flips degraded mode and
+                # surfaces as a structured 500 below.
+                # simonlint: ignore[race-unguarded-attr] -- _ha is written
+                # once, under _whatif_lock, before _whatif_svc is published;
+                # this runs only after whatif_service() returned non-None
+                # through that same lock, so the write happens-before it
+                return 200, self._ha.ingest(events)
             return 200, svc.image.apply_events(events)
         except Exception as e:
             count_http_error("ingest", 500)
             return 500, error_body(500, str(e))
+
+    def _shed_ingest_payload(self, length: int):
+        """Bound /v1/ingest memory: over the per-request cap → 413; over the
+        in-flight budget (4x the cap, summed across concurrent requests) →
+        429. Returns (code, body) to shed, or None to admit — the caller
+        must pair an admit with _release_ingest_bytes(length)."""
+        if length > self.ingest_max_bytes:
+            obs.SERVE_SHEDS.labels(reason="payload").inc()
+            return 413, error_body(
+                413, f"ingest payload of {length} bytes exceeds the "
+                f"{self.ingest_max_bytes}-byte cap "
+                f"(OPEN_SIMULATOR_INGEST_MAX_BYTES)")
+        with self._ingest_bytes_lock:
+            admitted = self._ingest_bytes + length <= 4 * self.ingest_max_bytes
+            if admitted:
+                self._ingest_bytes += length
+        if not admitted:
+            obs.SERVE_SHEDS.labels(reason="payload").inc()
+            return 429, error_body(
+                429, "too many ingest payload bytes in flight; retry")
+        return None
+
+    def _release_ingest_bytes(self, length: int) -> None:
+        with self._ingest_bytes_lock:
+            self._ingest_bytes -= length
 
     # --------------------------------------------------------------- serving ------
 
@@ -492,8 +610,13 @@ class Server:
         # stopped too instead of orphaned
         with self._whatif_lock:
             svc = self._whatif_svc
+            ha = self._ha
         if svc is not None:
             svc.stop()  # wake the micro-batch dispatcher; queued requests fail fast
+        if ha is not None:
+            # in-flight requests finished (or were counted stranded) above;
+            # close the WAL handle so the valid prefix is the final word
+            ha.close()
         if self._scope_owned:
             # join the telemetry sampler and drop the trace buffer: the
             # scope this server created must not outlive it (a later
@@ -515,12 +638,15 @@ class Server:
             def log_message(self, fmt, *args):  # quiet by default
                 pass
 
-            def _send(self, code: int, body: object) -> None:
+            def _send(self, code: int, body: object,
+                      headers: Optional[dict] = None) -> None:
                 data = json.dumps(body).encode()
                 self._last_code = code
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for key, value in (headers or {}).items():
+                    self.send_header(key, value)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -587,7 +713,20 @@ class Server:
 
             def _get_routes(self):
                 if self.path == "/healthz":
-                    self._send(200, {"message": "ok"})
+                    # simonha staleness ceiling: a degraded server keeps
+                    # answering at the last consistent epoch, but past the
+                    # ceiling it stops claiming health — the orchestrator's
+                    # cue to restart/resync it
+                    ha = server._ha
+                    if ha is not None and not ha.healthy():
+                        self._send(503, {
+                            "message": "degraded past the staleness ceiling",
+                            "reason": ha.degraded_reason(),
+                            "staleness_s": round(ha.staleness_s(), 3),
+                            "staleness_ceiling_s": ha.staleness_ceiling_s,
+                        })
+                    else:
+                        self._send(200, {"message": "ok"})
                 elif self.path == "/metrics" or self.path.startswith("/metrics?"):
                     # Prometheus scrape surface (the reference mounts
                     # kube-scheduler's metrics handler; server.go:152) —
@@ -713,6 +852,8 @@ class Server:
                             "yet built (POST /v1/whatif first)", "serve-stats")
                         return
                     stats = svc.stats()
+                    if server._ha is not None:
+                        stats["ha"] = server._ha.stats()
                     sc = scope_mod.active() if server.scope else None
                     if sc is not None:
                         from ..obs import instruments as obs_i
@@ -762,6 +903,28 @@ class Server:
 
             def _post_routes(self):
                 length = int(self.headers.get("Content-Length") or 0)
+                if self.path == "/v1/ingest":
+                    # satellite: bound /v1/ingest memory BEFORE reading the
+                    # body — an oversized or budget-busting payload is shed
+                    # unread, and the connection drops (the request stream
+                    # would otherwise desync on the unconsumed body)
+                    shed = server._shed_ingest_payload(length)
+                    if shed is not None:
+                        code, body = shed
+                        count_http_error("ingest", code)
+                        self.close_connection = True
+                        self._send(code, body,
+                                   {"Retry-After": "1"} if code == 429
+                                   else None)
+                        return
+                    try:
+                        self._dispatch_post(length)
+                    finally:
+                        server._release_ingest_bytes(length)
+                    return
+                self._dispatch_post(length)
+
+            def _dispatch_post(self, length: int) -> None:
                 raw = self.rfile.read(length)
                 try:
                     req = json.loads(raw or b"{}")
@@ -788,7 +951,17 @@ class Server:
                 else:
                     self._send_err(404, "not found", "other")
                     return
-                self._send(code, body)
+                # handlers stay 2-tuple (the gRPC bridge and embedders unpack
+                # them); HTTP-only headers derive from the body here
+                headers = None
+                if isinstance(body, dict):
+                    if code == 429 and "retry_after_s" in body:
+                        headers = {"Retry-After": str(max(
+                            1, int(body["retry_after_s"] + 0.999)))}
+                    elif (server._ha is not None and code == 200
+                          and "epoch" in body):
+                        headers = {"X-Simon-Epoch": str(body["epoch"])}
+                self._send(code, body, headers)
 
         class Httpd(ThreadingHTTPServer):
             # the socketserver default backlog of 5 resets connections under

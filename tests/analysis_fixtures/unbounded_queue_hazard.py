@@ -1,0 +1,35 @@
+"""Fixture for the unbounded-queue rule: every stdlib spelling of an
+unbounded producer/consumer channel must fire (Queue with no maxsize, an
+explicit maxsize=0, SimpleQueue, bare deque); the waived half is a
+deliberately unbounded channel with its bounded-elsewhere argument; the
+clean half passes real bounds every way the ctors accept them."""
+
+import collections
+import queue
+from collections import deque
+from queue import Queue
+
+# --------------------------------------------------------------- findings ----
+
+work = queue.Queue()  # no maxsize: unbounded backlog
+undo = queue.LifoQueue()
+ranked = queue.PriorityQueue(maxsize=0)  # explicit 0 IS unbounded
+fast = queue.SimpleQueue()  # unboundable by construction
+events = Queue()  # bare-name import, same hazard
+ring = deque()
+tail = collections.deque([1, 2, 3])
+
+# ------------------------------------------------------------------ waived ----
+
+# simonlint: ignore[unbounded-queue] -- depth bounded by the admission
+# controller upstream: at most max_queue items are ever enqueued
+overflow = queue.Queue()
+
+# -------------------------------------------------------------------- clean ----
+
+bounded = queue.Queue(maxsize=128)
+bounded_pos = queue.Queue(64)
+bounded_lifo = queue.LifoQueue(maxsize=8)
+recent = deque(maxlen=32)
+recent_kw = collections.deque([1, 2], maxlen=2)
+recent_pos = deque([1, 2], 2)  # second positional IS the maxlen

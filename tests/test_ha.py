@@ -1,0 +1,485 @@
+"""simonha: crash-consistent serving (serve/ha.py).
+
+The contract under test (README "High availability", ISSUE PR 19):
+
+- **Crash-restart bit-identity.** `--state-dir` restart (checkpoint + WAL
+  tail replay) produces an image bit-identical to the never-crashed process
+  — same epoch, same host truth, same what-if answers — across the seeded
+  churn traces the PR 10 delta-ingest property tests already pin.
+- **WAL recovery.** A torn tail (SIGKILL mid-write) truncates to the valid
+  prefix; duplicate records replay idempotently (seq <= image.seq skips); a
+  seq gap or a lineage-digest mismatch is refused loudly (WalMismatch), and
+  a doctored checkpoint never loads.
+- **Admission determinism.** Seeded controller + injectable clock: the same
+  request sequence sheds identically, with the same jittered Retry-After.
+- **Bounded staleness.** Degraded mode serves the last consistent epoch,
+  stamps staleness, flips /healthz at the ceiling, and recovers via the
+  next good ingest or an explicit generation-bumping resync — never a
+  wrong answer (the wrong-epoch tripwire).
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu.obs import REGISTRY
+from open_simulator_tpu.resilience import FaultPlan, installed
+from open_simulator_tpu.serve import (
+    AdmissionController,
+    HAState,
+    IngestWAL,
+    ResidentImage,
+    ShedError,
+    WalMismatch,
+    WhatIfService,
+    WrongEpochError,
+    lineage_digest,
+    load_checkpoint,
+    save_checkpoint,
+)
+from open_simulator_tpu.serve.ha import CHECKPOINT_NAME, WAL_NAME
+
+from fixtures import make_pod
+from test_serve import (
+    _trace_events,
+    assert_same_response,
+    make_cluster,
+    whatif_pods,
+)
+
+
+def _builder(n_nodes=8, n_bound=5):
+    """A build_image closure over a fixed boot cluster (fresh copies per
+    call, like the server's snapshot_fn path)."""
+    nodes, bound = make_cluster(n_nodes, n_bound)
+
+    def build():
+        return ResidentImage.try_build(
+            [json.loads(json.dumps(n)) for n in nodes],
+            pods=[json.loads(json.dumps(p)) for p in bound])
+
+    return build, nodes
+
+
+def _host_truth(image):
+    return json.dumps({"nodes": image.current_nodes(),
+                       "pods": image.cluster_pods()},
+                      sort_keys=True, default=str)
+
+
+# ------------------------------------------------- crash-restart identity ----
+
+
+@pytest.mark.parametrize("seed", [7, 23, 101])
+def test_crash_restart_bit_identity(seed, tmp_path):
+    """The acceptance oracle: apply a seeded churn trace twice — once
+    uninterrupted, once 'crashed' mid-trace (the HAState abandoned without
+    close, a torn partial record on the WAL tail) and restarted from the
+    state dir — and require identical epoch, host truth, and answers."""
+    build, nodes = _builder()
+    live = [0, 0, 0]
+    rng = np.random.default_rng(seed)
+    batches = [_trace_events(rng, nodes, live) for _ in range(6)]
+    req = whatif_pods("ha", 5, anti_on="churn")
+
+    ha_a = HAState.open(str(tmp_path / "a"), build, checkpoint_every=3)
+    for evs in batches:
+        ha_a.ingest(evs)
+
+    ha_b = HAState.open(str(tmp_path / "b"), build, checkpoint_every=3)
+    for evs in batches[:4]:
+        ha_b.ingest(evs)
+    # SIGKILL: no close, and a torn partial record on the tail
+    with open(str(tmp_path / "b" / WAL_NAME), "a") as f:
+        f.write('{"seq": 999, "events": [{"type": "pod_')
+    ha_b2 = HAState.open(str(tmp_path / "b"), build, checkpoint_every=3)
+    assert ha_b2.wal.truncated or not ha_b2.wal.records  # tail repaired
+    for evs in batches[4:]:
+        ha_b2.ingest(evs)
+
+    assert ha_b2.image.epoch == ha_a.image.epoch
+    assert _host_truth(ha_b2.image) == _host_truth(ha_a.image)
+    assert_same_response(ha_b2.image.session(req).run(),
+                         ha_a.image.session(req).run())
+    ha_a.close()
+    ha_b2.close()
+
+
+def test_restart_without_checkpoint_replays_full_wal(tmp_path):
+    build, _ = _builder()
+    ha = HAState.open(str(tmp_path), build, checkpoint_every=100)
+    for i in range(3):
+        ha.ingest([{"type": "pod_add", "pod": make_pod(
+            f"w-{i}", cpu="1", memory="1Gi", node_name="n-0")}])
+    truth, epoch = _host_truth(ha.image), ha.image.epoch
+    ha.close()
+    ha2 = HAState.open(str(tmp_path), build, checkpoint_every=100)
+    assert (ha2.replayed, ha2.skipped) == (3, 0)
+    assert ha2.image.epoch == epoch and _host_truth(ha2.image) == truth
+    ha2.close()
+
+
+def test_compaction_seals_wal_and_restore_uses_checkpoint(tmp_path):
+    build, _ = _builder()
+    ha = HAState.open(str(tmp_path), build, checkpoint_every=2)
+    for i in range(5):
+        ha.ingest([{"type": "pod_add", "pod": make_pod(
+            f"c-{i}", cpu="1", memory="1Gi", node_name="n-1")}])
+    # 2 compactions landed; the WAL holds only the unsealed tail
+    assert len(ha.wal.records) == 1
+    truth, epoch = _host_truth(ha.image), ha.image.epoch
+    ha.close()
+    ha2 = HAState.open(str(tmp_path), build, checkpoint_every=2)
+    assert ha2.replayed == 1  # checkpoint carried the sealed 4
+    assert ha2.image.epoch == epoch and _host_truth(ha2.image) == truth
+    ha2.close()
+
+
+# ----------------------------------------------------------- WAL recovery ----
+
+
+def test_wal_torn_tail_truncates_to_valid_prefix(tmp_path):
+    path = str(tmp_path / "w.wal")
+    wal = IngestWAL.open(path, "d1")
+    wal.append(1, [{"type": "node_drain", "name": "n-0"}])
+    wal.append(2, [{"type": "node_drain", "name": "n-1"}])
+    wal.close()
+    with open(path, "ab") as f:  # invalid utf-8 mid-record, no newline
+        f.write(b'{"seq": 3, "events": [\xff\xfe')
+    size_before = len(open(path, "rb").read())
+    wal2 = IngestWAL.open(path, "d1")
+    assert wal2.truncated
+    assert [s for s, _ in wal2.records] == [1, 2]
+    assert len(open(path, "rb").read()) < size_before  # bytes actually gone
+    wal2.append(3, [])  # the repaired log accepts appends again
+    wal2.close()
+
+
+def test_wal_unterminated_parsable_tail_not_replayed(tmp_path):
+    """A record without its newline is NOT durable even when it parses:
+    fsync ordering only proves bytes up to the last terminator."""
+    path = str(tmp_path / "w.wal")
+    wal = IngestWAL.open(path, "d1")
+    wal.append(1, [])
+    wal.close()
+    with open(path, "a") as f:
+        f.write(json.dumps({"seq": 2, "events": []}))  # no \n
+    wal2 = IngestWAL.open(path, "d1")
+    assert [s for s, _ in wal2.records] == [1]
+    wal2.close()
+
+
+def test_wal_digest_mismatch_refused(tmp_path):
+    path = str(tmp_path / "w.wal")
+    IngestWAL.open(path, "lineage-a").close()
+    before = REGISTRY.values().get(
+        "simon_serve_wal_parity_mismatches_total", 0)
+    with pytest.raises(WalMismatch, match="different serving lineage"):
+        IngestWAL.open(path, "lineage-b")
+    assert REGISTRY.values()[
+        "simon_serve_wal_parity_mismatches_total"] == before + 1
+
+
+def test_duplicate_epoch_replay_is_idempotent(tmp_path):
+    build, _ = _builder()
+    ha = HAState.open(str(tmp_path), build, checkpoint_every=100)
+    for i in range(3):
+        ha.ingest([{"type": "pod_add", "pod": make_pod(
+            f"d-{i}", cpu="1", memory="1Gi", node_name="n-2")}])
+    truth, epoch = _host_truth(ha.image), ha.image.epoch
+    ha.close()
+    # a duplicate of record 2 on the tail (e.g. an at-least-once shipper)
+    with open(str(tmp_path / WAL_NAME)) as f:
+        dup = f.readlines()[2]
+    with open(str(tmp_path / WAL_NAME), "a") as f:
+        f.write(dup)
+    ha2 = HAState.open(str(tmp_path), build, checkpoint_every=100)
+    assert (ha2.replayed, ha2.skipped) == (3, 1)
+    assert ha2.image.epoch == epoch and _host_truth(ha2.image) == truth
+    ha2.close()
+
+
+def test_wal_seq_gap_refused(tmp_path):
+    build, _ = _builder()
+    ha = HAState.open(str(tmp_path), build, checkpoint_every=100)
+    ha.ingest([{"type": "node_drain", "name": "n-0"}])
+    ha.close()
+    with open(str(tmp_path / WAL_NAME), "a") as f:
+        f.write(json.dumps({"seq": 5, "events": []}) + "\n")
+    with pytest.raises(WalMismatch, match="replay gap"):
+        HAState.open(str(tmp_path), build, checkpoint_every=100)
+
+
+def test_doctored_checkpoint_refused(tmp_path):
+    build, _ = _builder()
+    ha = HAState.open(str(tmp_path), build, checkpoint_every=1)
+    ha.ingest([{"type": "node_drain", "name": "n-0"}])  # forces a checkpoint
+    ha.close()
+    path = str(tmp_path / CHECKPOINT_NAME)
+    raw = bytearray(open(path, "rb").read())
+    raw[-1] ^= 0xFF  # flip one payload byte; header sha256 now disagrees
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(WalMismatch, match="sha256 mismatch"):
+        load_checkpoint(path)
+    with pytest.raises(WalMismatch):
+        HAState.open(str(tmp_path), build)
+    # truncation (torn rename never happens — os.replace is atomic — but a
+    # copy mid-write can truncate) is refused too
+    open(path, "wb").write(bytes(raw[:len(raw) // 2]))
+    with pytest.raises(WalMismatch):
+        load_checkpoint(path)
+
+
+def test_checkpoint_roundtrip_preserves_epoch_and_truth(tmp_path):
+    nodes, bound = make_cluster(8, 5)
+    img = ResidentImage.try_build(nodes, pods=bound)
+    img.apply_events([{"type": "node_drain", "name": "n-7"}])
+    digest = lineage_digest(img.current_nodes(), img.cluster_pods())
+    path = str(tmp_path / "c.bin")
+    head = save_checkpoint(path, img, digest)
+    assert (head["generation"], head["seq"]) == (img.generation, img.seq)
+    from open_simulator_tpu.serve import restore_image
+
+    head2, state = load_checkpoint(path)
+    img2 = restore_image(state)
+    assert img2.epoch == img.epoch
+    assert _host_truth(img2) == _host_truth(img)
+    req = whatif_pods("ckpt", 4)
+    assert_same_response(img2.session(req).run(), img.session(req).run())
+
+
+def test_compaction_races_concurrent_ingest(tmp_path):
+    """checkpoint() from a background thread serializes with ingest under
+    the same locks: no torn capture, and the final restart is bit-identical
+    to the live image."""
+    build, _ = _builder()
+    ha = HAState.open(str(tmp_path), build, checkpoint_every=10_000)
+    errors = []
+
+    def churn():
+        try:
+            for i in range(20):
+                ha.ingest([{"type": "pod_add", "pod": make_pod(
+                    f"r-{i}", cpu="1", memory="1Gi",
+                    node_name=f"n-{i % 8}")}])
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    def compact():
+        try:
+            for _ in range(10):
+                ha.checkpoint()
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=churn),
+               threading.Thread(target=compact)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    truth, epoch = _host_truth(ha.image), ha.image.epoch
+    ha.close()
+    ha2 = HAState.open(str(tmp_path), build)
+    assert ha2.image.epoch == epoch and _host_truth(ha2.image) == truth
+    ha2.close()
+
+
+# ------------------------------------------------------- admission control ----
+
+
+def _scripted_clock(start=0.0):
+    t = [start]
+
+    def clock():
+        return t[0]
+
+    return t, clock
+
+
+def test_admission_queue_bound_sheds():
+    ac = AdmissionController(max_queue=4, seed=0)
+    ac.admit("whatif", "a", queued=3)  # under the bound: admitted
+    with pytest.raises(ShedError) as ei:
+        ac.admit("whatif", "a", queued=4)
+    assert ei.value.reason == "queue_full" and ei.value.retry_after > 0
+    assert ac.sheds == 1
+
+
+def test_admission_tenant_buckets_isolate_and_refill():
+    t, clock = _scripted_clock()
+    ac = AdmissionController(max_queue=100, tenant_rate=1.0,
+                             tenant_burst=2.0, seed=0, clock=clock)
+    ac.admit("whatif", "a", 0)
+    ac.admit("whatif", "a", 0)
+    with pytest.raises(ShedError) as ei:
+        ac.admit("whatif", "a", 0)  # burst of 2 spent
+    assert ei.value.reason == "rate_limit"
+    ac.admit("whatif", "b", 0)  # tenant b has its own bucket
+    t[0] = 1.5  # 1.5s refill at 1 rps
+    ac.admit("whatif", "a", 0)
+
+
+def test_admission_deadline_shed_needs_evidence():
+    t, clock = _scripted_clock()
+    ac = AdmissionController(max_queue=100, seed=0, clock=clock)
+    # cold controller: no p95 evidence, a tight deadline still admits
+    ac.admit("whatif", "a", 0, deadline_s=0.001)
+    for _ in range(20):
+        ac.observe_wall(1.0)
+    with pytest.raises(ShedError) as ei:
+        ac.admit("whatif", "a", 0, deadline_s=0.5)  # p95=1.0 > remaining
+    assert ei.value.reason == "deadline"
+    ac.admit("whatif", "a", 0, deadline_s=2.0)  # covered: admitted
+
+
+def test_admission_shed_sequence_is_deterministic():
+    """Same seed + same scripted request sequence => identical shed
+    decisions AND identical jittered retry_after values."""
+
+    def run():
+        t, clock = _scripted_clock()
+        ac = AdmissionController(max_queue=2, tenant_rate=1.0,
+                                 tenant_burst=1.0, seed=42, clock=clock)
+        for _ in range(10):
+            ac.observe_wall(0.4)
+        out = []
+        for step, (tenant, queued, deadline) in enumerate(
+                [("a", 0, None), ("a", 0, None), ("b", 5, None),
+                 ("b", 0, 0.1), ("a", 1, None), ("c", 2, 0.05)]):
+            t[0] = 0.25 * step
+            try:
+                ac.admit("whatif", tenant, queued, deadline_s=deadline)
+                out.append("ok")
+            except ShedError as e:
+                out.append((e.reason, round(e.retry_after, 9)))
+        return out
+
+    a, b = run(), run()
+    assert a == b
+    assert any(isinstance(x, tuple) for x in a)  # the script does shed
+
+
+def test_service_submit_sheds_through_admission():
+    nodes, bound = make_cluster(8, 3)
+    img = ResidentImage.try_build(nodes, pods=bound)
+    t, clock = _scripted_clock()
+    ac = AdmissionController(max_queue=8, tenant_rate=1.0, tenant_burst=1.0,
+                             seed=0, clock=clock)
+    svc = WhatIfService(img, window_ms=0.0, admission=ac)
+    req = whatif_pods("shed", 2)
+    first = svc.submit(req, tenant="t1")
+    assert first["total"] == 2
+    with pytest.raises(ShedError):  # bucket of 1 spent, clock frozen
+        svc.submit(req, tenant="t1")
+    assert svc.stats()["sheds"] == 1
+    assert "window_scale" in svc.stats()
+    svc.stop()
+
+
+# --------------------------------------------- degraded mode / staleness -----
+
+
+def test_ingest_stall_degrades_then_ceiling_flips_health(tmp_path):
+    t, clock = _scripted_clock()
+    build, _ = _builder()
+    ha = HAState.open(str(tmp_path), build, checkpoint_every=100,
+                      staleness_ceiling_s=30.0, clock=clock)
+    assert ha.healthy() and ha.staleness_s() == 0.0
+    plan = FaultPlan.from_json({"faults": [
+        {"site": "ingest_stall", "attempt": 1, "error": "transient"}]})
+    with installed(plan):
+        with pytest.raises(Exception):
+            ha.ingest([{"type": "node_drain", "name": "n-0"}])
+    assert ha.degraded_reason() == "ingest_stall"
+    t[0] = 10.0
+    assert ha.staleness_s() == 10.0 and ha.healthy()  # inside the ceiling
+    assert ha.stats()["degraded"] == "ingest_stall"
+    t[0] = 31.0
+    assert not ha.healthy()  # the 503 flip
+    # recovery: the next successful ingest clears staleness entirely
+    ha.ingest([{"type": "node_drain", "name": "n-1"}])
+    assert ha.degraded_reason() is None and ha.staleness_s() == 0.0
+    assert ha.healthy()
+    ha.close()
+
+
+def test_wal_append_failure_degrades_and_image_untouched(tmp_path):
+    build, _ = _builder()
+    ha = HAState.open(str(tmp_path), build, checkpoint_every=100)
+    epoch = ha.image.epoch
+    plan = FaultPlan.from_json({"faults": [
+        {"site": "wal_write", "attempt": 1, "error": "transient"}]})
+    with installed(plan):
+        with pytest.raises(Exception):
+            ha.ingest([{"type": "node_drain", "name": "n-0"}])
+    # WAL-ahead: the apply never ran, the image never moved
+    assert ha.image.epoch == epoch and ha.degraded_reason() == "wal"
+    # serving continues at the last consistent epoch, stamped stale
+    resp = {"epoch": ha.image.epoch}
+    headers = ha.stamp(resp)
+    assert headers["X-Simon-Epoch"] == epoch
+    assert resp["staleness_s"] >= 0.0
+    ha.close()
+
+
+def test_resync_recovers_with_generation_bump(tmp_path):
+    build, _ = _builder()
+    ha = HAState.open(str(tmp_path), build, checkpoint_every=100)
+    gen = ha.image.generation
+    ha._enter_degraded("ingest")
+    ha.resync()
+    assert ha.image.generation == gen + 1
+    assert ha.degraded_reason() is None and ha.healthy()
+    req = whatif_pods("resync", 3)
+    assert_same_response(ha.image.session(req).run(),
+                         ha.image.fresh_probe(req))
+    ha.close()
+
+
+def test_wrong_epoch_tripwire_fails_loudly(tmp_path):
+    build, _ = _builder()
+    ha = HAState.open(str(tmp_path), build)
+    before = REGISTRY.values().get(
+        "simon_serve_wrong_epoch_answers_total", 0)
+    with pytest.raises(WrongEpochError):
+        ha.stamp({"epoch": f"{ha.image.generation}.{ha.image.seq + 1}"})
+    with pytest.raises(WrongEpochError):
+        ha.stamp({"epoch": f"{ha.image.generation + 1}.0"})
+    assert REGISTRY.values()[
+        "simon_serve_wrong_epoch_answers_total"] == before + 2
+    # at or behind the image: stamped fine (degraded mode's whole point)
+    assert "X-Simon-Epoch" in ha.stamp({"epoch": ha.image.epoch})
+    ha.close()
+
+
+def test_fault_sites_replay_equal(tmp_path):
+    """Every new simonha fault site, injected twice with the same plan,
+    produces the same fired-injection trace (the simonfault contract)."""
+    build, _ = _builder()
+    for site in ("wal_write", "wal_fsync", "checkpoint_write",
+                 "ingest_stall"):
+        traces = []
+        for rep in range(2):
+            d = tmp_path / f"{site}-{rep}"
+            ha = HAState.open(str(d), build, checkpoint_every=1)
+            plan = FaultPlan.from_json({"faults": [
+                {"site": site, "attempt": 1, "error": "transient"}]})
+            with installed(plan) as active:
+                if site == "checkpoint_write":
+                    # the batch landed durably before compaction failed:
+                    # the ingest succeeds and the state degrades instead
+                    # (a 500 would retry a landed delta into double-apply)
+                    ha.ingest([{"type": "node_drain", "name": "n-0"}])
+                    assert ha.degraded_reason() == "checkpoint"
+                else:
+                    with pytest.raises(Exception):
+                        ha.ingest([{"type": "node_drain", "name": "n-0"}])
+                    assert ha.degraded_reason() is not None
+                traces.append(list(active.trace))
+            ha.close()
+        assert traces[0] == traces[1], site
+        assert traces[0], site  # the site actually fired

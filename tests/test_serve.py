@@ -454,3 +454,162 @@ def test_cli_serve_parser():
          "--fanout", "4", "--port", "0"])
     assert args.command == "serve" and args.synthetic_nodes == 8
     assert args.window_ms == 1.0 and args.fanout == 4
+
+
+# ------------------------------------------------------- simonha over HTTP ----
+
+
+def _ha_server(state_dir, n_nodes=8, n_bound=3, **kw):
+    nodes, bound = make_cluster(n_nodes, n_bound)
+    rt = ResourceTypes(nodes=nodes, pods=bound)
+    snap = ClusterSnapshot(rt, [], [], [])
+    return Server(snapshot_fn=lambda: snap, whatif=True,
+                  whatif_window_ms=0.0, state_dir=str(state_dir), **kw)
+
+
+def test_http_state_dir_stamps_epoch_and_staleness(tmp_path):
+    server = _ha_server(tmp_path)
+    code, body = server.handle_ingest(
+        {"events": [{"type": "node_drain", "name": "n-7"}]})
+    assert code == 200 and body["applied"] == 1
+    code, body = server.handle_whatif({"pods": [make_pod("s-1", cpu="1",
+                                                         memory="1Gi")]})
+    assert code == 200
+    assert body["staleness_s"] == 0.0  # healthy: stamped, not stale
+    assert body["epoch"] == server._ha.image.epoch
+    server.drain(deadline=0.1)
+
+
+def test_http_restart_from_state_dir_bit_identical(tmp_path):
+    """The serve-level restart oracle: kill server A (drain = the graceful
+    half; test_ha covers SIGKILL semantics on the raw files), boot server B
+    over the same --state-dir, require the same epoch and the same answers."""
+    req = {"pods": [make_pod(f"rs-{j}", cpu="1", memory="1Gi")
+                    for j in range(3)]}
+    a = _ha_server(tmp_path, checkpoint_every=2)
+    for i in range(3):
+        code, _ = a.handle_ingest({"events": [{
+            "type": "pod_add", "pod": make_pod(
+                f"live-{i}", cpu="1", memory="1Gi",
+                node_name=f"n-{i}")}]})
+        assert code == 200
+    code, want = a.handle_whatif(dict(req))
+    assert code == 200
+    epoch = a._ha.image.epoch
+    a.drain(deadline=0.1)
+
+    b = _ha_server(tmp_path, checkpoint_every=2)
+    code, got = b.handle_whatif(dict(req))
+    assert code == 200
+    assert b._ha.image.epoch == epoch
+    assert b._ha.skipped + b._ha.replayed >= 1  # restored, not rebuilt
+    assert_same_response(got, want)
+    assert got["epoch"] == want["epoch"]
+    b.drain(deadline=0.1)
+
+
+def test_http_healthz_flips_503_past_staleness_ceiling(tmp_path):
+    import http.client
+
+    server = _ha_server(tmp_path, staleness_ceiling_s=0.0)
+    server.whatif_service()  # boot the HA state
+    httpd = server.build_httpd(port=0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/healthz")
+        assert conn.getresponse().read() and True
+        server._ha._enter_degraded("ingest")
+        server._ha._last_ok -= 1.0  # degraded for a solid second
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 503 and body["reason"] == "ingest"
+        assert body["staleness_s"] > 0
+        # recovery via successful ingest: healthz flips back
+        server._ha.ingest([{"type": "node_drain", "name": "n-6"}])
+        conn.request("GET", "/healthz")
+        assert conn.getresponse().status == 200
+        conn.close()
+    finally:
+        httpd.shutdown()
+        server.drain(deadline=0.1)
+
+
+def test_http_ingest_payload_caps(tmp_path):
+    """Satellite: the unbounded-memory hazard is closed BEFORE the body is
+    read — oversized payload 413, in-flight byte budget 429, both
+    structured and counted."""
+    import http.client
+
+    server = _ha_server(tmp_path, ingest_max_bytes=1024)
+    httpd = server.build_httpd(port=0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        big = json.dumps({"events": [{"type": "node_drain",
+                                      "name": "x" * 2048}]})
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("POST", "/v1/ingest", big,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 413 and body["code"] == 413
+        conn.close()  # the server dropped the connection with the body unread
+
+        # in-flight budget: pre-load the accounting to the 4x cap
+        server._ingest_bytes = 4 * 1024
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("POST", "/v1/ingest", json.dumps({"events": []}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 429
+        assert resp.getheader("Retry-After") is not None
+        body = json.loads(resp.read())
+        assert body["code"] == 429
+        conn.close()
+        server._ingest_bytes = 0
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("POST", "/v1/ingest", json.dumps(
+            {"events": [{"type": "node_drain", "name": "n-5"}]}),
+            {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200  # the budget released: normal service
+        assert resp.getheader("X-Simon-Epoch") == server._ha.image.epoch
+        conn.close()
+    finally:
+        httpd.shutdown()
+        server.drain(deadline=0.1)
+
+
+def test_http_whatif_shed_maps_to_429_with_retry_after(tmp_path):
+    import http.client
+
+    server = _ha_server(tmp_path, max_queue=8, tenant_rate=0.001)
+    httpd = server.build_httpd(port=0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+        body = json.dumps({"pods": [make_pod("sh-1", cpu="1",
+                                             memory="1Gi")]})
+        codes = []
+        for _ in range(10):  # burst past the 8-token burst at ~0 rps
+            conn.request("POST", "/v1/whatif", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            out = json.loads(resp.read())
+            codes.append(resp.status)
+            if resp.status == 429:
+                assert out["reason"] == "rate_limit"
+                assert out["retry_after_s"] > 0
+                assert resp.getheader("Retry-After") is not None
+        assert codes.count(200) == 8 and codes.count(429) == 2
+        conn.close()
+    finally:
+        httpd.shutdown()
+        server.drain(deadline=0.1)

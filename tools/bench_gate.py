@@ -24,7 +24,13 @@ compile-cache accounting starts from zero exactly like the baseline run):
    (PodStore/NodeStore, streaming encode forced on) in its own interpreter,
    with a hard peak-RSS budget: the struct-of-arrays store must CUT host
    memory vs the dict path, and streaming must cap per-run buffers
-   (RSS_1M_BUDGET_MB; see the constant's comment for measurements).
+   (RSS_1M_BUDGET_MB; see the constant's comment for measurements);
+6. **restart gate (simonha)** — restart-to-ready wall for a 10k-node image
+   in its own interpreter: a checkpoint+WAL-tail restore must come up at
+   the exact pre-crash epoch with bit-identical answers, and must be at
+   least RESTORE_SPEEDUP_FLOOR x faster than rebuilding the image from the
+   materialized node dicts (the apiserver-relist baseline a restart would
+   otherwise pay).
 
 Then diffs the fresh registry snapshot against the committed baseline
 (tests/golden/bench_gate_baseline.json) with the SAME machinery as
@@ -85,6 +91,11 @@ MUST_BE_ZERO = (
     "simon_pulse_records_dropped_total",
     "simon_pulse_regressions_total",
     "simon_pulse_phase_seconds_total",
+    # simonha (PR 19): an answer stamped ahead of the image, or a WAL/
+    # checkpoint lineage/integrity mismatch, is a crash-consistency
+    # correctness failure no baseline can excuse
+    "simon_serve_wrong_epoch_answers_total",
+    "simon_serve_wal_parity_mismatches_total",
 )
 
 # jax-version-dependent families excluded from the baseline diff (see
@@ -127,6 +138,104 @@ print(json.dumps({{
         resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1),
 }}))
 """
+
+
+# Restart-to-ready floor for the simonha gate (PR 19): restoring a 10k-node
+# resident image from checkpoint + WAL tail vs rebuilding it from the
+# materialized node dicts (what a restart without --state-dir pays: a full
+# apiserver relist + per-dict encode). The columnar checkpoint rides the
+# NodeStore whole, so restore skips the per-node dict parse entirely —
+# measured ~15-30x on CI-class hosts; the 5x floor only trips if restore
+# falls back to the dict path (or checkpointing silently degrades to a
+# rebuild), not on host-speed jitter.
+RESTORE_SPEEDUP_FLOOR = 5.0
+RESTART_WORKLOAD = r"""
+import json, os, shutil, sys, tempfile, time
+sys.path.insert(0, {repo!r})
+from open_simulator_tpu.serve import HAState, ResidentImage
+from open_simulator_tpu.utils.synth import synth_cluster_store
+
+N_NODES = 10_000
+ns, _ = synth_cluster_store(N_NODES, 0)
+
+
+def build():
+    return ResidentImage.try_build(ns)
+
+
+def pod(i, node):
+    meta = dict(name="ha-gate-%d" % i, namespace="default",
+                uid="ha-gate-uid-%d" % i, labels=dict(app="ha-gate"))
+    spec = dict(containers=[dict(
+        name="c", image="nginx",
+        resources=dict(requests=dict(cpu="500m", memory="1Gi")))])
+    if node:
+        spec["nodeName"] = node
+    return dict(apiVersion="v1", kind="Pod", metadata=meta, spec=spec,
+                status=dict(phase="Running" if node else "Pending"))
+
+
+probe = [pod(1000 + j, None) for j in range(3)]
+state_dir = tempfile.mkdtemp(prefix="ha_restart_gate_")
+try:
+    ha = HAState.open(state_dir, build, checkpoint_every=4)
+    for step in range(5):  # checkpoint seals batch 4; batch 5 stays in WAL
+        ha.ingest([dict(type="pod_add",
+                        pod=pod(step, "node-%05d" % (step % 8)))])
+    want = ha.image.session(probe).run()
+    want_epoch = ha.image.epoch
+    relist_nodes = ha.image.current_nodes()  # the apiserver-relist payload
+    ha.close()
+
+    t0 = time.perf_counter()
+    ha2 = HAState.open(state_dir, build, checkpoint_every=4)
+    restore_s = time.perf_counter() - t0
+    got = ha2.image.session(probe).run()
+    match = (ha2.image.epoch == want_epoch and all(
+        got[k] == want[k]
+        for k in ("scheduled", "total", "unscheduled", "utilization")))
+    replayed = ha2.replayed
+    ha2.close()
+
+    t0 = time.perf_counter()
+    img = ResidentImage.try_build(relist_nodes)
+    rebuild_s = time.perf_counter() - t0
+    rebuilt_ok = len(img.current_nodes()) == N_NODES
+finally:
+    shutil.rmtree(state_dir, ignore_errors=True)
+
+print(json.dumps(dict(
+    n_nodes=N_NODES, restore_s=round(restore_s, 3),
+    rebuild_s=round(rebuild_s, 3),
+    speedup=round(rebuild_s / max(restore_s, 1e-9), 1),
+    replayed=replayed, answers_match=bool(match),
+    rebuilt_ok=bool(rebuilt_ok))))
+"""
+
+
+def run_restart_gate() -> dict:
+    """The simonha restart-to-ready probe, in its own interpreter: its WAL/
+    checkpoint counter families must NOT leak into this process' registry
+    snapshot (the baseline diff covers the serve/sweep/hard workloads only),
+    and both timed sides — checkpoint restore and dict-relist rebuild — run
+    in the same warmed process, so the speedup compares work, not imports."""
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, "-c", RESTART_WORKLOAD.format(repo=REPO)],
+        env=dict(os.environ), capture_output=True, text=True, timeout=900)
+    row = None
+    for line in reversed(out.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            row = json.loads(line)
+            break
+    if row is None:
+        raise SystemExit(
+            f"restart gate workload produced no row (rc={out.returncode}, "
+            f"stderr tail: {out.stderr[-300:]!r})")
+    if not row["rebuilt_ok"] or row["replayed"] < 1:
+        raise SystemExit(f"restart gate workload malformed: {row}")
+    return row
 
 
 def run_rss_gate() -> dict:
@@ -271,6 +380,24 @@ def main(argv=None) -> int:
                        f"{rss['rss_mb']}MB > {RSS_1M_BUDGET_MB}MB budget — "
                        f"the host path is growing per-pod state again")
 
+    restart = run_restart_gate()
+    print(f"gate restart row: restore {restart['restore_s']}s vs rebuild "
+          f"{restart['rebuild_s']}s = {restart['speedup']}x "
+          f"(floor {RESTORE_SPEEDUP_FLOOR}x), replayed={restart['replayed']}, "
+          f"answers_match={restart['answers_match']}")
+    restart_failures = []
+    if not restart["answers_match"]:
+        restart_failures.append(
+            "restart gate: the checkpoint+WAL restore came up at a "
+            "different epoch or with different what-if answers than the "
+            "pre-restart image — crash consistency is broken")
+    if restart["speedup"] < RESTORE_SPEEDUP_FLOOR:
+        restart_failures.append(
+            f"restart gate: checkpoint restore only {restart['speedup']}x "
+            f"faster than the dict-relist rebuild (floor "
+            f"{RESTORE_SPEEDUP_FLOOR}x) — the columnar store fast path "
+            f"fell off the restore")
+
     if args.update:
         with open(BASELINE, "w") as f:
             json.dump(snap, f, indent=1, sort_keys=True)
@@ -301,7 +428,7 @@ def main(argv=None) -> int:
     from open_simulator_tpu.cli.main import _diff_metrics
 
     changed, regressions = _diff_metrics(base, snap, sys.stdout)
-    for msg in hard_failures + mesh_failures:
+    for msg in hard_failures + mesh_failures + restart_failures:
         print(f"GATE FAILURE: {msg}", file=sys.stderr)
     if rss_failure:
         print(f"GATE FAILURE: {rss_failure}", file=sys.stderr)
@@ -310,7 +437,8 @@ def main(argv=None) -> int:
               f"grew vs {os.path.relpath(BASELINE, REPO)} (re-baseline "
               f"with --update ONLY if the growth is intended)",
               file=sys.stderr)
-    if hard_failures or regressions or rss_failure or mesh_failures:
+    if (hard_failures or regressions or rss_failure or mesh_failures
+            or restart_failures):
         return 1
     print(f"bench gate: OK ({changed} metric(s) changed, 0 regressions)")
     return 0

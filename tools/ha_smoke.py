@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""CI smoke for simonha (fast, CPU-only).
+
+The crash-consistent-serving acceptance criteria, end to end:
+
+- **Real SIGKILL mid-ingest-burst.** A child process boots an HAState over a
+  --state-dir, ingests a deterministic delta burst, and SIGKILLs itself from
+  inside a WAL append (record durable, apply never ran). The parent restarts
+  from the same state dir — checkpoint + WAL-tail replay — finishes the
+  burst, and asserts epoch, host truth, and what-if answers bit-identical to
+  an uninterrupted run.
+- **Fault-site replay equality.** Each new site (wal_write / wal_fsync /
+  checkpoint_write / ingest_stall), injected twice under the same plan,
+  fires an identical trace (the simonfault contract), degrades the HA state,
+  and the next good ingest recovers it.
+- **Overload.** A concurrent burst against a bounded admission queue: every
+  request either completes or sheds (completions + sheds == burst size, all
+  threads join), sheds are counted, and the service takes new work
+  afterwards — overload never wedges in-flight requests. A scripted-clock
+  token-bucket slice pins the EXACT shed count and its determinism.
+- **Tripwires.** simon_serve_wrong_epoch_answers_total and
+  simon_serve_wal_parity_mismatches_total are zero at exit (the bench-gate
+  MUST_BE_ZERO families).
+
+Prints one JSON line with the measured numbers.
+"""
+
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from open_simulator_tpu.obs import REGISTRY  # noqa: E402
+from open_simulator_tpu.resilience import FaultPlan, installed  # noqa: E402
+from open_simulator_tpu.serve import (  # noqa: E402
+    AdmissionController,
+    HAState,
+    ResidentImage,
+    ShedError,
+    WhatIfService,
+)
+from open_simulator_tpu.utils.synth import synth_node  # noqa: E402
+
+STATE_DIR = "/tmp/ha_smoke_state"
+N_BATCHES = 10
+KILL_AFTER_APPENDS = 6  # SIGKILL inside the append of batch 6's record
+CHECKPOINT_EVERY = 4    # so the restart exercises checkpoint + WAL tail
+
+
+def _pod(i, node=None):
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": f"ha-{i}", "namespace": "default",
+                     "uid": f"ha-uid-{i}", "labels": {"app": "ha"}},
+        "spec": {"containers": [{"name": "c", "image": "nginx",
+                                 "resources": {"requests": {
+                                     "cpu": "500m", "memory": "1Gi"}}}]},
+        "status": {"phase": "Running" if node else "Pending"},
+    }
+    if node:
+        pod["spec"]["nodeName"] = node
+    return pod
+
+
+def _workload():
+    """Deterministic boot cluster + ingest burst, shared with the child."""
+    nodes = [synth_node(i) for i in range(8)]
+    batches = []
+    for step in range(N_BATCHES):
+        if step == 4:
+            batches.append([{"type": "node_drain", "name": "node-00006"}])
+        elif step == 8:
+            batches.append([{"type": "node_drain", "name": "node-00007"}])
+        else:
+            batches.append([
+                {"type": "pod_add",
+                 "pod": _pod(step * 4 + j, node=f"node-{step % 6:05d}")}
+                for j in range(2)])
+    return nodes, batches
+
+
+def _build_image():
+    nodes, _ = _workload()
+    return ResidentImage.try_build(nodes)
+
+
+def _req():
+    return [_pod(1000 + j) for j in range(3)]
+
+
+def _host_truth(image):
+    return json.dumps({"nodes": image.current_nodes(),
+                       "pods": image.cluster_pods()},
+                      sort_keys=True, default=str)
+
+
+def _sum(prefix):
+    return sum(v for k, v in REGISTRY.values().items()
+               if k.startswith(prefix))
+
+
+def _same_answer(a, b, what):
+    for key in ("scheduled", "total", "unscheduled", "utilization"):
+        assert a[key] == b[key], f"{what}: {key} {a[key]} != {b[key]}"
+
+
+# ------------------------------------------------- SIGKILL crash-restart -----
+
+
+def sigkill_restart_smoke(row):
+    import shutil
+    import signal
+    import subprocess
+
+    nodes, batches = _workload()
+
+    # the never-crashed oracle
+    oracle = ResidentImage.try_build(nodes)
+    for evs in batches:
+        oracle.apply_events(evs)
+    want = oracle.session(_req()).run()
+
+    if os.path.exists(STATE_DIR):
+        shutil.rmtree(STATE_DIR)
+    child = r"""
+import os, signal, sys
+sys.path.insert(0, %r)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import tools.ha_smoke as hs
+from open_simulator_tpu.serve import HAState, IngestWAL
+
+real = IngestWAL.append
+state = {"n": 0}
+def append(self, seq, events):
+    real(self, seq, events)        # the record is fsync'd BEFORE the kill
+    state["n"] += 1
+    if state["n"] >= %d:
+        os.kill(os.getpid(), signal.SIGKILL)
+IngestWAL.append = append
+
+_, batches = hs._workload()
+ha = HAState.open(%r, hs._build_image,
+                  checkpoint_every=hs.CHECKPOINT_EVERY)
+for evs in batches:
+    ha.ingest(evs)
+print("UNREACHABLE")
+""" % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+       KILL_AFTER_APPENDS, STATE_DIR)
+    proc = subprocess.run([sys.executable, "-c", child],
+                          capture_output=True, text=True, timeout=540)
+    assert proc.returncode == -signal.SIGKILL, \
+        f"child did not die by SIGKILL: rc={proc.returncode} " \
+        f"{proc.stderr[-400:]}"
+    assert "UNREACHABLE" not in proc.stdout
+
+    # restart from the state dir: checkpoint (first CHECKPOINT_EVERY
+    # batches sealed) + WAL-tail replay, then finish the burst
+    ha = HAState.open(STATE_DIR, _build_image,
+                      checkpoint_every=CHECKPOINT_EVERY)
+    assert os.path.exists(os.path.join(STATE_DIR, "checkpoint.bin")), \
+        "child never compacted: the restart exercised no checkpoint"
+    assert ha.replayed >= 1, "restart replayed nothing from the WAL tail"
+    applied = ha.image.seq
+    assert applied == KILL_AFTER_APPENDS, \
+        f"restart seq {applied}: the durable-but-unapplied record must " \
+        f"replay (WAL-ahead), expected {KILL_AFTER_APPENDS}"
+    for evs in batches[applied:]:
+        ha.ingest(evs)
+    got = ha.image.session(_req()).run()
+    assert ha.image.epoch == oracle.epoch, \
+        f"epoch diverged: {ha.image.epoch} != {oracle.epoch}"
+    assert _host_truth(ha.image) == _host_truth(oracle), \
+        "restarted host truth != never-crashed host truth"
+    _same_answer(got, want, "crash-restart answer")
+    ha.close()
+    shutil.rmtree(STATE_DIR)
+    row["sigkill_restart"] = {
+        "killed_after_appends": KILL_AFTER_APPENDS,
+        "replayed": ha.replayed, "skipped": ha.skipped,
+        "final_epoch": oracle.epoch,
+    }
+
+
+# --------------------------------------------------- fault-site replay -------
+
+
+def ha_site_sweep(row):
+    import shutil
+    import tempfile
+
+    fired = {}
+    for site in ("wal_write", "wal_fsync", "checkpoint_write",
+                 "ingest_stall"):
+        traces = []
+        for rep in range(2):
+            d = tempfile.mkdtemp(prefix=f"ha_smoke_{site}_")
+            ha = HAState.open(d, _build_image, checkpoint_every=1)
+            plan = FaultPlan.from_json({"faults": [
+                {"site": site, "attempt": 1, "error": "transient"}]})
+            with installed(plan) as active:
+                raised = False
+                try:
+                    ha.ingest([{"type": "node_drain", "name": "node-00000"}])
+                except Exception:
+                    raised = True  # the clean-failure surface
+                if site == "checkpoint_write":
+                    # the batch was durable + applied before compaction
+                    # failed: the ingest must SUCCEED (a 500 would make the
+                    # client double-apply via retry) and degrade instead
+                    assert not raised, f"{site}: landed ingest failed"
+                else:
+                    assert raised, f"{site}: injected fault vanished"
+                traces.append(list(active.trace))
+            assert ha.degraded_reason() is not None, \
+                f"{site}: ingest failure did not flip degraded mode"
+            # recovery: the next good ingest marks healthy again
+            ha.ingest([{"type": "node_drain", "name": "node-00001"}])
+            assert ha.degraded_reason() is None and ha.healthy(), \
+                f"{site}: recovery ingest did not clear degraded mode"
+            ha.close()
+            shutil.rmtree(d)
+        assert traces[0] == traces[1], f"{site}: trace not replay-equal"
+        assert traces[0], f"{site}: site never fired"
+        fired[site] = len(traces[0])
+    row["ha_sites_replay_equal"] = fired
+
+
+# ------------------------------------------------------------- overload ------
+
+
+def overload_smoke(row):
+    nodes, _ = _workload()
+    img = ResidentImage.try_build(nodes)
+    ac = AdmissionController(max_queue=2, seed=7)
+    svc = WhatIfService(img, window_ms=300.0, fanout=4, admission=ac)
+    svc.submit([_pod(2000)])  # pay the compile before the burst
+
+    results = []
+    lock = threading.Lock()
+
+    def go(i):
+        try:
+            out = svc.submit([_pod(3000 + i)])
+            with lock:
+                results.append(("ok", out["scheduled"]))
+        except ShedError as e:
+            assert e.retry_after > 0
+            with lock:
+                results.append(("shed", e.reason))
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(24)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in threads), \
+        "overload wedged a request thread"
+    ok = [r for r in results if r[0] == "ok"]
+    shed = [r for r in results if r[0] == "shed"]
+    assert len(ok) + len(shed) == 24, results
+    assert shed, "bounded queue never shed under a 24-wide burst"
+    assert ac.sheds == len(shed), "shed decisions not counted"
+    after = svc.submit([_pod(4000)])  # the service takes new work post-burst
+    assert after["total"] == 1
+    svc.stop()
+
+    # deterministic slice: scripted clock + token bucket pins exact sheds
+    t = [0.0]
+    ac2 = AdmissionController(max_queue=64, tenant_rate=1.0,
+                              tenant_burst=4.0, seed=0, clock=lambda: t[0])
+    svc2 = WhatIfService(img, window_ms=0.0, admission=ac2)
+    outcomes = []
+    for i in range(8):  # clock frozen: exactly the 4-token burst admits
+        try:
+            svc2.submit([_pod(5000 + i)], tenant="tb")
+            outcomes.append("ok")
+        except ShedError as e:
+            outcomes.append(e.reason)
+    assert outcomes.count("ok") == 4 and \
+        outcomes.count("rate_limit") == 4, outcomes
+    svc2.stop()
+    row["overload"] = {"burst": 24, "completed": len(ok),
+                       "shed": len(shed),
+                       "sheds_total": _sum("simon_serve_sheds_total")}
+
+
+def main() -> int:
+    row = {"metric": "ha_smoke"}
+    ha_site_sweep(row)
+    sigkill_restart_smoke(row)
+    overload_smoke(row)
+    wrong = _sum("simon_serve_wrong_epoch_answers_total")
+    mism = _sum("simon_serve_wal_parity_mismatches_total")
+    assert wrong == 0, f"wrong-epoch tripwire fired {wrong}x"
+    assert mism == 0, f"WAL parity-mismatch tripwire fired {mism}x"
+    row["wrong_epoch_total"] = wrong
+    row["wal_mismatches_total"] = mism
+    row["wal_ops_total"] = _sum("simon_serve_wal_ops_total")
+    row["checkpoints_total"] = _sum("simon_serve_checkpoints_total")
+    print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
