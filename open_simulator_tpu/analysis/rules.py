@@ -1326,3 +1326,99 @@ def rule_unbounded_queue(ctx: ModuleContext) -> List[Finding]:
             f"with the reason the depth is bounded elsewhere",
         ))
     return out
+
+
+# ------------------------------------------ unclassified-network-error --
+
+# The live tier's error taxonomy (simulator/live.py, live/sync.py): every
+# network failure routes to exactly one of AuthError (fatal, never
+# retried), TransientError (reconnect under the seeded RetryPolicy), or
+# ProtocolError (bounded teardown; code=410 triggers relist
+# reconciliation). A bare `except OSError: return None` in live code
+# silently converts a dropped connection into wrong control flow — the
+# retry/breaker/relist machinery never sees the failure, so the watch
+# neither reconnects nor reconciles. Scope is structural: modules living
+# in a `live` package directory or with a `live*` basename. Non-network
+# uses of OSError in live modules (bookmark-file reads, best-effort
+# close()) carry reasoned waivers.
+_NETWORK_EXC = {
+    "OSError", "IOError", "ConnectionError", "ConnectionResetError",
+    "ConnectionRefusedError", "ConnectionAbortedError", "BrokenPipeError",
+    "TimeoutError", "socket.error", "socket.timeout", "socket.gaierror",
+    "socket.herror", "ssl.SSLError", "ssl.SSLEOFError",
+    "urllib.error.URLError", "urllib.error.HTTPError",
+    "http.client.HTTPException",
+}
+_NETWORK_EXC_PREFIXES = ("http.client.", "socket.")
+_ERROR_TAXONOMY = {"AuthError", "TransientError", "ProtocolError"}
+
+
+def _is_live_module(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return "live" in parts[:-1] or parts[-1].startswith("live")
+
+
+def _caught_network_names(ctx: ModuleContext,
+                          handler: ast.ExceptHandler) -> Set[str]:
+    typ = handler.type
+    if typ is None:
+        return set()
+    elts = typ.elts if isinstance(typ, ast.Tuple) else [typ]
+    hits: Set[str] = set()
+    for e in elts:
+        name = ctx.resolve(e)
+        if name is None:
+            continue
+        if name in _NETWORK_EXC or name.startswith(_NETWORK_EXC_PREFIXES):
+            hits.add(name)
+    return hits
+
+
+def _routes_to_taxonomy(ctx: ModuleContext,
+                        handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if not isinstance(node, ast.Raise):
+            continue
+        if node.exc is None:
+            return True  # bare re-raise hands the error upward intact
+        exc = node.exc
+        if isinstance(exc, ast.Call):
+            exc = exc.func
+        name = ctx.resolve(exc)
+        if name and name.split(".")[-1] in _ERROR_TAXONOMY:
+            return True
+    return False
+
+
+@register(
+    "unclassified-network-error", Severity.WARNING,
+    "A network-error catch (OSError family, socket.*, urllib.error.*, "
+    "http.client.*) in a live-cluster module whose handler neither raises "
+    "one of the typed taxonomy errors (AuthError / TransientError / "
+    "ProtocolError) nor bare-re-raises. Unrouted network failures bypass "
+    "the retry/breaker/relist machinery entirely: the watch loop can't "
+    "reconnect on what it never sees. Classify the failure, or waive a "
+    "genuinely non-network OSError site with `# simonlint: "
+    "ignore[unclassified-network-error] -- <why it is not a network "
+    "path>`.",
+)
+def rule_unclassified_network_error(ctx: ModuleContext) -> List[Finding]:
+    if not _is_live_module(ctx.path):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        hits = _caught_network_names(ctx, node)
+        if not hits or _routes_to_taxonomy(ctx, node):
+            continue
+        out.append(Finding(
+            "unclassified-network-error", Severity.WARNING, ctx.path,
+            node.lineno, node.col_offset,
+            f"except {'/'.join(sorted(hits))} in live code swallows a "
+            f"network failure the retry/breaker/relist machinery never "
+            f"sees — raise AuthError/TransientError/ProtocolError (or "
+            f"bare-re-raise), or waive with why this is not a network "
+            f"path",
+        ))
+    return out

@@ -266,6 +266,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--ingest-max-bytes", type=int, default=None, metavar="BYTES",
         help="shed any /v1/ingest payload over BYTES with 413 before "
              "reading it (default 8 MiB; in-flight total bounded at 4x)")
+    p_serve.add_argument(
+        "--watch", default=None, metavar="SPEC",
+        help="simonsync: keep the resident image current from a watch "
+             "source instead of (only) /v1/ingest. SPEC is "
+             "'file:stream.jsonl' (recorded JSONL replay), a chunked-HTTP "
+             "watch URL (optionally 'watch_url|list_url' so 410-Gone can "
+             "relist-reconcile), or 'kube' (watch the kubeconfig cluster's "
+             "nodes+pods). Resumes from the persisted resourceVersion "
+             "bookmark when --state-dir is set")
 
     p_slo = sub.add_parser(
         "slo", help="Render a running serve instance's SLO snapshot "
@@ -550,7 +559,8 @@ def cmd_serve(args) -> int:
                                    is not None else 256),
                         tenant_rate=getattr(args, "tenant_rate", None),
                         ingest_max_bytes=getattr(
-                            args, "ingest_max_bytes", None))
+                            args, "ingest_max_bytes", None),
+                        watch=getattr(args, "watch", None))
         if args.grpc_port:
             from ..server.grpcbridge import GrpcBridge
 
@@ -671,6 +681,11 @@ _BAD_WHEN_UP = (
     # mismatch is a crash-consistency correctness failure
     "simon_serve_wrong_epoch_answers_total",
     "simon_serve_wal_parity_mismatches_total",
+    # simonsync (PR 20): a post-reconcile parity mismatch is a correctness
+    # failure; a relist falling back to a generation-bumping rebuild means
+    # the columnar diff declined — a robustness regression
+    "simon_sync_parity_mismatches_total",
+    "simon_sync_full_rebuilds_total",
 )
 
 

@@ -1,0 +1,32 @@
+"""simonsync: resilient live-cluster watch sync (see sync.py).
+
+The typed error taxonomy (AuthError / TransientError / ProtocolError) is
+defined in simulator/live.py and re-exported here so live/ modules share
+one classification discipline — the `unclassified-network-error` lint rule
+enforces that every network catch under live/ routes through it.
+"""
+
+from ..simulator.live import (  # noqa: F401
+    AuthError,
+    LiveClusterError,
+    ProtocolError,
+    TransientError,
+)
+from .decode import TemplateInterner, WatchLine, parse_line, reconcile, to_delta  # noqa: F401
+from .sync import (  # noqa: F401
+    BOOKMARK_NAME,
+    HttpWatchSource,
+    QueueSource,
+    RecordedSource,
+    ScriptedSource,
+    WatchSource,
+    WatchSync,
+    kube_watch_sources,
+)
+
+__all__ = [
+    "AuthError", "LiveClusterError", "ProtocolError", "TransientError",
+    "TemplateInterner", "WatchLine", "parse_line", "reconcile", "to_delta",
+    "BOOKMARK_NAME", "HttpWatchSource", "QueueSource", "RecordedSource",
+    "ScriptedSource", "WatchSource", "WatchSync", "kube_watch_sources",
+]

@@ -1,0 +1,252 @@
+"""simonsync columnar decode: kube watch JSON -> resident-image delta events.
+
+One ``json.loads`` per watch line is the floor, but nothing downstream of it
+needs a fresh object tree per pod: the decoder interns pod *templates* — the
+heavy spec subtree (containers, resources, affinity, tolerations) and the
+label map are parsed once per distinct shape and shared by reference across
+every pod that matches, which is ``PodStore.add_block``'s template-block
+idiom applied to the delta path. Each decoded pod is still a distinct top
+dict (the engine's ``_sig_of`` bookkeeping is identity-keyed), but a 10k-pod
+stream of 8 templates retains 8 spec trees, not 10k. Node objects ride the
+image's ``node_add`` path, which extends ``NodeArrays`` columnar in place.
+
+The other half of this module is :func:`reconcile` — the 410-Gone recovery
+diff. It compares a freshly listed cluster against the resident image's
+*index structures* (``sync_snapshot`` reads the pod index and the node-name
+column directly; no per-object materialization) and emits the minimal delta
+batch: only what actually changed in the gap window, never a
+generation-bumping rebuild unless the diff finds a change the delta path
+cannot express (today: a drained node coming back).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from ..simulator.live import ProtocolError
+
+WATCH_TYPES = ("ADDED", "MODIFIED", "DELETED", "BOOKMARK")
+
+
+class WatchLine(NamedTuple):
+    """One parsed watch-stream line."""
+
+    type: str   # ADDED | MODIFIED | DELETED | BOOKMARK
+    kind: str   # Node | Pod | ...
+    key: str    # "namespace/name" for pods, bare name for nodes
+    rv: int     # object resourceVersion (monotone per stream)
+    obj: dict
+
+
+def _rv_of(meta: dict) -> int:
+    raw = meta.get("resourceVersion")
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        raise ProtocolError(f"unparseable resourceVersion {raw!r}")
+
+
+def parse_line(raw: str) -> WatchLine:
+    """Parse one watch line; classify every malformation as ProtocolError.
+
+    A server-side ``ERROR`` status line raises ProtocolError carrying the
+    status code — 410 is the relist trigger, exactly like live.py's GET
+    classification."""
+    try:
+        d = json.loads(raw)
+    except ValueError as e:
+        raise ProtocolError(f"undecodable watch line: {e}")
+    if not isinstance(d, dict):
+        raise ProtocolError("watch line is not an object")
+    typ = d.get("type")
+    obj = d.get("object") or {}
+    if typ == "ERROR":
+        code = obj.get("code")
+        raise ProtocolError(obj.get("message") or "watch error stream",
+                            code=code if isinstance(code, int) else None)
+    if typ not in WATCH_TYPES:
+        raise ProtocolError(f"unknown watch event type {typ!r}")
+    if not isinstance(obj, dict):
+        raise ProtocolError("watch object is not a dict")
+    meta = obj.get("metadata") or {}
+    rv = _rv_of(meta)
+    kind = obj.get("kind") or ""
+    if typ == "BOOKMARK":
+        return WatchLine("BOOKMARK", kind, "", rv, obj)
+    if kind == "Pod":
+        name = meta.get("name") or ""
+        key = f"{meta.get('namespace') or 'default'}/{name}"
+    else:
+        name = key = meta.get("name") or ""
+    if not name:
+        raise ProtocolError(f"watch {kind or 'object'} without a name")
+    return WatchLine(typ, kind, key, rv, obj)
+
+
+class TemplateInterner:
+    """Share spec subtrees across pods of the same shape (and strip node
+    metadata the image never reads). ``hits`` counts pods that reused an
+    already-parsed template — the bench's interning-efficacy stat."""
+
+    def __init__(self) -> None:
+        self._pods: Dict[str, Tuple[dict, dict]] = {}
+        self.hits = 0
+
+    @property
+    def templates(self) -> int:
+        return len(self._pods)
+
+    def pod(self, obj: dict) -> dict:
+        meta = obj.get("metadata") or {}
+        spec = obj.get("spec") or {}
+        labels = meta.get("labels") or {}
+        shape = {k: v for k, v in spec.items() if k != "nodeName"}
+        sig = json.dumps((meta.get("namespace") or "default", labels, shape),
+                         sort_keys=True, separators=(",", ":"))
+        got = self._pods.get(sig)
+        if got is None:
+            got = (labels, shape)
+            self._pods[sig] = got
+        else:
+            self.hits += 1
+        pod: dict = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": meta.get("name"),
+                         "namespace": meta.get("namespace") or "default",
+                         "labels": got[0]},
+            "spec": dict(got[1]),
+        }
+        node = spec.get("nodeName")
+        if node:
+            pod["spec"]["nodeName"] = node
+        return pod
+
+    def node(self, obj: dict) -> dict:
+        # nodes are unique; just drop the bookkeeping subtrees the image
+        # never reads so the resident store doesn't retain them
+        meta = dict(obj.get("metadata") or {})
+        meta.pop("managedFields", None)
+        meta.pop("resourceVersion", None)
+        out = dict(obj)
+        out["metadata"] = meta
+        return out
+
+
+def to_delta(line: WatchLine, interner: TemplateInterner
+             ) -> Tuple[Optional[dict], Optional[str]]:
+    """WatchLine -> (resident-image delta event, None) or (None, skip
+    reason). The image only tracks committed (bound) pods and schedulable
+    nodes; everything else is an explicit skip, counted by the sync loop."""
+    obj = line.obj
+    if line.kind == "Pod":
+        if line.type == "DELETED":
+            return {"type": "pod_delete", "key": line.key}, None
+        meta = obj.get("metadata") or {}
+        spec = obj.get("spec") or {}
+        if not spec.get("nodeName") or meta.get("deletionTimestamp"):
+            return None, "unbound"
+        return {"type": "pod_add", "pod": interner.pod(obj)}, None
+    if line.kind == "Node":
+        name = line.key
+        if line.type == "DELETED":
+            return {"type": "node_delete", "name": name}, None
+        if line.type == "MODIFIED":
+            if (obj.get("spec") or {}).get("unschedulable"):
+                return {"type": "node_drain", "name": name}, None
+            return None, "untracked_change"
+        return {"type": "node_add", "node": interner.node(obj)}, None
+    return None, "unknown_kind"
+
+
+def pod_key_of(obj: dict) -> str:
+    meta = obj.get("metadata") or {}
+    return f"{meta.get('namespace') or 'default'}/{meta.get('name') or ''}"
+
+
+def reconcile(image, listed_nodes: List[dict], listed_pods: List[dict],
+              interner: TemplateInterner
+              ) -> Tuple[List[dict], List[str]]:
+    """Columnar relist diff: listed truth vs the resident index structures.
+
+    Returns (delta events, inexpressible changes). The event batch is
+    canonically ordered — node adds, drains, pod deletes, pod adds, each
+    name-sorted — so a reconciled gap applies deterministically regardless
+    of the order the list endpoint returned objects in. An inexpressible
+    change (a drained node resurrected) is reported instead of approximated;
+    the caller rebuilds and re-reconciles."""
+    res_pods, res_live = image.sync_snapshot()
+
+    listed_live: Dict[str, dict] = {}
+    listed_node_names = set()
+    for n in listed_nodes:
+        name = (n.get("metadata") or {}).get("name") or ""
+        if not name:
+            continue
+        listed_node_names.add(name)
+        if not (n.get("spec") or {}).get("unschedulable"):
+            listed_live[name] = n
+
+    inexpressible: List[str] = []
+    node_adds: List[dict] = []
+    drains: List[dict] = []
+    for name in sorted(set(listed_live) - res_live):
+        if image.node_state(name) == "drained":
+            # the delta path cannot resurrect a drained slot in place
+            inexpressible.append(f"resurrected-node:{name}")
+        else:
+            node_adds.append({"type": "node_add",
+                              "node": interner.node(listed_live[name])})
+    for name in sorted(res_live - set(listed_live)):
+        drains.append({"type": "node_drain", "name": name})
+
+    # committed pods = listed pods bound to a live listed node; pods bound
+    # to drained/absent nodes are evicted by the drain above (kube drain
+    # semantics, same as the image's own node_drain path)
+    listed_bound: Dict[str, Tuple[dict, str]] = {}
+    for p in listed_pods:
+        meta = p.get("metadata") or {}
+        node = (p.get("spec") or {}).get("nodeName")
+        if not node or node not in listed_live or meta.get("deletionTimestamp"):
+            continue
+        listed_bound[pod_key_of(p)] = (p, node)
+
+    deletes: List[dict] = []
+    adds: List[dict] = []
+    for key in sorted(set(res_pods) - set(listed_bound)):
+        deletes.append({"type": "pod_delete", "key": key})
+    for key in sorted(set(listed_bound) - set(res_pods)):
+        adds.append({"type": "pod_add",
+                     "pod": interner.pod(listed_bound[key][0])})
+    for key in sorted(set(res_pods) & set(listed_bound)):
+        if res_pods[key] != listed_bound[key][1]:  # rebound to another node
+            deletes.append({"type": "pod_delete", "key": key})
+            adds.append({"type": "pod_add",
+                         "pod": interner.pod(listed_bound[key][0])})
+    return node_adds + drains + deletes + adds, inexpressible
+
+
+def verify_parity(image, listed_nodes: List[dict],
+                  listed_pods: List[dict]) -> List[str]:
+    """Post-reconcile exactness check: the resident sets must now equal the
+    listed truth. Any surviving difference is a reconciliation bug, counted
+    by the MUST_BE_ZERO parity tripwire."""
+    res_pods, res_live = image.sync_snapshot()
+    listed_live = set()
+    for n in listed_nodes:
+        name = (n.get("metadata") or {}).get("name") or ""
+        if name and not (n.get("spec") or {}).get("unschedulable"):
+            listed_live.add(name)
+    listed_keys = set()
+    for p in listed_pods:
+        node = (p.get("spec") or {}).get("nodeName")
+        meta = p.get("metadata") or {}
+        if node and node in listed_live and not meta.get("deletionTimestamp"):
+            listed_keys.add(pod_key_of(p))
+    problems = []
+    for name in sorted(res_live ^ listed_live):
+        problems.append(f"node:{name}")
+    for key in sorted(set(res_pods) ^ listed_keys):
+        problems.append(f"pod:{key}")
+    return problems

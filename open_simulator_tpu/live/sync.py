@@ -1,0 +1,729 @@
+"""simonsync: resilient live-cluster watch sync for the resident image.
+
+A first-party reflector/informer equivalent: one `WatchSync` keeps a
+`ResidentImage` (optionally behind the simonha `HAState` WAL) consistent
+against an unreliable watch source. The contract, in kube terms:
+
+- **Resumable watch.** The sync tracks a resourceVersion *bookmark* — the
+  high-water mark through which every event has been applied. Connection
+  flaps reconnect from the bookmark with the policy's seeded backoff
+  schedule, so reconnect timing is bit-replayable like every other fault
+  path (`RetryPolicy.schedule()`).
+- **Exactly-once apply.** Three dedup layers, cheapest first: a global
+  `rv <= bookmark` stale filter (everything at or under the bookmark is
+  already applied), a per-(kind, name) resourceVersion table (the informer
+  cache: duplicates and out-of-order re-deliveries lose the RV race), and a
+  presence probe against the resident index (re-deliveries after a crash,
+  when the in-memory RV table is gone). Batches are sorted by RV before
+  apply, so a reordered wire never changes apply order.
+- **Bookmark-delimited batches.** Events buffer until the stream's BOOKMARK
+  line (the server's declared safe point) and apply as ONE image batch — so
+  the epoch lineage (`generation.seq`) of a chaos-wracked run is identical
+  to the flap-free replay: one seq per window, however many times the
+  window's events were re-served.
+- **410 Gone -> relist reconciliation.** When the server has compacted away
+  the bookmark, the sync lists current state and diffs it *columnar*
+  against the resident stores (`decode.reconcile` reads the pod index and
+  node-name column; no object materialization), emitting only the gap's
+  delta events — never a generation-bumping full rebuild unless the diff
+  finds an inexpressible change. The gap window rides the simonha
+  bounded-staleness machinery (`note_stall`), so degraded-mode headers and
+  the staleness ceiling apply while the gap is open.
+- **Crash-consistent resume.** When a `state_dir` is given, the bookmark is
+  persisted (tmp + fsync + atomic rename) BEFORE each batch applies,
+  stamped with the image seq the apply will produce. On restart the seq
+  disambiguates: seq reached the stamp -> the batch landed, resume from
+  `next_rv`; it didn't -> the batch was lost, resume from `prev_rv`.
+  Combined with the PR 19 WAL that makes SIGKILL mid-stream resume exact:
+  checkpoint + WAL tail rebuild the image, the bookmark file pins the
+  stream position, and re-delivered windows dedup to empty batches.
+
+Fault sites: `watch_read` (one line read), `watch_parse` (one line decode),
+`watch_gone` (server-side compaction -> forced 410), `relist` (the recovery
+list call). All four join the simonfault registry's replay-equality
+contract.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from ..obs import instruments as obs
+from ..obs import pulse
+from ..resilience import faults
+from ..resilience.policy import CircuitBreaker, RetryPolicy
+from ..simulator.live import AuthError, ProtocolError, TransientError
+from . import decode
+
+BOOKMARK_NAME = "sync.bookmark.json"
+
+__all__ = [
+    "WatchSource", "RecordedSource", "QueueSource", "ScriptedSource",
+    "HttpWatchSource", "WatchSync", "BOOKMARK_NAME", "kube_watch_sources",
+]
+
+
+# ------------------------------------------------------------------ sources ---
+
+
+class WatchSource:
+    """One unreliable delta feed. `watch(since_rv)` yields raw JSON lines
+    and is expected to fail: TransientError tears the stream down for a
+    bookmark reconnect, ProtocolError(code=410) forces relist
+    reconciliation, AuthError aborts. BOOKMARK lines are the server's safe
+    points — reorders never cross them and batches flush at them."""
+
+    def watch(self, since_rv: int) -> Iterator[str]:
+        raise NotImplementedError
+
+    def list(self) -> Tuple[int, List[dict], List[dict]]:
+        """(resourceVersion, nodes, pods) — current state, for relist."""
+        raise ProtocolError("this watch source cannot list")
+
+    def close(self) -> None:
+        pass
+
+
+class RecordedSource(WatchSource):
+    """A recorded JSONL stream (bench/CI): every line is replayed on every
+    connect; the sync's stale/dedup filters make resumption exact."""
+
+    def __init__(self, lines: Optional[List[str]] = None,
+                 path: Optional[str] = None) -> None:
+        if (lines is None) == (path is None):
+            raise ValueError("exactly one of lines/path")
+        self._lines = lines
+        self._path = path
+
+    def watch(self, since_rv: int) -> Iterator[str]:
+        if self._lines is not None:
+            yield from self._lines
+            return
+        with open(self._path, "r", encoding="utf-8") as f:
+            for raw in f:
+                raw = raw.strip()
+                if raw:
+                    yield raw
+
+
+class QueueSource(WatchSource):
+    """An in-process push feed (loadgen churn, tests): `push()` lines in,
+    `close()` ends the stream cleanly."""
+
+    _CLOSE = object()
+
+    def __init__(self, maxsize: int = 4096) -> None:
+        import queue
+
+        # bounded: a sync thread that falls behind back-pressures the
+        # producer at push() instead of absorbing the backlog into heap
+        self._q = queue.Queue(maxsize=maxsize)
+
+    def push(self, line: str) -> None:
+        self._q.put(line)
+
+    def close(self) -> None:
+        self._q.put(self._CLOSE)
+
+    def watch(self, since_rv: int) -> Iterator[str]:
+        while True:
+            item = self._q.get()
+            if item is self._CLOSE:
+                return
+            yield item
+
+
+class ScriptedSource(WatchSource):
+    """A scripted in-process apiserver for chaos tests: serves a clean
+    recorded stream with seeded flaps, duplicates, adjacent reorders, and
+    410-Gone compactions injected at deterministic positions. Compactions
+    land on bookmark boundaries and swallow exactly one window, so a
+    reconciled gap costs exactly the one image batch its lost window would
+    have — the epoch-parity construction the chaos gate asserts.
+    """
+
+    def __init__(self, lines: List[str], seed: int = 0, flap_p: float = 0.0,
+                 dup_p: float = 0.0, reorder_p: float = 0.0,
+                 gone_p: float = 0.0,
+                 base_nodes: Optional[List[dict]] = None,
+                 base_pods: Optional[List[dict]] = None) -> None:
+        import random
+
+        # the cluster state that predates the stream — list() answers must
+        # include it or a relist would "reconcile away" the whole base
+        self._base_nodes = list(base_nodes or [])
+        self._base_pods = list(base_pods or [])
+        self._clean: List[decode.WatchLine] = [decode.parse_line(x)
+                                               for x in lines]
+        self._floor = 0
+        self._fired: Dict[int, bool] = {}  # wire index -> one-shot fault spent
+        rng = random.Random(seed)
+
+        # group into bookmark-delimited windows
+        windows: List[List[int]] = [[]]
+        for i, ln in enumerate(self._clean):
+            windows[-1].append(i)
+            if ln.type == "BOOKMARK":
+                windows.append([])
+        if not windows[-1]:
+            windows.pop()
+
+        # wire plan: ("line", rv, raw) | ("flap", after_rv) |
+        #            ("gone", trigger_rv, floor_rv)
+        wire: List[tuple] = []
+        for w, idxs in enumerate(windows):
+            events = [i for i in idxs if self._clean[i].type != "BOOKMARK"]
+            bmarks = [i for i in idxs if self._clean[i].type == "BOOKMARK"]
+            if w > 0 and events and bmarks and rng.random() < gone_p:
+                wire.append(("gone", self._clean[events[0]].rv,
+                             self._clean[bmarks[-1]].rv))
+            order = list(events)
+            k = 0
+            while k < len(order) - 1:
+                if rng.random() < reorder_p:
+                    order[k], order[k + 1] = order[k + 1], order[k]
+                    k += 2
+                else:
+                    k += 1
+            for i in order:
+                ln = self._clean[i]
+                wire.append(("line", ln.rv, lines[i]))
+                if rng.random() < dup_p:
+                    wire.append(("line", ln.rv, lines[i]))
+                if rng.random() < flap_p:
+                    wire.append(("flap", ln.rv))
+            for i in bmarks:
+                wire.append(("line", self._clean[i].rv, lines[i]))
+        self._wire = wire
+        self.flaps_planned = sum(1 for e in wire if e[0] == "flap")
+        self.gones_planned = sum(1 for e in wire if e[0] == "gone")
+
+    def watch(self, since_rv: int) -> Iterator[str]:
+        if since_rv < self._floor:
+            raise ProtocolError("resourceVersion too old", code=410)
+        for wi, entry in enumerate(self._wire):
+            kind = entry[0]
+            if kind == "line":
+                if entry[1] > since_rv:
+                    yield entry[2]
+            elif kind == "flap":
+                # one-shot: a reconnect replaying the same window must not
+                # trip over the same scripted flap forever
+                if entry[1] > since_rv and not self._fired.get(wi):
+                    self._fired[wi] = True
+                    raise TransientError("connection reset by chaos script")
+            else:  # gone
+                trigger_rv, floor_rv = entry[1], entry[2]
+                if trigger_rv > since_rv and not self._fired.get(wi):
+                    self._fired[wi] = True
+                    self._floor = max(self._floor, floor_rv)
+                    raise ProtocolError(
+                        "resourceVersion compacted", code=410)
+
+    def list(self) -> Tuple[int, List[dict], List[dict]]:
+        rv = self._floor or (self._clean[-1].rv if self._clean else 0)
+        return self.state_at(rv)
+
+    def state_at(self, rv: int) -> Tuple[int, List[dict], List[dict]]:
+        """Replay the clean stream to `rv`: the apiserver's list answer.
+        Node drains/deletes evict bound pods, mirroring the cluster's own
+        lifecycle (and the image's node_drain semantics)."""
+        nodes: Dict[str, dict] = {
+            (n.get("metadata") or {}).get("name") or "": n
+            for n in self._base_nodes}
+        pods: Dict[str, dict] = {decode.pod_key_of(p): p
+                                 for p in self._base_pods}
+        for ln in self._clean:
+            if ln.rv > rv:
+                break
+            if ln.type == "BOOKMARK":
+                continue
+            if ln.kind == "Node":
+                if ln.type == "DELETED":
+                    nodes.pop(ln.key, None)
+                else:
+                    nodes[ln.key] = ln.obj
+                if ln.type == "DELETED" or (
+                        (ln.obj.get("spec") or {}).get("unschedulable")):
+                    pods = {k: p for k, p in pods.items()
+                            if (p.get("spec") or {}).get("nodeName") != ln.key}
+            elif ln.kind == "Pod":
+                if ln.type == "DELETED":
+                    pods.pop(ln.key, None)
+                else:
+                    pods[ln.key] = ln.obj
+        return rv, list(nodes.values()), list(pods.values())
+
+
+class HttpWatchSource(WatchSource):
+    """The real chunked-HTTP watch, classified through live.py's typed
+    taxonomy: 401/403 AuthError (never retried), 410 ProtocolError(code)
+    (relist), 429/5xx and every socket-level failure TransientError
+    (bookmark reconnect), undecodable bodies ProtocolError."""
+
+    def __init__(self, watch_url: str, list_url: Optional[str] = None,
+                 token: Optional[str] = None, ssl_ctx=None,
+                 timeout: float = 30.0) -> None:
+        self.watch_url = watch_url
+        self.list_url = list_url
+        self.token = token
+        self.ssl_ctx = ssl_ctx
+        self.timeout = timeout
+
+    def _open(self, url: str):
+        headers = {"Accept": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        req = urllib.request.Request(url, headers=headers)
+        try:
+            return urllib.request.urlopen(req, timeout=self.timeout,
+                                          context=self.ssl_ctx)
+        except urllib.error.HTTPError as e:
+            code = e.code
+            if code in (401, 403):
+                raise AuthError(f"HTTP {code} from {url}")
+            if code == 410:
+                raise ProtocolError(f"HTTP 410 from {url}", code=410)
+            if code == 429 or 500 <= code <= 599:
+                ra = 0.0
+                try:
+                    ra = float(e.headers.get("Retry-After") or 0.0)
+                except (TypeError, ValueError):
+                    ra = 0.0
+                raise TransientError(f"HTTP {code} from {url}",
+                                     retry_after=ra, code=code)
+            raise ProtocolError(f"HTTP {code} from {url}", code=code)
+        except (OSError, http.client.HTTPException) as e:
+            raise TransientError(f"connect to {url} failed: {e}")
+
+    def watch(self, since_rv: int) -> Iterator[str]:
+        sep = "&" if "?" in self.watch_url else "?"
+        url = f"{self.watch_url}{sep}resourceVersion={since_rv}"
+        resp = self._open(url)
+        try:
+            while True:
+                try:
+                    raw = resp.readline()
+                except (OSError, http.client.HTTPException) as e:
+                    raise TransientError(f"watch read failed: {e}")
+                if not raw:
+                    # server closed the stream: reflectors re-watch, so a
+                    # clean EOF is a transient teardown, not completion
+                    raise TransientError("watch stream ended")
+                line = raw.decode("utf-8", "replace").strip()
+                if line:
+                    yield line
+        finally:
+            try:
+                resp.close()
+            # simonlint: ignore[unclassified-network-error] -- best-effort
+            # close of an already-failed stream; the read path above has
+            # already routed the real failure
+            except OSError:
+                pass
+
+    def list(self) -> Tuple[int, List[dict], List[dict]]:
+        if not self.list_url:
+            raise ProtocolError("no list endpoint configured")
+        resp = self._open(self.list_url)
+        try:
+            try:
+                body = resp.read()
+            except (OSError, http.client.HTTPException) as e:
+                raise TransientError(f"list read failed: {e}")
+        finally:
+            try:
+                resp.close()
+            # simonlint: ignore[unclassified-network-error] -- best-effort
+            # close after the body is already read (or its failure routed)
+            except OSError:
+                pass
+        try:
+            d = json.loads(body.decode("utf-8", "replace"))
+        except ValueError as e:
+            raise ProtocolError(f"undecodable list body: {e}")
+        meta = d.get("metadata") or {}
+        try:
+            rv = int(d.get("resourceVersion") or meta.get("resourceVersion"))
+        except (TypeError, ValueError):
+            raise ProtocolError("list body without a resourceVersion")
+        if "items" in d:  # kube-style single-resource list
+            kind = (d.get("kind") or "").replace("List", "")
+            items = d.get("items") or []
+            for it in items:
+                it.setdefault("kind", kind)
+            nodes = [it for it in items if it.get("kind") == "Node"]
+            pods = [it for it in items if it.get("kind") == "Pod"]
+            return rv, nodes, pods
+        return rv, d.get("nodes") or [], d.get("pods") or []
+
+
+def kube_watch_sources(client) -> List["HttpWatchSource"]:
+    """Two sources (nodes, pods) over a live apiserver, reusing the
+    KubeClient's endpoint, bearer token, and TLS context."""
+    base = client.server.rstrip("/")
+    return [
+        HttpWatchSource(f"{base}/api/v1/nodes?watch=1",
+                        list_url=f"{base}/api/v1/nodes",
+                        token=client.token, ssl_ctx=client.ssl_ctx),
+        HttpWatchSource(f"{base}/api/v1/pods?watch=1",
+                        list_url=f"{base}/api/v1/pods",
+                        token=client.token, ssl_ctx=client.ssl_ctx),
+    ]
+
+
+# --------------------------------------------------------------------- sync ---
+
+# watch-loop defaults: quicker first retry than the GET policy (a torn
+# stream usually reconnects instantly) but the same determinism contract
+WATCH_RETRY = RetryPolicy(max_attempts=6, base=0.05, mult=2.0, cap=2.0,
+                          jitter=0.2, max_elapsed=60.0, seed=0)
+
+
+class WatchSync:
+    """The reflector loop. Drives `source` into `image` (or, when `ha` is
+    given, through `HAState.ingest` so every batch rides the WAL)."""
+
+    def __init__(self, source: WatchSource, image=None, ha=None,
+                 state_dir: Optional[str] = None,
+                 retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 max_flap_streak: int = 12, name: str = "") -> None:
+        self.name = name
+        if ha is not None:
+            image = ha.image
+            state_dir = state_dir or ha.state_dir
+        if image is None:
+            raise ValueError("WatchSync needs an image or an HAState")
+        self.source = source
+        self.image = image
+        self.ha = ha
+        self.state_dir = state_dir
+        self.retry = retry or WATCH_RETRY
+        self.breaker = breaker
+        self.sleep = sleep
+        self.max_flap_streak = int(max_flap_streak)
+        self.interner = decode.TemplateInterner()
+        self._rv: Dict[Tuple[str, str], int] = {}
+        self.bookmark = self._load_bookmark()
+        self.sleeps: List[float] = []  # observed backoff (determinism tests)
+        self.batches = 0
+        self.applied = 0
+        self.duplicates = 0
+        self.stale = 0
+        self.skipped = 0
+        self.reconnects = 0
+        self.relists = 0
+        self.full_rebuilds = 0
+        self.parity_mismatches = 0
+        self._t_decode = 0.0
+
+    # --------------------------------------------------------- bookmarking ---
+
+    def _seq(self) -> int:
+        return int(self.image.seq)
+
+    def _bookmark_path(self) -> str:
+        # one bookmark file per named source (kube mode runs nodes + pods
+        # loops against one state dir)
+        base = (BOOKMARK_NAME if not self.name
+                else BOOKMARK_NAME.replace(".json", f".{self.name}.json"))
+        return os.path.join(self.state_dir, base)
+
+    def _load_bookmark(self) -> int:
+        if not self.state_dir:
+            return 0
+        path = self._bookmark_path()
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                d = json.load(f)
+        # simonlint: ignore[unclassified-network-error] -- local bookmark
+        # file read, not a network path: missing/torn file means cold start
+        except (OSError, ValueError):
+            return 0
+        try:
+            if self._seq() >= int(d.get("expected_seq", 0)):
+                rv = int(d.get("next_rv", 0))
+            else:
+                rv = int(d.get("prev_rv", 0))
+        except (TypeError, ValueError):
+            return 0
+        obs.SYNC_BOOKMARK_RV.set(float(rv))
+        return rv
+
+    def _write_bookmark(self, prev_rv: int, next_rv: int,
+                        expected_seq: int) -> None:
+        """Persist BEFORE the apply, stamped with the seq the apply will
+        produce; restart resolves prev/next by comparing the restored seq
+        against the stamp (crash on either side of the apply is exact)."""
+        if not self.state_dir:
+            return
+        path = self._bookmark_path()
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"prev_rv": int(prev_rv), "next_rv": int(next_rv),
+                       "expected_seq": int(expected_seq)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    # --------------------------------------------------------------- dedup ---
+
+    def _effective(self, ev: dict, staged: Dict[Tuple[str, str], str]
+                   ) -> Optional[Tuple[Tuple[str, str], str]]:
+        """(staged key, new staged state) when the event changes effective
+        state; None when it is a presence duplicate (already reflected by
+        the image or by an earlier event staged in this batch)."""
+        typ = ev["type"]
+        if typ == "pod_add":
+            key = decode.pod_key_of(ev["pod"])
+            k = ("Pod", key)
+            cur = staged.get(k) or (
+                "present" if self.image.has_pod(key) else "absent")
+            return (k, "present") if cur == "absent" else None
+        if typ == "pod_delete":
+            k = ("Pod", ev["key"])
+            cur = staged.get(k) or (
+                "present" if self.image.has_pod(ev["key"]) else "absent")
+            return (k, "absent") if cur == "present" else None
+        if typ == "node_add":
+            name = ((ev.get("node") or {}).get("metadata") or {}).get(
+                "name") or ""
+            k = ("Node", name)
+            cur = staged.get(k) or self.image.node_state(name)
+            return (k, "live") if cur == "absent" else None
+        if typ in ("node_drain", "node_delete"):
+            k = ("Node", ev["name"])
+            cur = staged.get(k) or self.image.node_state(ev["name"])
+            return (k, "drained") if cur == "live" else None
+        return None
+
+    # --------------------------------------------------------------- apply ---
+
+    def _apply(self, events: List[dict]) -> None:
+        t0 = time.perf_counter()
+        if self.ha is not None:
+            self.ha.ingest(events)
+        else:
+            self.image.apply_events(events)
+        pulse.phase("sync_apply", time.perf_counter() - t0)
+
+    def _flush(self, window: List[decode.WatchLine], new_rv: int) -> None:
+        """Decode, dedup, and apply one bookmark-delimited window.
+
+        Dedup runs over the rv-SORTED window, not arrival order: deciding
+        per line would let a wire reorder poison the window (a re-add of a
+        resident pod arriving before its own delete reads as a presence
+        duplicate, and its higher rv then swallows the delete from the
+        per-key rv table — the window nets to nothing where the in-order
+        stream applied delete+add). Sorting first makes arrival order
+        unobservable, so chaos and clean replays stage identical batches."""
+        t0 = time.perf_counter()
+        window.sort(key=lambda ln: ln.rv)
+        batch: List[dict] = []
+        pend_rv: Dict[Tuple[str, str], int] = {}
+        staged: Dict[Tuple[str, str], str] = {}
+        for line in window:
+            k = (line.kind, line.key)
+            if line.rv <= max(self._rv.get(k, 0), pend_rv.get(k, 0)):
+                self.duplicates += 1
+                obs.SYNC_EVENTS.labels(outcome="duplicate").inc()
+                continue
+            pend_rv[k] = line.rv
+            ev, _skip = decode.to_delta(line, self.interner)
+            if ev is None:
+                self.skipped += 1
+                obs.SYNC_EVENTS.labels(outcome="skipped").inc()
+                continue
+            eff = self._effective(ev, staged)
+            if eff is None:
+                self.duplicates += 1
+                obs.SYNC_EVENTS.labels(outcome="duplicate").inc()
+                continue
+            staged[eff[0]] = eff[1]
+            batch.append(ev)
+        self._t_decode += time.perf_counter() - t0
+        if self._t_decode:
+            pulse.phase("sync_decode", self._t_decode)
+            self._t_decode = 0.0
+        if batch:
+            self._write_bookmark(self.bookmark, max(new_rv, self.bookmark),
+                                 self._seq() + 1)
+            self._apply(batch)
+            self.batches += 1
+            self.applied += len(batch)
+            obs.SYNC_EVENTS.labels(outcome="applied").inc(len(batch))
+        elif new_rv > self.bookmark:
+            self._write_bookmark(new_rv, new_rv, 0)
+        if new_rv > self.bookmark:
+            self.bookmark = new_rv
+            obs.SYNC_BOOKMARK_RV.set(float(new_rv))
+        self._rv.update(pend_rv)
+
+    # ------------------------------------------------------------- consume ---
+
+    def _consume(self, stop: Optional[threading.Event]) -> bool:
+        it = self.source.watch(self.bookmark)
+        window: List[decode.WatchLine] = []
+        max_rv = self.bookmark
+        made_progress = False
+        for raw in it:
+            if stop is not None and stop.is_set():
+                self._flush(window, max_rv)
+                return True
+            faults.maybe_fail("watch_read")
+            try:
+                faults.maybe_fail("watch_gone")
+            except ProtocolError as e:
+                # this site models the SERVER compacting our horizon away
+                raise ProtocolError(f"watch expired: {e}", code=410)
+            t0 = time.perf_counter()
+            try:
+                faults.maybe_fail("watch_parse")
+                line = decode.parse_line(raw)
+            finally:
+                self._t_decode += time.perf_counter() - t0
+            if line.type == "BOOKMARK":
+                # flush outside the decode timer: sync_decode and
+                # sync_apply must decompose the wall, not overlap it
+                self._flush(window, max(max_rv, line.rv))
+                window = []
+                max_rv = self.bookmark
+                made_progress = True
+                continue
+            if line.rv <= self.bookmark:
+                self.stale += 1
+                obs.SYNC_EVENTS.labels(outcome="stale").inc()
+                continue
+            window.append(line)
+            max_rv = max(max_rv, line.rv)
+        self._flush(window, max_rv)
+        return True
+
+    # -------------------------------------------------------------- relist ---
+
+    def _relist(self) -> None:
+        self.relists += 1
+        obs.SYNC_RELISTS.inc()
+        if self.ha is not None:
+            self.ha.note_stall("watch_gone")
+
+        def _do():
+            faults.maybe_fail("relist")
+            return self.source.list()
+
+        rv, nodes, pods = self.retry.call(
+            _do, site="sync_relist",
+            retryable=lambda e: isinstance(e, TransientError),
+            breaker=self.breaker, sleep=self.sleep)
+        t0 = time.perf_counter()
+        events, inexpressible = decode.reconcile(
+            self.image, nodes, pods, self.interner)
+        if inexpressible:
+            # the delta path declined; take the image's documented escape
+            # hatch (generation bump) and re-diff against the fresh truth
+            self.full_rebuilds += 1
+            obs.SYNC_FULL_REBUILDS.inc()
+            if self.ha is not None:
+                self.ha.resync()
+            else:
+                with self.image._lock:
+                    self.image._rebuild()
+            events, _ = decode.reconcile(self.image, nodes, pods,
+                                         self.interner)
+        pulse.phase("sync_reconcile", time.perf_counter() - t0)
+        # a reconciled gap costs exactly ONE image batch — the same seq its
+        # lost window would have cost the flap-free run — even when the diff
+        # turns out empty
+        self._write_bookmark(self.bookmark, max(rv, self.bookmark),
+                             self._seq() + 1)
+        self._apply(events)
+        self.batches += 1
+        self.applied += len(events)
+        if events:
+            obs.SYNC_EVENTS.labels(outcome="applied").inc(len(events))
+        problems = decode.verify_parity(self.image, nodes, pods)
+        if problems:
+            self.parity_mismatches += len(problems)
+            obs.SYNC_PARITY.inc(len(problems))
+        if rv > self.bookmark:
+            self.bookmark = rv
+            obs.SYNC_BOOKMARK_RV.set(float(rv))
+        self._rv = {k: v for k, v in self._rv.items() if v > rv}
+
+    # ----------------------------------------------------------------- run ---
+
+    def run(self, stop: Optional[threading.Event] = None) -> dict:
+        """Consume the source to completion (recorded/queue streams end;
+        live streams run until `stop`). Flaps reconnect from the bookmark
+        on the seeded schedule; 410 relists; auth errors and exhausted
+        backoff raise."""
+        sched = self.retry.schedule()
+        streak = 0
+        last_fail_bookmark = -1
+        while not (stop is not None and stop.is_set()):
+            try:
+                if self.breaker is not None:
+                    self.breaker.before_call()
+                done = self._consume(stop)
+                if self.breaker is not None:
+                    self.breaker.record_success()
+                if done:
+                    break
+            except AuthError:
+                raise  # never retried: actionable, not transient
+            except (TransientError, ProtocolError) as e:
+                if isinstance(e, ProtocolError):
+                    if getattr(e, "code", None) == 410:
+                        self._relist()
+                        streak = 0
+                        continue
+                    # undecodable stream: tear down and re-watch, bounded
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                if self.bookmark > last_fail_bookmark:
+                    # the stream advanced since the last failure: a flap on
+                    # a moving watch, not a wedged endpoint — the streak
+                    # bound guards consecutive NO-PROGRESS failures only
+                    streak = 0
+                last_fail_bookmark = self.bookmark
+                streak += 1
+                self.reconnects += 1
+                obs.SYNC_RECONNECTS.inc()
+                if streak > self.max_flap_streak:
+                    raise
+                delay = max(sched[min(streak - 1, len(sched) - 1)],
+                            float(getattr(e, "retry_after", 0.0) or 0.0))
+                self.sleeps.append(delay)
+                self.sleep(delay)
+        return self.stats()
+
+    def start_thread(self, stop: threading.Event) -> threading.Thread:
+        t = threading.Thread(target=self.run, args=(stop,),
+                             name="watch-sync", daemon=True)
+        t.start()
+        return t
+
+    def stats(self) -> dict:
+        return {
+            "bookmark": self.bookmark,
+            "batches": self.batches,
+            "applied": self.applied,
+            "duplicates": self.duplicates,
+            "stale": self.stale,
+            "skipped": self.skipped,
+            "reconnects": self.reconnects,
+            "relists": self.relists,
+            "full_rebuilds": self.full_rebuilds,
+            "parity_mismatches": self.parity_mismatches,
+            "templates": self.interner.templates,
+            "template_hits": self.interner.hits,
+        }

@@ -247,6 +247,42 @@ SERVE_WAL_MISMATCHES = counter(
     "loudly rather than serving from doubted state (bench-gate "
     "MUST_BE_ZERO pin).")
 
+# -------------------------------------------------------------------- sync ----
+# simonsync (live/sync.py): resilient watch ingest keeping the resident
+# image consistent against an unreliable delta source.
+
+SYNC_EVENTS = counter(
+    "simon_sync_events_total",
+    "Watch events seen by the sync loop, by disposition. 'applied' rode a "
+    "delta batch into the image; 'duplicate' was already present (informer "
+    "cache semantics); 'stale' lost the per-(kind,name) resourceVersion "
+    "race; 'skipped' expressed no change the image tracks.",
+    ("outcome",))
+SYNC_RECONNECTS = counter(
+    "simon_sync_reconnects_total",
+    "Watch stream teardowns survived by reconnecting from the bookmark "
+    "with the seeded backoff schedule.")
+SYNC_RELISTS = counter(
+    "simon_sync_relists_total",
+    "410-Gone recoveries: the sync listed current state and reconciled it "
+    "against the resident stores via columnar diff, emitting only delta "
+    "events for the gap window.")
+SYNC_FULL_REBUILDS = counter(
+    "simon_sync_full_rebuilds_total",
+    "Relist reconciliations that found an inexpressible change and had to "
+    "fall back to a generation-bumping rebuild. Never nonzero in the chaos "
+    "gate's traces (bench-gate MUST_BE_ZERO pin).")
+SYNC_PARITY = counter(
+    "simon_sync_parity_mismatches_total",
+    "Post-reconcile parity failures: the resident image's node/pod sets "
+    "disagreed with the freshly listed state after applying the diff. "
+    "Never nonzero: reconciliation is exact by construction (bench-gate "
+    "MUST_BE_ZERO pin).")
+SYNC_BOOKMARK_RV = gauge(
+    "simon_sync_bookmark_rv",
+    "The resourceVersion high-water mark the watch would resume from "
+    "after a reconnect or restart.")
+
 # ------------------------------------------------------------------- sweep ----
 # simonsweep (sweep/): batched scenario sweeps — Monte-Carlo what-if fleets
 # coalesced onto the scenario axis of the sweep_*_fanout kernels.

@@ -42,6 +42,20 @@ the durable write so the on-disk valid prefix survives the failure:
   ingest_stall    the ingest admission edge (models an apiserver/watch
                   stall: serving flips to bounded-staleness degraded mode)
 
+simonsync watch-sync sites (live/sync.py) — the resumable watch loop and
+its relist-reconciliation recovery path; injections here must leave the
+resident image convergent (the chaos gate replays the same seeded plan
+twice and asserts identical traces AND identical final images):
+
+  watch_read      one chunked-watch line read (a dropped connection mid
+                  stream: the sync reconnects from its bookmark)
+  watch_parse     decoding one watch line (malformed JSON from the server;
+                  classified ProtocolError, the stream is torn down)
+  watch_gone      the server compacting away the client's resourceVersion
+                  (410 Gone: forces the relist-reconciliation path)
+  relist          the recovery list() call itself (relist must be retried
+                  with the same seeded backoff as the watch)
+
 Activation is process-global (`install_plan` / `clear_plan`): tests use the
 context manager form, the CLI wires `simon apply --fault-plan`, and the
 server exposes POST /debug/fault-plan. The no-plan fast path is a single
@@ -66,6 +80,8 @@ SITES: Tuple[str, ...] = (
     "watchdog_wedge", "oom_to_device", "oom_dispatch", "journal_write",
     # simonha crash-consistent-serving sites (serve/ha.py)
     "wal_write", "wal_fsync", "checkpoint_write", "ingest_stall",
+    # simonsync watch-sync sites (live/sync.py)
+    "watch_read", "watch_parse", "watch_gone", "relist",
 )
 
 ERROR_CLASSES: Tuple[str, ...] = ("runtime", "transient", "auth", "protocol")

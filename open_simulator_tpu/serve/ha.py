@@ -628,6 +628,12 @@ class HAState:
 
     # ----------------------------------------------------- degraded mode -----
 
+    def note_stall(self, reason: str) -> None:
+        """Public hook for delta-source gaps (watch-sync 410 windows, feed
+        outages): start the bounded-staleness clock without touching the
+        image. The next successful ingest marks healthy again."""
+        self._enter_degraded(reason)
+
     def _enter_degraded(self, reason: str) -> None:
         with self._mu:
             if self._degraded is None:

@@ -346,6 +346,36 @@ class ResidentImage:
         """Live (non-drained) node count."""
         return int(self.active[:self._sim.na.N].sum())
 
+    # ---------------------------------------------------------- sync view -----
+
+    def has_pod(self, key: str) -> bool:
+        """True when a committed pod with this "namespace/name" key is
+        resident. Watch-sync presence dedup: a re-delivered pod_add for a
+        resident key is a duplicate, not a new commit."""
+        with self._lock:
+            return key in self._pod_index
+
+    def node_state(self, name: str) -> str:
+        """"live" | "drained" | "absent" — the store's view of one node
+        name, without materializing the node object."""
+        with self._lock:
+            ni = self._sim.na.index.get(name)
+            if ni is None or ni >= self.active.shape[0]:
+                return "absent"
+            return "live" if bool(self.active[ni]) else "drained"
+
+    def sync_snapshot(self) -> Tuple[Dict[str, Optional[str]], set]:
+        """Columnar view for watch-sync relist reconciliation: a
+        ({pod_key: node_name}, {live node names}) pair read straight off the
+        index structures — no per-object dict materialization."""
+        with self._lock:
+            pods = {key: (pod.get("spec") or {}).get("nodeName")
+                    for key, (pod, _) in self._pod_index.items()}
+            na = self._sim.na
+            nodes = {name for name, i in na.index.items()
+                     if i < self.active.shape[0] and bool(self.active[i])}
+            return pods, nodes
+
     # ------------------------------------------------------------- ingest -----
 
     def apply_events(self, events: Sequence[dict]) -> dict:

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -170,6 +171,7 @@ class Server:
         tenant_rate: Optional[float] = None,
         ingest_max_bytes: Optional[int] = None,
         shed_seed: int = 0,
+        watch: Optional[str] = None,
     ) -> None:
         # /debug/fault-plan is a process-global WRITE endpoint (testing/CI):
         # never enabled by default on a production server. Opt in explicitly
@@ -260,6 +262,20 @@ class Server:
             else int(os.environ.get("OPEN_SIMULATOR_INGEST_MAX_BYTES",
                                     str(8 << 20))))
         self.shed_seed = shed_seed
+        # simonsync (live/sync.py): resilient watch ingest. --watch points
+        # the resident image at a delta source ("file:stream.jsonl", a
+        # chunked-HTTP watch URL — optionally "watch_url|list_url" so 410
+        # can relist — or "kube" for the kubeconfig cluster). Off by
+        # default; ingest then stays request-driven via /v1/ingest.
+        if watch is None:
+            watch = os.environ.get("OPEN_SIMULATOR_WATCH") or None
+        self.watch_spec = watch
+        self._kubeconfig = kubeconfig
+        self._master = master
+        self._syncs: List = []
+        self._sync_threads: List[threading.Thread] = []
+        self._sync_stop = threading.Event()
+        self._sync_errors: List[str] = []
         self._ha = None
         self._ingest_bytes = 0  # in-flight /v1/ingest payload bytes
         self._ingest_bytes_lock = threading.Lock()
@@ -414,6 +430,61 @@ class Server:
                     fanout=self.whatif_fanout, admission=admission)
             return self._whatif_svc
 
+    def start_watch(self) -> bool:
+        """Start the simonsync watch loop(s) against `watch_spec`, feeding
+        the resident image (through the HA WAL when --state-dir is on).
+        Returns False when serving is off or the image declined."""
+        if not self.watch_spec or not self.whatif:
+            return False
+        svc = self.whatif_service()
+        if svc is None:
+            return False
+        with self._whatif_lock:
+            ha = self._ha
+        from ..live import (HttpWatchSource, RecordedSource, WatchSync,
+                            kube_watch_sources)
+
+        spec = self.watch_spec
+        if spec.startswith("file:"):
+            sources = [RecordedSource(path=spec[len("file:"):])]
+        elif spec == "kube":
+            from ..simulator.live import create_kube_client
+
+            sources = kube_watch_sources(
+                create_kube_client(self._kubeconfig, self._master))
+        elif "|" in spec:
+            watch_url, list_url = spec.split("|", 1)
+            sources = [HttpWatchSource(watch_url, list_url=list_url)]
+        else:
+            sources = [HttpWatchSource(spec)]
+        image = None if ha is not None else svc.image
+        for i, src in enumerate(sources):
+            sync = WatchSync(src, image=image, ha=ha,
+                             state_dir=None if ha else self.state_dir,
+                             name=f"src{i}" if len(sources) > 1 else "")
+            self._syncs.append(sync)
+
+            def _run(s=sync):
+                try:
+                    s.run(self._sync_stop)
+                except Exception as e:  # noqa: BLE001 — surfaced via stats
+                    self._sync_errors.append(f"{type(e).__name__}: {e}")
+                    print(f"watch-sync died: {type(e).__name__}: {e}",
+                          file=sys.stderr)
+
+            t = threading.Thread(target=_run, name="watch-sync", daemon=True)
+            t.start()
+            self._sync_threads.append(t)
+        return True
+
+    def sync_stats(self) -> Optional[dict]:
+        if not self._syncs:
+            return None
+        out: dict = {"sources": [s.stats() for s in self._syncs]}
+        if self._sync_errors:
+            out["errors"] = list(self._sync_errors)
+        return out
+
     def handle_whatif(self, req: dict) -> Tuple[int, object]:
         """POST /v1/whatif: probe one what-if against the resident cluster
         image. Request: {"pods": [...], "deployments": [...],
@@ -548,6 +619,8 @@ class Server:
         self._t_start = time.time()
         httpd = self.build_httpd(port, host)
         self.install_sigterm_handler(drain_deadline)
+        if self.watch_spec:
+            self.start_watch()
         print(f"simon server listening on :{port}")
         httpd.serve_forever()
 
@@ -604,6 +677,11 @@ class Server:
                     break
                 self._state_cv.wait(timeout=min(left, 0.1))
             stranded = self._inflight
+        # the watch loops stop BEFORE the HA WAL closes: a sync mid-flush
+        # must not race a closed WAL handle
+        self._sync_stop.set()
+        for t in self._sync_threads:
+            t.join(timeout=2.0)
         # read under the init lock: a request that won admission just before
         # _draining flipped may still be lazily creating the service; the
         # lock orders that creation before this read so its dispatcher is
@@ -854,6 +932,9 @@ class Server:
                     stats = svc.stats()
                     if server._ha is not None:
                         stats["ha"] = server._ha.stats()
+                    sync = server.sync_stats()
+                    if sync is not None:
+                        stats["sync"] = sync
                     sc = scope_mod.active() if server.scope else None
                     if sc is not None:
                         from ..obs import instruments as obs_i

@@ -6,6 +6,8 @@ stress with taints + affinities) without copying any reference fixture files.
 
 from __future__ import annotations
 
+import json
+import random
 from typing import List, Optional, Tuple
 
 
@@ -132,6 +134,118 @@ def synth_cluster(
                 pods.append(synth_pod(idx, labels={"app": app}))
         k += 1
     return nodes, pods
+
+
+def synth_watch_stream(
+    n_nodes: int,
+    n_events: int,
+    seed: int = 0,
+    bookmark_every: int = 64,
+    n_bound: int = 0,
+    n_templates: int = 8,
+    start_rv: int = 1000,
+) -> Tuple[List[dict], List[dict], List[str]]:
+    """A deterministic recorded kube-watch stream over a synthetic cluster:
+    (initial nodes, initially bound pods, JSONL watch lines).
+
+    The stream is churn the resident-image delta path can express end to
+    end — bound-pod ADDED/DELETED from a small template pool (so decode's
+    template interning has something to intern), occasional node ADDED and
+    drain (MODIFIED with spec.unschedulable) — delimited by BOOKMARK lines
+    every `bookmark_every` events. resourceVersions are globally monotone;
+    deletes only target pods committed before the current window so a
+    window's net effect is never a wash (the chaos gate's relist windows
+    stay meaningful). Drains evict their pods from the generator's own
+    live-set, mirroring the image's node_drain semantics.
+    """
+    rng = random.Random(seed)
+    nodes = [synth_node(i) for i in range(n_nodes)]
+    live_nodes = [f"node-{i:05d}" for i in range(n_nodes)]
+
+    bound: List[dict] = []
+    pods_by_node: dict = {name: set() for name in live_nodes}
+    live_pods: dict = {}  # key -> node name
+    for i in range(n_bound):
+        p = synth_pod(i, cpu_milli=100 + 50 * (i % n_templates),
+                      labels={"app": f"seed-{i % n_templates}"})
+        node = live_nodes[i % len(live_nodes)]
+        p["spec"]["nodeName"] = node
+        bound.append(p)
+        key = f"default/{p['metadata']['name']}"
+        live_pods[key] = node
+        pods_by_node[node].add(key)
+
+    def _line(typ: str, obj: dict) -> str:
+        return json.dumps({"type": typ, "object": obj},
+                          separators=(",", ":"))
+
+    lines: List[str] = []
+    rv = start_rv
+    next_node_i = n_nodes
+    next_pod_i = 0
+    # pods eligible for deletion: committed before the current window
+    deletable = sorted(live_pods)
+    in_window = 0
+
+    for _ in range(n_events):
+        rv += 1
+        r = rng.random()
+        if r < 0.04 and len(live_nodes) > max(2, n_nodes // 2):
+            # drain one node; its pods leave the cluster with it
+            name = live_nodes.pop(rng.randrange(len(live_nodes)))
+            for key in pods_by_node.pop(name, ()):
+                live_pods.pop(key, None)
+            deletable = [k for k in deletable if k in live_pods]
+            obj = synth_node(int(name.split("-")[-1]))
+            obj["spec"]["unschedulable"] = True
+            obj["metadata"]["resourceVersion"] = str(rv)
+            lines.append(_line("MODIFIED", obj))
+        elif r < 0.07:
+            obj = synth_node(next_node_i)
+            name = obj["metadata"]["name"]
+            next_node_i += 1
+            live_nodes.append(name)
+            pods_by_node[name] = set()
+            obj["metadata"]["resourceVersion"] = str(rv)
+            lines.append(_line("ADDED", obj))
+        elif r < 0.27 and deletable:
+            key = deletable.pop(rng.randrange(len(deletable)))
+            node = live_pods.pop(key, None)
+            if node is not None:
+                pods_by_node.get(node, set()).discard(key)
+            ns, name = key.split("/", 1)
+            lines.append(_line("DELETED", {
+                "kind": "Pod", "apiVersion": "v1",
+                "metadata": {"name": name, "namespace": ns,
+                             "resourceVersion": str(rv)}}))
+        else:
+            t = rng.randrange(n_templates)
+            p = synth_pod(0, cpu_milli=100 + 50 * t,
+                          labels={"app": f"stream-{t}"})
+            name = f"wpod-{next_pod_i:06d}"
+            next_pod_i += 1
+            node = live_nodes[rng.randrange(len(live_nodes))]
+            p["metadata"]["name"] = name
+            p["metadata"]["resourceVersion"] = str(rv)
+            p["kind"] = "Pod"
+            p["spec"]["nodeName"] = node
+            key = f"default/{name}"
+            live_pods[key] = node
+            pods_by_node[node].add(key)
+            lines.append(_line("ADDED", p))
+        in_window += 1
+        if in_window >= bookmark_every:
+            rv += 1
+            lines.append(_line("BOOKMARK", {
+                "kind": "Pod",
+                "metadata": {"resourceVersion": str(rv)}}))
+            deletable = sorted(live_pods)
+            in_window = 0
+    if in_window:
+        rv += 1
+        lines.append(_line("BOOKMARK", {
+            "kind": "Pod", "metadata": {"resourceVersion": str(rv)}}))
+    return nodes, bound, lines
 
 
 def synth_cluster_store(

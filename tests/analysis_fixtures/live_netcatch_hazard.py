@@ -1,0 +1,120 @@
+"""Fixture for the unclassified-network-error rule (NEVER imported — AST
+only). The `live` basename puts this module in the rule's scope. The
+findings half catches network errors without routing them to the typed
+taxonomy (AuthError / TransientError / ProtocolError); the waived half is
+a genuinely non-network OSError with its reason; the clean half routes
+every catch — typed raise, bare re-raise, aliased taxonomy import — and a
+non-network except stays out of scope."""
+
+import http.client
+import socket
+import urllib.error
+from urllib.error import HTTPError
+
+
+class AuthError(Exception):
+    pass
+
+
+class TransientError(Exception):
+    pass
+
+
+class ProtocolError(Exception):
+    pass
+
+
+from simulator.live import ProtocolError as ProtoErr  # noqa: E402
+
+
+# --------------------------------------------------------------- findings ----
+
+
+def swallowed_read(conn):
+    try:
+        return conn.read()
+    except OSError:  # FINDING: dropped connection becomes a silent None
+        return None
+
+
+def logged_not_routed(url, log):
+    try:
+        return url.open()
+    except urllib.error.URLError as e:  # FINDING: logging is not routing
+        log.warning("open failed: %s", e)
+
+
+def tuple_of_resets(sock):
+    try:
+        return sock.recv(4096)
+    except (socket.timeout, ConnectionResetError):  # FINDING: tuple catch
+        pass
+
+
+def http_exception_continue(resp):
+    for _ in range(3):
+        try:
+            return resp.getheaders()
+        except http.client.HTTPException:  # FINDING: retry loop bypasses policy
+            continue
+
+
+def wrong_taxonomy(client):
+    try:
+        return client.get("/api/v1/nodes")
+    except HTTPError as e:  # FINDING: ValueError is not a taxonomy class
+        raise ValueError(f"bad response: {e}")
+
+
+# ------------------------------------------------------------------ waived ----
+
+
+def read_bookmark(path):
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read()
+    # simonlint: ignore[unclassified-network-error] -- local bookmark file
+    # read, not a network path: a missing file means a cold start
+    except OSError:
+        return None
+
+
+# -------------------------------------------------------------------- clean ----
+
+
+def routed_transient(sock):
+    try:
+        return sock.recv(4096)
+    except (OSError, http.client.HTTPException) as e:
+        raise TransientError(f"recv failed: {e}") from e
+
+
+def routed_auth(resp):
+    try:
+        return resp.read()
+    except urllib.error.HTTPError as e:
+        if e.code in (401, 403):
+            raise AuthError(str(e)) from e
+        raise TransientError(str(e)) from e
+
+
+def reraised(conn):
+    try:
+        return conn.read()
+    except ConnectionResetError:
+        conn.close()
+        raise
+
+
+def routed_via_alias(conn):
+    try:
+        return conn.getresponse()
+    except OSError as e:
+        raise ProtoErr(f"connection in a bad state: {e}") from e
+
+
+def non_network_is_out_of_scope(blob):
+    try:
+        return int(blob)
+    except (TypeError, ValueError):
+        return 0

@@ -461,6 +461,43 @@ def test_suppression_reason_not_covered_by_star():
     assert is_suppressed(f, supp)  # explicit (reasoned) waiver still works
 
 
+# ----------------------------------- simonsync: unclassified-network-error --
+
+
+def test_unclassified_network_error_rule_fires():
+    # five unrouted network catches fire; the bookmark-file waiver reports
+    # suppressed, not active
+    assert _counts("live_netcatch_hazard.py",
+                   "unclassified-network-error") == 5
+    assert _counts("live_netcatch_hazard.py", "unclassified-network-error",
+                   suppressed=True) == 1
+
+
+def test_unclassified_network_error_scoped_to_live_modules(tmp_path):
+    # the identical handlers outside a live path are out of scope — the
+    # taxonomy discipline fences live-cluster code only
+    mod = tmp_path / "batch_loader.py"
+    mod.write_text((FIXTURES / "live_netcatch_hazard.py").read_text())
+    fr = analyze_file(str(mod))
+    assert not any(f.rule == "unclassified-network-error"
+                   for f in fr.findings)
+
+
+def test_unclassified_network_error_real_live_tier_routes():
+    # the shipping live tier must stay compliant: every network catch in
+    # simulator/live.py and live/ routes to the typed taxonomy (or carries
+    # a reasoned non-network waiver)
+    targets = [PACKAGE / "simulator" / "live.py",
+               *sorted((PACKAGE / "live").glob("*.py"))]
+    for target in targets:
+        fr = analyze_file(str(target))
+        assert fr.error is None
+        active = [f for f in fr.findings
+                  if f.rule == "unclassified-network-error"
+                  and not f.suppressed]
+        assert not active, f"{target}: {[f.line for f in active]}"
+
+
 # --------------------------------------------------- registry self-test --
 
 

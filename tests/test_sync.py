@@ -1,0 +1,434 @@
+"""simonsync: resilient live-cluster watch sync (live/sync.py, live/decode.py).
+
+The contract under test (README "Live sync", ISSUE PR 20):
+
+- **Chaos convergence.** A seeded chaos run — connection flaps, duplicate
+  deliveries, in-window reorders, 410-Gone compactions — converges to an
+  image bit-identical to the flap-free replay of the same recorded stream:
+  same host truth, same epoch lineage (one seq per bookmark window, relist
+  windows included), same what-if answers, zero full rebuilds.
+- **Exactly-once apply.** Three dedup layers (bookmark stale filter,
+  per-(kind,key) resourceVersion table, presence probe against the resident
+  image with per-batch staging) make redelivery and reorder no-ops; batches
+  apply sorted by rv at server-declared safe points only.
+- **Relist reconciliation.** A compacted horizon (410) recovers by listing
+  current state and diffing it columnar against the resident image —
+  delta events only, one batch, never a generation-bumping rebuild — and
+  the reconciled image equals a from-scratch build over the listed state.
+- **Crash-exact resume.** Every applied batch rides the simonha WAL behind
+  a prev/next/expected-seq bookmark stamp written before the apply, so a
+  SIGKILL anywhere resumes from (checkpoint + WAL tail + bookmark) without
+  double-applying or dropping a window.
+- **Deterministic recovery.** Reconnect backoff comes from the seeded
+  RetryPolicy schedule: the same fault plan replays the same sleeps and the
+  same injection trace (the simonfault contract, sites watch_read /
+  watch_parse / watch_gone / relist).
+"""
+
+import json
+
+import pytest
+
+from open_simulator_tpu.live import (
+    ProtocolError,
+    QueueSource,
+    RecordedSource,
+    ScriptedSource,
+    TemplateInterner,
+    WatchSync,
+    parse_line,
+)
+from open_simulator_tpu.resilience import FaultPlan, installed
+from open_simulator_tpu.serve import HAState, ResidentImage
+from open_simulator_tpu.server.http import ClusterSnapshot, Server
+from open_simulator_tpu.core.types import ResourceTypes
+from open_simulator_tpu.utils.synth import synth_node, synth_watch_stream
+
+from test_serve import assert_same_response, whatif_pods
+
+CHAOS = dict(flap_p=0.02, dup_p=0.05, reorder_p=0.05, gone_p=0.25)
+
+
+def _stream(n_nodes=40, n_events=300, seed=7, bookmark_every=24, n_bound=30):
+    return synth_watch_stream(n_nodes, n_events, seed=seed,
+                              bookmark_every=bookmark_every, n_bound=n_bound)
+
+
+def _image(nodes, bound):
+    img = ResidentImage.try_build(
+        [json.loads(json.dumps(n)) for n in nodes],
+        pods=[json.loads(json.dumps(p)) for p in bound])
+    assert img is not None
+    return img
+
+
+def _truth(image):
+    pods, live = image.sync_snapshot()
+    return json.dumps({"pods": sorted(pods.items()), "nodes": sorted(live)},
+                      sort_keys=True)
+
+
+def _oracle(nodes, bound, lines):
+    """The flap-free replay every chaos run must converge to."""
+    img = _image(nodes, bound)
+    stats = WatchSync(RecordedSource(lines=lines), image=img).run()
+    return img, stats
+
+
+def _line(typ, obj):
+    return json.dumps({"type": typ, "object": obj})
+
+
+def _pod_line(typ, name, rv, node="node-00000", ns="default"):
+    return _line(typ, {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns,
+                     "resourceVersion": str(rv)},
+        "spec": {"nodeName": node,
+                 "containers": [{"name": "app", "resources": {
+                     "requests": {"cpu": "100m", "memory": "64Mi"}}}]}})
+
+
+def _bookmark(rv):
+    return _line("BOOKMARK", {"kind": "Pod",
+                              "metadata": {"resourceVersion": str(rv)}})
+
+
+# ------------------------------------------------------- chaos convergence ----
+
+
+@pytest.mark.parametrize("seed", [3, 11, 42])
+def test_chaos_convergence_bit_identical(seed):
+    """The acceptance oracle: flaps + 410s + duplicates + reorders converge
+    to the flap-free image — host truth, epoch lineage, zero rebuilds."""
+    nodes, bound, lines = _stream()
+    oracle, _ = _oracle(nodes, bound, lines)
+
+    img = _image(nodes, bound)
+    src = ScriptedSource(lines, seed=seed, base_nodes=nodes,
+                         base_pods=bound, **CHAOS)
+    sync = WatchSync(src, image=img, sleep=lambda s: None)
+    stats = sync.run()
+
+    assert src.gones_planned or src.flaps_planned  # chaos actually planned
+    assert _truth(img) == _truth(oracle)
+    assert img.epoch == oracle.epoch
+    assert img.generation == 1 and stats["full_rebuilds"] == 0
+    assert stats["parity_mismatches"] == 0
+
+
+def test_chaos_whatif_answers_match_oracle():
+    """Host truth converging is necessary; the serving answer converging is
+    the point. The chaos image answers what-ifs identically to the
+    flap-free image AND to its own serial fresh-encode probe."""
+    nodes, bound, lines = _stream()
+    oracle, _ = _oracle(nodes, bound, lines)
+    img = _image(nodes, bound)
+    WatchSync(ScriptedSource(lines, seed=11, base_nodes=nodes,
+                             base_pods=bound, **CHAOS),
+              image=img, sleep=lambda s: None).run()
+    for tag, n in (("a", 4), ("b", 7)):
+        req = whatif_pods(tag, n)
+        got = img.session(req).run()
+        assert_same_response(got, oracle.fresh_probe(req))
+        assert_same_response(got, img.fresh_probe(req))
+
+
+# ------------------------------------------------------------ dedup layers ----
+
+
+def test_duplicate_rv_delivery_applies_once():
+    nodes = [synth_node(0)]
+    img = _image(nodes, [])
+    lines = [_pod_line("ADDED", "p-1", 5),
+             _pod_line("ADDED", "p-1", 5),  # wire redelivery, same rv
+             _bookmark(6)]
+    stats = WatchSync(RecordedSource(lines=lines), image=img).run()
+    assert stats["applied"] == 1 and stats["duplicates"] == 1
+    assert img.has_pod("default/p-1")
+
+
+def test_presence_dedup_is_per_batch_staged():
+    """add -> delete -> re-add of one key inside a single window must stage
+    through (final state present), while a re-add of an already-resident
+    pod with a fresh rv is recognized as a presence duplicate."""
+    nodes = [synth_node(0)]
+    img = _image(nodes, [])
+    lines = [_pod_line("ADDED", "p-1", 5),
+             _pod_line("DELETED", "p-1", 6),
+             _pod_line("ADDED", "p-1", 7),
+             _bookmark(8),
+             _pod_line("ADDED", "p-1", 9),  # new rv, but already resident
+             _bookmark(10)]
+    stats = WatchSync(RecordedSource(lines=lines), image=img).run()
+    assert img.has_pod("default/p-1")
+    assert stats["applied"] == 3  # the staged add/delete/add all land
+    assert stats["duplicates"] == 1  # the post-bookmark re-add is presence-deduped
+
+
+def test_out_of_order_window_applies_sorted():
+    """Wire reorder inside a window never changes the applied order: the
+    batch sorts by rv, so delete-then-add arriving as add-then-delete
+    still nets to the rv-ordered outcome."""
+    nodes = [synth_node(0)]
+    base_pod = _pod_line("ADDED", "p-1", 5)
+    inorder = [base_pod, _bookmark(6),
+               _pod_line("DELETED", "p-1", 7),
+               _pod_line("ADDED", "p-1", 8),
+               _bookmark(9)]
+    reordered = [base_pod, _bookmark(6),
+                 _pod_line("ADDED", "p-1", 8),
+                 _pod_line("DELETED", "p-1", 7),
+                 _bookmark(9)]
+    img_a = _image(nodes, [])
+    img_b = _image(nodes, [])
+    WatchSync(RecordedSource(lines=inorder), image=img_a).run()
+    WatchSync(RecordedSource(lines=reordered), image=img_b).run()
+    assert _truth(img_a) == _truth(img_b)
+    assert img_a.epoch == img_b.epoch
+    assert img_a.has_pod("default/p-1")
+
+
+def test_stale_replay_before_bookmark_filtered():
+    """Reconnecting a source that replays from before our bookmark (the
+    recorded-stream shape) drops everything at-or-under the bookmark."""
+    nodes = [synth_node(0)]
+    img = _image(nodes, [])
+    lines = [_pod_line("ADDED", "p-1", 5), _bookmark(6)]
+    sync = WatchSync(RecordedSource(lines=lines), image=img)
+    sync.run()
+    assert sync.bookmark == 6
+    seq0 = img.seq
+    stats = WatchSync.run(sync)  # second pass over the same recorded lines
+    assert stats["stale"] >= 1 and img.seq == seq0
+    assert stats["applied"] == 1  # nothing new applied beyond the first run
+
+
+def test_skip_only_window_advances_bookmark_without_seq():
+    """A window whose events all decode to skips (unbound pods) advances
+    the bookmark but never bumps the epoch — bookmark-only persistence."""
+    nodes = [synth_node(0)]
+    img = _image(nodes, [])
+    unbound = _line("ADDED", {
+        "kind": "Pod", "metadata": {"name": "ghost", "namespace": "default",
+                                    "resourceVersion": "5"},
+        "spec": {}})
+    seq0 = img.seq
+    sync = WatchSync(RecordedSource(lines=[unbound, _bookmark(6)]), image=img)
+    stats = sync.run()
+    assert stats["skipped"] == 1 and stats["applied"] == 0
+    assert sync.bookmark == 6 and img.seq == seq0
+
+
+# ------------------------------------------------------ relist reconcile ----
+
+
+def test_relist_reconcile_equals_from_scratch_rebuild():
+    """Doctored gaps: every eligible window is compacted away (gone_p=1),
+    forcing relist after relist. The reconciled image must equal a
+    from-scratch build over the source's listed state — with generation 1
+    (delta events only, never a rebuild)."""
+    nodes, bound, lines = _stream(n_events=240, seed=9)
+    img = _image(nodes, bound)
+    src = ScriptedSource(lines, seed=4, gone_p=1.0, base_nodes=nodes,
+                         base_pods=bound)
+    sync = WatchSync(src, image=img, sleep=lambda s: None)
+    stats = sync.run()
+    assert stats["relists"] >= 1
+    assert stats["full_rebuilds"] == 0 and img.generation == 1
+    assert stats["parity_mismatches"] == 0
+
+    final_rv, listed_nodes, listed_pods = src.list()
+    # try_build commits every node it is handed; the listed state carries
+    # drained nodes as spec.unschedulable markers, so drop them here
+    live_only = [n for n in listed_nodes
+                 if not (n.get("spec") or {}).get("unschedulable")]
+    fresh = _image(live_only, listed_pods)
+    pods_a, live_a = img.sync_snapshot()
+    pods_b, live_b = fresh.sync_snapshot()
+    assert (sorted(pods_a.items()), sorted(live_a)) == (
+        sorted(pods_b.items()), sorted(live_b))
+
+
+def test_relist_gap_costs_exactly_one_seq():
+    """Epoch parity through a gap: the reconcile batch costs exactly the
+    seq the swallowed window would have — chaos epoch == clean epoch."""
+    nodes, bound, lines = _stream(n_events=120, seed=13)
+    oracle, _ = _oracle(nodes, bound, lines)
+    img = _image(nodes, bound)
+    src = ScriptedSource(lines, seed=2, gone_p=1.0, base_nodes=nodes,
+                         base_pods=bound)
+    stats = WatchSync(src, image=img, sleep=lambda s: None).run()
+    assert stats["relists"] >= 1
+    assert img.epoch == oracle.epoch
+    assert _truth(img) == _truth(oracle)
+
+
+# -------------------------------------------------- crash-exact resume ----
+
+
+class _KillAfter:
+    """Source wrapper that raises mid-stream after n lines — the in-process
+    stand-in for SIGKILL (tools/sync_smoke.py kills a real process)."""
+
+    class Boom(BaseException):
+        pass
+
+    def __init__(self, inner, n):
+        self.inner, self.n, self.count = inner, n, 0
+
+    def watch(self, since_rv):
+        for line in self.inner.watch(since_rv):
+            self.count += 1
+            if self.count > self.n:
+                raise self.Boom()
+            yield line
+
+    def list(self):
+        return self.inner.list()
+
+
+@pytest.mark.parametrize("seed,kill_at", [(7, 40), (23, 130), (101, 201)])
+def test_sigkill_resume_bit_identity(seed, kill_at, tmp_path):
+    """Kill the consumer mid-stream (WAL and bookmark left wherever the
+    crash caught them), reopen the state dir, resume from
+    (checkpoint + WAL tail + bookmark), and require the final image be
+    bit-identical to the never-crashed chaos-free oracle."""
+    nodes, bound, lines = _stream(n_nodes=30, n_events=240, seed=5,
+                                  bookmark_every=20, n_bound=20)
+    oracle, _ = _oracle(nodes, bound, lines)
+
+    def build():
+        return _image(nodes, bound)
+
+    ha1 = HAState.open(str(tmp_path), build, checkpoint_every=4)
+    src = ScriptedSource(lines, seed=seed, base_nodes=nodes,
+                         base_pods=bound, **CHAOS)
+    sync1 = WatchSync(_KillAfter(src, kill_at), ha=ha1,
+                      sleep=lambda s: None)
+    with pytest.raises(_KillAfter.Boom):
+        sync1.run()
+    # crash: abandon ha1 unclosed; reopen replays checkpoint + WAL tail
+    ha2 = HAState.open(str(tmp_path), build, checkpoint_every=4)
+    sync2 = WatchSync(src, ha=ha2, sleep=lambda s: None)
+    stats = sync2.run()
+    assert _truth(ha2.image) == _truth(oracle)
+    assert ha2.image.epoch == oracle.epoch
+    assert stats["full_rebuilds"] == 0 and stats["parity_mismatches"] == 0
+    ha2.close()
+
+
+# ------------------------------------------------ deterministic recovery ----
+
+
+def test_reconnect_backoff_is_bit_replayable():
+    """Two fresh consumers over identically-seeded flapping sources sleep
+    the exact same schedule — recovery is part of the replayable run."""
+    nodes, bound, lines = _stream(n_events=160, seed=17)
+    sleeps = []
+    for _ in range(2):
+        img = _image(nodes, bound)
+        src = ScriptedSource(lines, seed=21, flap_p=0.12,
+                             base_nodes=nodes, base_pods=bound)
+        sync = WatchSync(src, image=img, sleep=lambda s: None)
+        sync.run()
+        sleeps.append(list(sync.sleeps))
+    assert sleeps[0], "no flap fired — chaos knob lost its bite"
+    assert sleeps[0] == sleeps[1]
+
+
+def test_fault_sites_replay_equal(tmp_path):
+    """Every simonsync fault site, injected twice with the same plan, fires
+    the same trace and still converges to the oracle (the simonfault
+    contract extended to the watch path)."""
+    nodes, bound, lines = _stream(n_events=120, seed=19)
+    oracle, _ = _oracle(nodes, bound, lines)
+    for site, error in (("watch_read", "transient"),
+                        ("watch_parse", "transient"),
+                        ("watch_gone", "protocol"),
+                        ("relist", "transient")):
+        traces = []
+        for rep in range(2):
+            img = _image(nodes, bound)
+            # the relist site only runs inside 410 recovery, so its fault
+            # plan rides a source whose windows actually compact away
+            src = ScriptedSource(
+                lines, seed=1, base_nodes=nodes, base_pods=bound,
+                gone_p=1.0 if site == "relist" else 0.0)
+            sync = WatchSync(src, image=img, sleep=lambda s: None)
+            plan = FaultPlan.from_json({"faults": [
+                {"site": site, "attempt": 2, "error": error}]})
+            with installed(plan) as active:
+                stats = sync.run()
+                traces.append(list(active.trace))
+            assert _truth(img) == _truth(oracle), site
+            assert stats["full_rebuilds"] == 0, site
+            if site in ("watch_gone", "relist"):
+                assert stats["relists"] >= 1, site
+            else:
+                assert stats["reconnects"] >= 1, site
+        assert traces[0] == traces[1], site
+        assert traces[0], site  # the site actually fired
+
+
+# ----------------------------------------------------- decode unit layer ----
+
+
+def test_parse_line_typed_errors():
+    with pytest.raises(ProtocolError):
+        parse_line("{not json")
+    with pytest.raises(ProtocolError):
+        parse_line(json.dumps({"type": "FROBNICATED", "object": {}}))
+    with pytest.raises(ProtocolError) as ei:
+        parse_line(json.dumps({"type": "ERROR", "object": {
+            "code": 410, "message": "too old resource version"}}))
+    assert ei.value.code == 410
+
+
+def test_template_interner_shares_subtrees_not_identity():
+    """Interned pods share labels/spec template blocks (dict-free decode)
+    but stay distinct top-level objects — the image's identity-keyed
+    bookkeeping (`id(pod)`) must never see aliased pods."""
+    interner = TemplateInterner()
+    raw = json.loads(_pod_line("ADDED", "p-1", 5))["object"]
+    raw2 = json.loads(_pod_line("ADDED", "p-2", 6))["object"]
+    a, b = interner.pod(raw), interner.pod(raw2)
+    assert a is not b
+    assert a["metadata"]["labels"] is b["metadata"]["labels"]
+    assert interner.hits >= 1
+
+
+def test_queue_source_backpressure_bound():
+    q = QueueSource(maxsize=2)
+    q.push("a")
+    q.push("b")
+    assert q._q.full()  # a stalled consumer back-pressures the producer
+
+
+# -------------------------------------------------------- server wiring ----
+
+
+def test_server_start_watch_feeds_resident_image(tmp_path):
+    """`--watch file:PATH` end to end: the server starts a WatchSync over
+    the recorded stream, the resident image converges to the flap-free
+    oracle, and /v1/serve/stats carries the sync stats block."""
+    nodes, bound, lines = _stream(n_nodes=12, n_events=80,
+                                  bookmark_every=16, n_bound=8)
+    oracle, _ = _oracle(nodes, bound, lines)
+    rec = tmp_path / "stream.jsonl"
+    rec.write_text("\n".join(lines) + "\n")
+
+    rt = ResourceTypes(nodes=[json.loads(json.dumps(n)) for n in nodes],
+                       pods=[json.loads(json.dumps(p)) for p in bound])
+    snap = ClusterSnapshot(rt, [], [], [])
+    server = Server(snapshot_fn=lambda: snap, whatif=True,
+                    watch=f"file:{rec}")
+    assert server.start_watch()
+    for t in server._sync_threads:
+        t.join(timeout=30.0)
+    stats = server.sync_stats()
+    assert stats and not stats.get("errors")
+    assert stats["sources"][0]["applied"] > 0
+    img = server.whatif_service().image
+    assert _truth(img) == _truth(oracle)
+    assert img.epoch == oracle.epoch

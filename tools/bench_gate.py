@@ -96,6 +96,12 @@ MUST_BE_ZERO = (
     # correctness failure no baseline can excuse
     "simon_serve_wrong_epoch_answers_total",
     "simon_serve_wal_parity_mismatches_total",
+    # simonsync (PR 20): the resident image diverging from the listed
+    # cluster after a relist, or a watch gap degrading into a
+    # generation-bumping full rebuild, breaks the delta-only convergence
+    # contract — the chaos gate proves both stay zero under injected faults
+    "simon_sync_parity_mismatches_total",
+    "simon_sync_full_rebuilds_total",
 )
 
 # jax-version-dependent families excluded from the baseline diff (see

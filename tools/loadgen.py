@@ -153,25 +153,72 @@ def run_loadgen(args) -> dict:
         return sum(counts), time.perf_counter() - t_run, lat
 
     churn_stop = threading.Event()
+    churn_direct = bool(getattr(args, "churn_direct", False))
+    churn_q = None
+    churn_sync = None
+    churn_sync_thread = None
 
-    def churner() -> None:
-        """Mid-run ingest deltas: pod churn while serving (the live-cluster
-        shape). Low rate — the point is correctness under churn, measured
-        throughput stays a serving number."""
+    def _churn_delta(i: int):
+        """One churn step's (added pod, deleted name|None) — shared by both
+        drive modes so the A/B compares paths, not workloads."""
         from open_simulator_tpu.utils.synth import synth_pod
 
+        pod = synth_pod(900000 + i, labels={"app": "churn"})
+        pod["spec"]["nodeName"] = f"node-{i % args.nodes:05d}"
+        deleted = f"pod-{900000 + i - 4:06d}" if i > 4 else None
+        return pod, deleted
+
+    def churner_direct() -> None:
+        """Legacy mid-run churn: hand-built ingest deltas applied straight
+        to the image (--churn-direct, kept as the A/B reference for the
+        watch-path mode below)."""
         i = 0
         while not churn_stop.wait(0.25):
             i += 1
-            pod = synth_pod(900000 + i, labels={"app": "churn"})
-            pod["spec"]["nodeName"] = f"node-{i % args.nodes:05d}"
+            pod, deleted = _churn_delta(i)
             image.apply_events([
                 {"type": "pod_add", "pod": pod}] + ([
                     {"type": "pod_delete", "namespace": "default",
-                     "name": f"pod-{900000 + i - 4:06d}"}] if i > 4 else []))
+                     "name": deleted}] if deleted else []))
 
-    ch = threading.Thread(target=churner, daemon=True)
+    def churner_watch() -> None:
+        """Default mid-run churn: the same deltas as watch JSON lines (with
+        monotone resourceVersions and a BOOKMARK safe point per burst)
+        pushed into a QueueSource; the WatchSync thread decodes, dedups,
+        and applies them — churn exercises the production live-sync ingest
+        path, not a hand-rolled shortcut."""
+        rv = 10_000_000
+        i = 0
+        while not churn_stop.wait(0.25):
+            i += 1
+            pod, deleted = _churn_delta(i)
+            rv += 1
+            pod["kind"] = "Pod"
+            pod["metadata"]["resourceVersion"] = str(rv)
+            lines = [json.dumps({"type": "ADDED", "object": pod})]
+            if deleted:
+                rv += 1
+                lines.append(json.dumps({"type": "DELETED", "object": {
+                    "kind": "Pod", "metadata": {
+                        "name": deleted, "namespace": "default",
+                        "resourceVersion": str(rv)}}}))
+            rv += 1
+            lines.append(json.dumps({"type": "BOOKMARK", "object": {
+                "kind": "Pod",
+                "metadata": {"resourceVersion": str(rv)}}}))
+            for ln in lines:
+                churn_q.push(ln)
+
+    ch = threading.Thread(
+        target=churner_direct if churn_direct else churner_watch,
+        daemon=True)
     if args.churn:
+        if not churn_direct:
+            from open_simulator_tpu.live import QueueSource, WatchSync
+
+            churn_q = QueueSource()
+            churn_sync = WatchSync(churn_q, image=image)
+            churn_sync_thread = churn_sync.start_thread(churn_stop)
         ch.start()
     # the MEASURED window runs with simonscope OFF: the serve_whatif_rps
     # row stays comparable across PRs, and the scoped window below reports
@@ -186,6 +233,21 @@ def run_loadgen(args) -> dict:
     batches = int(REGISTRY.values().get("simon_serve_batches_total", 0)
                   - batches0)
     churn_stop.set()
+    churn_cols: dict = {}
+    if args.churn:
+        ch.join(timeout=5.0)
+        if churn_sync is not None:
+            # drain: close the queue (sentinel) and wait for the sync
+            # thread to flush its last bookmark-batched apply — the parity
+            # sample below must see the fully-applied image
+            churn_q.close()
+            churn_sync_thread.join(timeout=10.0)
+            st = churn_sync.stats()
+            churn_cols = {"churn_drive": "watch",
+                          "churn_events_applied": st["applied"],
+                          "churn_batches": st["batches"]}
+        else:
+            churn_cols = {"churn_drive": "direct"}
 
     # parity sample: resident answers vs the serial fresh-encode oracle
     parity_ok = True
@@ -256,6 +318,7 @@ def run_loadgen(args) -> dict:
         "fanout": args.fanout,
         "drive": "http" if args.http else "inproc",
         "churn": bool(args.churn),
+        **churn_cols,
         "image_build_s": round(build_s, 3),
         "epoch": image.epoch,
         "batches": batches,
@@ -330,7 +393,13 @@ def main(argv=None) -> int:
     parser.add_argument("--templates", type=int, default=12)
     parser.add_argument("--parity-sample", type=int, default=4)
     parser.add_argument("--churn", action="store_true",
-                        help="apply live pod-churn ingest deltas mid-run")
+                        help="apply live pod-churn ingest deltas mid-run "
+                             "(through the simonsync watch path by default)")
+    parser.add_argument("--churn-direct", action="store_true",
+                        dest="churn_direct",
+                        help="with --churn: apply deltas straight to the "
+                             "image (legacy path, kept for A/B against the "
+                             "watch-source mode)")
     parser.add_argument("--http", action="store_true",
                         help="drive through the real HTTP stack instead of "
                              "in-process submit")

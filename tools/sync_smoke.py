@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""CI smoke for simonsync (fast, CPU-only).
+
+The resilient-watch-sync acceptance criteria, end to end and against REAL
+process/socket boundaries (tests/test_sync.py covers the in-process half):
+
+- **Socket-level connection kill mid-watch.** A stdlib HTTP server streams
+  a recorded watch over chunked HTTP and hard-closes the TCP connection
+  mid-stream on the first attempt. HttpWatchSource must classify the torn
+  read as TransientError, reconnect from the bookmark on the seeded
+  schedule, and converge to the flap-free oracle.
+- **Real SIGKILL between bookmark stamp and apply.** A child process syncs
+  a recorded stream into an HAState and SIGKILLs itself after the bookmark
+  file is written but BEFORE the batch applies — the nastiest point of the
+  crash window. The parent restarts from (checkpoint + WAL tail +
+  bookmark): the stamped-but-unapplied window must replay, and the final
+  image must be bit-identical (truth, epoch) to the never-crashed run.
+- **Fault-site replay equality.** watch_read / watch_parse / watch_gone /
+  relist, each injected twice under the same plan, fire identical traces
+  (the simonfault contract) and still converge to the oracle.
+- **Tripwires.** simon_sync_parity_mismatches_total and
+  simon_sync_full_rebuilds_total are zero at exit, and no run ever bumped
+  the image generation (delta events only, never a full rebuild).
+
+Prints one JSON line with the measured numbers.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from open_simulator_tpu.live import (  # noqa: E402
+    HttpWatchSource,
+    RecordedSource,
+    ScriptedSource,
+    WatchSync,
+)
+from open_simulator_tpu.obs import REGISTRY  # noqa: E402
+from open_simulator_tpu.resilience import FaultPlan, installed  # noqa: E402
+from open_simulator_tpu.serve import HAState, ResidentImage  # noqa: E402
+from open_simulator_tpu.utils.synth import synth_watch_stream  # noqa: E402
+
+STATE_DIR = "/tmp/sync_smoke_state"
+KILL_AT_BATCH = 4  # SIGKILL after batch 4's bookmark stamp, before its apply
+CHECKPOINT_EVERY = 2
+
+
+def _workload():
+    return synth_watch_stream(24, 200, seed=6, bookmark_every=20, n_bound=16)
+
+
+def _image(nodes, bound):
+    img = ResidentImage.try_build(
+        [json.loads(json.dumps(n)) for n in nodes],
+        pods=[json.loads(json.dumps(p)) for p in bound])
+    assert img is not None, "resident image declined the synthetic cluster"
+    return img
+
+
+def _build_image():
+    nodes, bound, _ = _workload()
+    return _image(nodes, bound)
+
+
+def _truth(image):
+    pods, live = image.sync_snapshot()
+    return json.dumps({"pods": sorted(pods.items()),
+                       "nodes": sorted(live)}, sort_keys=True)
+
+
+def _oracle():
+    nodes, bound, lines = _workload()
+    img = _image(nodes, bound)
+    WatchSync(RecordedSource(lines=lines), image=img).run()
+    return img
+
+
+# ------------------------------------------- socket-level connection kill ----
+
+
+def socket_kill_smoke(row):
+    """Stream the recorded watch over real HTTP; hard-close the socket
+    mid-stream on the first connection."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from urllib.parse import parse_qs, urlparse
+
+    nodes, bound, lines = _workload()
+    oracle = _oracle()
+    final_rv = max(
+        int(json.loads(ln)["object"]["metadata"]["resourceVersion"])
+        for ln in lines)
+    attempts = {"n": 0}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            q = parse_qs(urlparse(self.path).query)
+            since = int(q.get("resourceVersion", ["0"])[0])
+            attempts["n"] += 1
+            first = attempts["n"] == 1
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            sent = 0
+            for ln in lines:
+                rv = int(json.loads(ln)["object"]["metadata"]
+                         ["resourceVersion"])
+                if rv <= since:
+                    continue
+                self.wfile.write(ln.encode() + b"\n")
+                self.wfile.flush()
+                sent += 1
+                if first and sent >= 37:
+                    # hard TCP close mid-stream: no terminator, no
+                    # trailing newline — the reader sees a torn stream
+                    self.connection.close()
+                    return
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    img = _image(nodes, bound)
+    src = HttpWatchSource(f"http://127.0.0.1:{port}/watch", timeout=10.0)
+    stop = threading.Event()
+    sync = WatchSync(src, image=img, sleep=lambda s: stop.wait(s))
+    t = threading.Thread(target=sync.run, args=(stop,), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline and sync.bookmark < final_rv:
+        time.sleep(0.01)
+    stop.set()
+    t.join(timeout=30.0)
+    httpd.shutdown()
+    assert not t.is_alive(), "sync thread wedged after stop"
+    assert sync.bookmark >= final_rv, \
+        f"never converged: bookmark {sync.bookmark} < {final_rv}"
+    assert attempts["n"] >= 2, "the socket kill never forced a reconnect"
+    assert sync.reconnects >= 1, "torn read did not classify as transient"
+    assert _truth(img) == _truth(oracle), \
+        "socket-kill run diverged from flap-free oracle"
+    assert img.epoch == oracle.epoch, \
+        f"epoch diverged: {img.epoch} != {oracle.epoch}"
+    assert img.generation == 1 and sync.full_rebuilds == 0
+    row["socket_kill"] = {"connections": attempts["n"],
+                          "reconnects": sync.reconnects,
+                          "applied": sync.applied,
+                          "final_epoch": img.epoch}
+
+
+# ------------------------------------------------- SIGKILL crash-restart -----
+
+
+def sigkill_resume_smoke(row):
+    import shutil
+    import signal
+    import subprocess
+
+    oracle = _oracle()
+    nodes, bound, lines = _workload()
+    stream_path = os.path.join("/tmp", "sync_smoke_stream.jsonl")
+    with open(stream_path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+    if os.path.exists(STATE_DIR):
+        shutil.rmtree(STATE_DIR)
+    child = r"""
+import os, signal, sys
+sys.path.insert(0, %r)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import tools.sync_smoke as sm
+from open_simulator_tpu.live import RecordedSource, WatchSync
+from open_simulator_tpu.serve import HAState
+
+real = WatchSync._apply
+state = {"n": 0}
+def apply(self, events):
+    # the bookmark stamp for this batch is ALREADY on disk (_flush writes
+    # it before applying): dying here leaves a stamped-but-unapplied window
+    state["n"] += 1
+    if state["n"] >= %d:
+        os.kill(os.getpid(), signal.SIGKILL)
+    real(self, events)
+WatchSync._apply = apply
+
+ha = HAState.open(%r, sm._build_image, checkpoint_every=sm.CHECKPOINT_EVERY)
+sync = WatchSync(RecordedSource(path=%r), ha=ha)
+sync.run()
+print("UNREACHABLE")
+""" % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+       KILL_AT_BATCH, STATE_DIR, stream_path)
+    proc = subprocess.run([sys.executable, "-c", child],
+                          capture_output=True, text=True, timeout=540)
+    assert proc.returncode == -signal.SIGKILL, \
+        f"child did not die by SIGKILL: rc={proc.returncode} " \
+        f"{proc.stderr[-400:]}"
+    assert "UNREACHABLE" not in proc.stdout
+
+    # restart: checkpoint + WAL tail restore the applied prefix; the
+    # bookmark file's expected_seq detects the stamped-but-unapplied
+    # window and resumes from prev_rv so it replays
+    ha = HAState.open(STATE_DIR, _build_image,
+                      checkpoint_every=CHECKPOINT_EVERY)
+    restored_seq = ha.image.seq
+    assert restored_seq == KILL_AT_BATCH - 1, \
+        f"restored seq {restored_seq}, want {KILL_AT_BATCH - 1} " \
+        f"(batch {KILL_AT_BATCH} stamped but never applied)"
+    sync = WatchSync(RecordedSource(path=stream_path), ha=ha)
+    stats = sync.run()
+    assert _truth(ha.image) == _truth(oracle), \
+        "resumed host truth != never-crashed host truth"
+    assert ha.image.epoch == oracle.epoch, \
+        f"epoch diverged: {ha.image.epoch} != {oracle.epoch}"
+    assert stats["full_rebuilds"] == 0 and ha.image.generation == 1
+    ha.close()
+    shutil.rmtree(STATE_DIR)
+    os.unlink(stream_path)
+    row["sigkill_resume"] = {
+        "killed_at_batch": KILL_AT_BATCH,
+        "restored_seq": restored_seq,
+        "resumed_from_rv": stats["bookmark"],
+        "final_epoch": oracle.epoch,
+    }
+
+
+# --------------------------------------------------- fault-site replay -------
+
+
+def site_sweep_smoke(row):
+    nodes, bound, lines = _workload()
+    oracle = _oracle()
+    fired = {}
+    for site, error in (("watch_read", "transient"),
+                        ("watch_parse", "transient"),
+                        ("watch_gone", "protocol"),
+                        ("relist", "transient")):
+        traces = []
+        for rep in range(2):
+            img = _image(nodes, bound)
+            src = ScriptedSource(
+                lines, seed=1, base_nodes=nodes, base_pods=bound,
+                gone_p=1.0 if site == "relist" else 0.0)
+            sync = WatchSync(src, image=img, sleep=lambda s: None)
+            plan = FaultPlan.from_json({"faults": [
+                {"site": site, "attempt": 2, "error": error}]})
+            with installed(plan) as active:
+                stats = sync.run()
+                traces.append(list(active.trace))
+            assert _truth(img) == _truth(oracle), f"{site}: diverged"
+            assert stats["full_rebuilds"] == 0, site
+        assert traces[0] == traces[1], f"{site}: traces differ"
+        assert traces[0], f"{site}: never fired"
+        fired[site] = len(traces[0])
+    row["site_sweep"] = fired
+
+
+def main() -> int:
+    row = {}
+    socket_kill_smoke(row)
+    sigkill_resume_smoke(row)
+    site_sweep_smoke(row)
+    vals = REGISTRY.values()
+    for fam in ("simon_sync_parity_mismatches_total",
+                "simon_sync_full_rebuilds_total"):
+        assert int(vals.get(fam, 0)) == 0, f"{fam} nonzero"
+    row["tripwires_zero"] = True
+    print(json.dumps(row))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
